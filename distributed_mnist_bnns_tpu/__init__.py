@@ -1,0 +1,28 @@
+"""distributed_mnist_bnns_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework for
+training Binarized Neural Networks, with the full capability surface of the
+reference repo drepion43/distributed-mnist-BNNs (PyTorch/DDP), re-designed
+TPU-first.
+
+Subpackages
+-----------
+ops       : binarization/quantization primitives (custom_vjp STE), losses,
+            bitplane packing, XNOR-popcount GEMM (Pallas) and MXU paths.
+models    : Flax modules — BinarizedDense/BinarizedConv, the BNN MLP family,
+            fp32 ConvNet / deep CNN, and a fully-binarized CNN.
+parallel  : device meshes, data-parallel and model-parallel train steps
+            (jit/GSPMD and explicit shard_map+psum), multi-host init.
+train     : functional trainer (STE + latent-weight clamp projection),
+            optimizer registry and epoch "regime" scheduling, eval loops.
+data      : MNIST idx pipeline with deterministic per-host sharding.
+utils     : logging, meters, results CSV/HTML, checkpointing, accuracy.
+
+The reference's semantics that this framework preserves (see SURVEY.md):
+  * fp32 latent "master" weights binarized on every forward
+    (reference: models/binarized_modules.py:68-85),
+  * straight-through-estimator gradients applied to the latent weights
+    (reference training loop mnist-dist2.py:131-137), expressed here as a
+    jax.custom_vjp instead of the data-swap trick,
+  * clamp(-1, 1) projection of latent weights after each optimizer step.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+from .layers import BinarizedDense, BinarizedConv
+from .mlp import BnnMLP, bnn_mlp_large, bnn_mlp_small
+from .convnet import ConvNet
+from .cnn import DeepCNN
+from .bnn_cnn import BinarizedCNN
+from .registry import get_model, MODEL_REGISTRY, latent_clamp_mask
+
+__all__ = [
+    "BinarizedDense",
+    "BinarizedConv",
+    "BnnMLP",
+    "bnn_mlp_large",
+    "bnn_mlp_small",
+    "ConvNet",
+    "DeepCNN",
+    "BinarizedCNN",
+    "get_model",
+    "MODEL_REGISTRY",
+    "latent_clamp_mask",
+]

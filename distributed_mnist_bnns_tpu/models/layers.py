@@ -1,0 +1,164 @@
+"""Binarized Flax layers with fp32 latent ("master") parameters.
+
+Parity targets: the reference's BinarizeLinear / BinarizeConv2d
+(models/binarized_modules.py:68-85, 87-107). Semantics preserved:
+
+  * fp32 latent kernel/bias live as the *only* stored parameters; the ±1
+    binarized view is re-derived on every forward (the reference's
+    weight.org / weight.data pair collapses to latent params + a pure
+    function — no aliasing, no in-place mutation).
+  * inputs are binarized before the GEMM *except* for first layers fed raw
+    data. The reference keys this on channel count (input.size(1)==784 for
+    linear, ==3 for conv — models/binarized_modules.py:75,94), a fragile
+    heuristic; here it is an explicit ``binarize_input`` flag per layer
+    (SURVEY.md §7 "hard parts").
+  * bias stays fp32 and is added after the binary GEMM
+    (models/binarized_modules.py:83-84, 103-106).
+  * gradients: straight-through — ``binarize_ste`` (identity by default,
+    matching the training dynamics of the reference's data-swap trick;
+    "hardtanh" mode available for the textbook BNN STE).
+
+TPU-first notes: the GEMM runs on a selectable backend (bf16 MXU by
+default — exact for ±1 operands — or the XNOR-popcount bitplane path; see
+ops/xnor_gemm.py). Convolutions lower to lax.conv_general_dilated in
+bf16 (MXU) or to patch-extraction + binary GEMM for the bitplane backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.binarize import STEMode, binarize_ste
+from ..ops.xnor_gemm import Backend, binary_matmul, get_default_backend
+
+Dtype = Any
+
+
+def _latent_init(scale: float = 1.0) -> Callable:
+    """LeCun-uniform style init for latent weights, kept in [-1, 1] so the
+    clamp projection is a no-op at step 0 (torch's default kaiming-uniform
+    for the reference's layer sizes also lands well inside [-1, 1])."""
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in = 1
+        for d in shape[:-1]:
+            fan_in *= d
+        bound = min(1.0, scale / (fan_in**0.5))
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class BinarizedDense(nn.Module):
+    """y = binarize(x) @ binarize(W_latent) + b_fp32.
+
+    Attributes:
+      features: output width.
+      binarize_input: binarize the activations entering this layer
+        (False for the first layer on raw pixels — the explicit version of
+        the reference's ``input.size(1) != 784`` check).
+      ste: "identity" (reference parity) or "hardtanh".
+      backend: GEMM backend override (None -> global default).
+    """
+
+    features: int
+    binarize_input: bool = True
+    use_bias: bool = True
+    ste: STEMode = "identity"
+    backend: Backend | None = None
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel",
+            _latent_init(),
+            (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        if self.binarize_input:
+            x = binarize_ste(x, self.ste)
+        wb = binarize_ste(kernel, self.ste)
+        lead = x.shape[:-1]
+        y = binary_matmul(
+            x.reshape(-1, x.shape[-1]), wb, self.backend or get_default_backend()
+        )
+        y = y.reshape(*lead, self.features)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype
+            )
+            y = y + bias
+        return y
+
+
+class BinarizedConv(nn.Module):
+    """NHWC binarized conv: conv(binarize(x), binarize(W_latent)) + b_fp32.
+
+    Reference parity: BinarizeConv2d (models/binarized_modules.py:87-107) —
+    input binarized unless this is a raw-image first layer, fp32 latent
+    kernel binarized each forward, fp32 bias broadcast over space after the
+    conv. Data layout is NHWC (TPU-native), not the reference's NCHW.
+    """
+
+    features: int
+    kernel_size: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: str | Sequence[tuple[int, int]] = "SAME"
+    binarize_input: bool = True
+    use_bias: bool = True
+    ste: STEMode = "identity"
+    backend: Backend | None = None
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kh, kw = self.kernel_size
+        in_ch = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            _latent_init(),
+            (kh, kw, in_ch, self.features),
+            self.param_dtype,
+        )
+        if self.binarize_input:
+            x = binarize_ste(x, self.ste)
+        wb = binarize_ste(kernel, self.ste)
+
+        backend = self.backend or get_default_backend()
+        if backend in ("xnor", "pallas_xnor"):
+            # Patch-extraction (im2col) + bitplane GEMM: each output pixel's
+            # receptive field becomes a K=kh*kw*in_ch ±1 dot product.
+            patches = jax.lax.conv_general_dilated_patches(
+                x,
+                filter_shape=(kh, kw),
+                window_strides=tuple(self.strides),
+                padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )  # (N, Ho, Wo, kh*kw*in_ch) — but channel-major patch order
+            n, ho, wo, k = patches.shape
+            # conv_general_dilated_patches emits features as (in_ch, kh, kw)
+            # flattened; reorder the kernel to match.
+            wmat = jnp.transpose(wb, (2, 0, 1, 3)).reshape(kh * kw * in_ch, self.features)
+            y = binary_matmul(patches.reshape(-1, k), wmat, backend)
+            y = y.reshape(n, ho, wo, self.features)
+        else:
+            dtype = jnp.bfloat16 if backend == "bf16" else x.dtype
+            y = jax.lax.conv_general_dilated(
+                x.astype(dtype),
+                wb.astype(dtype),
+                window_strides=tuple(self.strides),
+                padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32,
+            )
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype
+            )
+            y = y + bias
+        return y

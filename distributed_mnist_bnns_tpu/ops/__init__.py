@@ -1,0 +1,20 @@
+from .binarize import binarize, binarize_ste, quantize
+from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss
+from .bitpack import pack_bits, unpack_bits, packed_dim
+from .xnor_gemm import xnor_matmul, binary_matmul, set_default_backend, get_default_backend
+
+__all__ = [
+    "binarize",
+    "binarize_ste",
+    "quantize",
+    "hinge_loss",
+    "sqrt_hinge_loss",
+    "cross_entropy_loss",
+    "pack_bits",
+    "unpack_bits",
+    "packed_dim",
+    "xnor_matmul",
+    "binary_matmul",
+    "set_default_backend",
+    "get_default_backend",
+]

@@ -1,0 +1,68 @@
+"""Bitplane packing of ±1 tensors into int32 words.
+
+This is the storage format for the XNOR-popcount GEMM backend (the TPU-native
+replacement for the fp32 GEMM-on-±1-values the reference runs through cuDNN,
+models/binarized_modules.py:80). Convention: bit = 1  ⟺  value = +1.
+
+With that convention, for two packed words a, b covering 32 positions:
+    mismatches = popcount(a XOR b)
+    dot        = matches - mismatches = 32 - 2 * mismatches
+so a full K-length ±1 dot product is  K - 2 * sum_w popcount(a_w XOR b_w).
+Zero-padding *both* operands' tail words adds equal bits (matches only), so
+the formula stays exact with the *unpadded* K — no masking needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+WORD_BITS = 32
+
+
+def packed_dim(k: int, multiple: int = 1) -> int:
+    """Number of int32 words needed to pack k bits, rounded up to `multiple`."""
+    words = -(-k // WORD_BITS)
+    return -(-words // multiple) * multiple
+
+
+def pack_bits(x: jnp.ndarray, pad_words_to: int = 1) -> jnp.ndarray:
+    """Pack ±1 values along the last axis into int32 bitplanes.
+
+    x: (..., K) array of ±1 (any float/int dtype; >0 is treated as +1).
+    Returns (..., packed_dim(K, pad_words_to)) int32.
+    """
+    k = x.shape[-1]
+    kw = packed_dim(k, pad_words_to)
+    pad = kw * WORD_BITS - k
+    bits = (x > 0).astype(jnp.uint32)
+    if pad:
+        bits = jnp.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
+    bits = bits.reshape(*bits.shape[:-1], kw, WORD_BITS)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    words = jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
+    return words.astype(jnp.int32)
+
+
+def unpack_bits(words: jnp.ndarray, k: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of pack_bits: (..., KW) int32 -> (..., k) ±1 array."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (words.astype(jnp.uint32)[..., None] >> shifts) & jnp.uint32(1)
+    flat = bits.reshape(*words.shape[:-1], words.shape[-1] * WORD_BITS)
+    pm1 = flat.astype(dtype) * 2 - 1
+    return pm1[..., :k]
+
+
+def pack_bits_np(x: np.ndarray, pad_words_to: int = 1) -> np.ndarray:
+    """NumPy host-side variant of pack_bits (used by the data pipeline and
+    the C++ loader's pure-python fallback)."""
+    k = x.shape[-1]
+    kw = packed_dim(k, pad_words_to)
+    pad = kw * WORD_BITS - k
+    bits = (x > 0).astype(np.uint32)
+    if pad:
+        bits = np.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
+    bits = bits.reshape(*bits.shape[:-1], kw, WORD_BITS)
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)
+    words = np.sum(bits << shifts, axis=-1, dtype=np.uint64).astype(np.uint32)
+    return words.view(np.int32)

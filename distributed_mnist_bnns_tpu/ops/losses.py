@@ -1,0 +1,66 @@
+"""Loss functions: hinge, squared ("sqrt") hinge, cross entropy.
+
+Parity with the reference's HingeLoss / SqrtHingeLossFunction
+(models/binarized_modules.py:20-54) and the CrossEntropyLoss used by every
+training loop (e.g. mnist-dist2.py:90). The reference's SqrtHingeLossFunction
+has a live pdb.set_trace() in its backward (models/binarized_modules.py:50),
+making it unusable; here the same math is implemented as a custom_vjp with the
+reference's handwritten gradient, minus the debugger.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def hinge_loss(output: jnp.ndarray, target_pm1: jnp.ndarray) -> jnp.ndarray:
+    """Margin-1 hinge: mean(max(0, 1 - output * target)).
+
+    ``target_pm1`` is ±1-coded (the reference's HingeLoss contract,
+    models/binarized_modules.py:20-32).
+    """
+    return jnp.mean(jnp.maximum(0.0, 1.0 - output * target_pm1))
+
+
+@jax.custom_vjp
+def sqrt_hinge_loss(output: jnp.ndarray, target_pm1: jnp.ndarray) -> jnp.ndarray:
+    """Squared hinge: mean over batch of sum(max(0, 1 - y*t)^2).
+
+    Mirrors the forward of reference SqrtHingeLossFunction
+    (models/binarized_modules.py:34-46): per-sample sum of squared hinge
+    terms, averaged over the batch, with the reference's handwritten backward
+    (minus its pdb.set_trace(), :50).
+    """
+    err = jnp.maximum(0.0, 1.0 - output * target_pm1)
+    batch = output.shape[0] if output.ndim > 0 else 1
+    return jnp.sum(err * err) / batch
+
+
+def _sqrt_hinge_fwd(output, target_pm1):
+    err = jnp.maximum(0.0, 1.0 - output * target_pm1)
+    batch = output.shape[0] if output.ndim > 0 else 1
+    return jnp.sum(err * err) / batch, (err, target_pm1, batch)
+
+
+def _sqrt_hinge_bwd(res, g):
+    err, target_pm1, batch = res
+    # d/d_output of sum((1 - y*t)_+^2)/B = -2 * t * err / B
+    grad_out = -2.0 * target_pm1 * err / batch * g
+    return grad_out, jnp.zeros_like(target_pm1)
+
+
+sqrt_hinge_loss.defvjp(_sqrt_hinge_fwd, _sqrt_hinge_bwd)
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross entropy over integer labels.
+
+    Equivalent of nn.CrossEntropyLoss (mnist-dist2.py:90). The reference's
+    BNN MLP ends in LogSoftmax *and* is trained with CrossEntropyLoss (a
+    double-log-softmax quirk, mnist-dist2.py:75,90,124 — harmless because
+    log_softmax is shift-invariant and idempotent up to normalization); we
+    accept either logits or log-probabilities for the same reason.
+    """
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()

@@ -1,0 +1,195 @@
+"""Binary (±1) matrix multiply with selectable TPU backends.
+
+This is the performance core: the role cuDNN/ATen's fp32 GEMM plays for the
+reference (nn.functional.linear on ±1 values, models/binarized_modules.py:80)
+is played here by one of:
+
+  * "xla"         — fp32 jnp.dot of the ±1 values (correctness oracle; what
+                    the reference effectively computes).
+  * "bf16"        — cast ±1 to bfloat16 and hit the MXU with fp32
+                    accumulation. ±1 is exactly representable in bf16, so
+                    this is bit-exact w.r.t. the fp32 oracle while running at
+                    MXU bf16 rate. Usually the fastest path at MNIST sizes.
+  * "xnor"        — int32 bitplane XNOR+popcount GEMM written in pure
+                    jax.numpy (XLA-compiled; also the CPU-runnable oracle for
+                    the Pallas kernel).
+  * "pallas_xnor" — the hand-written Pallas TPU kernel (bitplanes in VMEM,
+                    popcount on the VPU, fori_loop over packed-K).
+
+All backends are exact (no approximation): a ±1 dot product is an integer
+with |dot| <= K <= 2^24, representable in fp32/int32.
+
+Gradients: `binary_matmul` carries a custom_vjp whose backward is the pair of
+fp32 matmuls (g @ w^T, x^T @ g) on the ±1 operands — the same gradients the
+reference's autograd computes through nn.functional.linear on binarized
+values (SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from .bitpack import WORD_BITS, pack_bits
+
+Backend = Literal["xla", "bf16", "xnor", "pallas_xnor"]
+
+_DEFAULT_BACKEND: Backend = "bf16"
+
+
+def set_default_backend(backend: Backend) -> None:
+    global _DEFAULT_BACKEND
+    if backend not in ("xla", "bf16", "xnor", "pallas_xnor"):
+        raise ValueError(f"unknown backend {backend!r}")
+    _DEFAULT_BACKEND = backend
+
+
+def get_default_backend() -> Backend:
+    return _DEFAULT_BACKEND
+
+
+# ---------------------------------------------------------------------------
+# XNOR-popcount GEMM — pure-jnp reference implementation
+# ---------------------------------------------------------------------------
+
+
+def _xnor_matmul_jnp(x_pm1: jnp.ndarray, w_pm1: jnp.ndarray) -> jnp.ndarray:
+    """(M, K) @ (K, N) on ±1 values via bitplanes, in pure jax.numpy."""
+    k = x_pm1.shape[-1]
+    xp = pack_bits(x_pm1)                 # (M, KW) int32
+    wp = pack_bits(w_pm1.T)               # (N, KW) int32
+    xor = jnp.bitwise_xor(xp[:, None, :], wp[None, :, :])        # (M, N, KW)
+    mismatches = jnp.sum(
+        jax.lax.population_count(xor), axis=-1, dtype=jnp.int32
+    )
+    return (k - 2 * mismatches).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# XNOR-popcount GEMM — Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _xnor_kernel(x_ref, w_ref, o_ref, *, k_words: int, real_k: int):
+    """One (bm, bn) output tile: o = real_k - 2 * sum_w popcount(x ^ w).
+
+    x_ref: (bm, KW) int32 packed activations
+    w_ref: (bn, KW) int32 packed weights (N-major, packed along K)
+    The packed-K loop runs on the VPU: each step is a (bm, bn) xor+popcount.
+    """
+    x = x_ref[...]
+    w = w_ref[...]
+
+    def body(i, acc):
+        xw = jax.lax.dynamic_slice_in_dim(x, i, 1, axis=1)       # (bm, 1)
+        ww = jax.lax.dynamic_slice_in_dim(w, i, 1, axis=1)       # (bn, 1)
+        mism = jax.lax.population_count(
+            jnp.bitwise_xor(xw, jnp.transpose(ww))               # (bm, bn)
+        )
+        return acc + mism
+
+    bm, bn = o_ref.shape
+    acc = jax.lax.fori_loop(0, k_words, body, jnp.zeros((bm, bn), jnp.int32))
+    o_ref[...] = (real_k - 2 * acc).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
+def xnor_matmul(
+    x_pm1: jnp.ndarray,
+    w_pm1: jnp.ndarray,
+    *,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(M, K) @ (K, N) on ±1 values via the Pallas XNOR-popcount kernel.
+
+    Pads M and N up to block multiples (padding rows/cols are ±1 garbage and
+    sliced off), packs K into int32 words zero-padded so the popcount formula
+    stays exact (see bitpack.py docstring).
+    """
+    from jax.experimental import pallas as pl
+
+    m, k = x_pm1.shape
+    k2, n = w_pm1.shape
+    assert k == k2, (x_pm1.shape, w_pm1.shape)
+
+    bm = min(block_m, max(8, m))
+    bn = min(block_n, max(128, n))
+    mp = -(-m // bm) * bm
+    np_ = -(-n // bn) * bn
+
+    xp = pack_bits(x_pm1)            # (M, KW)
+    wp = pack_bits(w_pm1.T)          # (N, KW)
+    kw = xp.shape[-1]
+    if mp != m:
+        xp = jnp.pad(xp, ((0, mp - m), (0, 0)))
+    if np_ != n:
+        wp = jnp.pad(wp, ((0, np_ - n), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_xnor_kernel, k_words=kw, real_k=k),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, kw), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, kw), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(xp, wp)
+    return out[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# Unified differentiable entry point
+# ---------------------------------------------------------------------------
+
+
+def _forward(x_pm1, w_pm1, backend, interpret):
+    if backend == "xla":
+        return jnp.dot(x_pm1, w_pm1, preferred_element_type=jnp.float32)
+    if backend == "bf16":
+        return jnp.dot(
+            x_pm1.astype(jnp.bfloat16),
+            w_pm1.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    if backend == "xnor":
+        return _xnor_matmul_jnp(x_pm1, w_pm1)
+    if backend == "pallas_xnor":
+        return xnor_matmul(x_pm1, w_pm1, interpret=interpret)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def binary_matmul(
+    x_pm1: jnp.ndarray,
+    w_pm1: jnp.ndarray,
+    backend: Backend | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Differentiable ±1 matmul: forward on the chosen backend, backward as
+    bf16 MXU matmuls of the ±1 operands (exact, since operands are ±1 and
+    cotangents are fp32 — accumulation is fp32)."""
+    return _forward(x_pm1, w_pm1, backend or _DEFAULT_BACKEND, interpret)
+
+
+def _bmm_fwd(x_pm1, w_pm1, backend, interpret):
+    return _forward(x_pm1, w_pm1, backend or _DEFAULT_BACKEND, interpret), (
+        x_pm1,
+        w_pm1,
+    )
+
+
+def _bmm_bwd(backend, interpret, res, g):
+    x_pm1, w_pm1 = res
+    gx = jnp.dot(g, w_pm1.T.astype(g.dtype), preferred_element_type=jnp.float32)
+    gw = jnp.dot(x_pm1.T.astype(g.dtype), g, preferred_element_type=jnp.float32)
+    return gx.astype(x_pm1.dtype), gw.astype(w_pm1.dtype)
+
+
+binary_matmul.defvjp(_bmm_fwd, _bmm_bwd)

@@ -1,0 +1,27 @@
+"""Test configuration: run the suite on a simulated 8-device CPU platform so
+distributed (pjit/shard_map/psum) paths are exercised without TPU hardware —
+the TPU-world replacement for the reference's missing fake backend
+(SURVEY.md §4).
+
+This image may install an experimental remote-TPU PJRT plugin ("axon") from
+a PYTHONPATH sitecustomize at interpreter start, which flips the jax config
+to ``jax_platforms="axon,cpu"``; the first computation then dials a network
+tunnel and blocks. Backends initialize lazily, so pinning the config back to
+cpu here (before any computation) keeps the whole suite on the local CPU
+platform."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu"
+assert jax.local_device_count() == 8, jax.devices()

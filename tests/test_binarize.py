@@ -1,0 +1,88 @@
+"""Unit tests for binarize/quantize STE ops (SURVEY.md §4: binarize fwd/bwd
+against the reference semantics and finite differences)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.ops import binarize, quantize
+from distributed_mnist_bnns_tpu.ops.binarize import binarize_ste
+
+
+def test_binarize_det_values():
+    x = jnp.array([-2.0, -0.5, 0.0, 0.3, 1.7])
+    out = binarize(x)
+    np.testing.assert_array_equal(np.asarray(out), [-1, -1, 1, 1, 1])
+
+
+def test_binarize_outputs_strictly_pm1():
+    x = jax.random.normal(jax.random.PRNGKey(0), (128,))
+    out = np.asarray(binarize(x))
+    assert set(np.unique(out)) <= {-1.0, 1.0}
+
+
+def test_binarize_identity_ste_grad():
+    # Reference semantics: the data-swap trick makes the sign op invisible to
+    # autograd, so d(binarize)/dx == 1 everywhere (mnist-dist2.py:131-137).
+    x = jnp.array([-3.0, -0.5, 0.5, 3.0])
+    g = jax.grad(lambda v: binarize_ste(v, "identity").sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), np.ones(4))
+
+
+def test_binarize_hardtanh_ste_grad():
+    x = jnp.array([-3.0, -0.5, 0.5, 3.0])
+    g = jax.grad(lambda v: binarize_ste(v, "hardtanh").sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), [0.0, 1.0, 1.0, 0.0])
+
+
+def test_binarize_stochastic_statistics():
+    # P(+1) should be ~(x+1)/2 for x in [-1, 1].
+    key = jax.random.PRNGKey(1)
+    x = jnp.full((20000,), 0.5)
+    out = binarize(x, "stoch", key=key)
+    p_plus = float((out > 0).mean())
+    assert abs(p_plus - 0.75) < 0.02
+    assert set(np.unique(np.asarray(out))) <= {-1.0, 1.0}
+
+
+def test_binarize_stochastic_requires_key():
+    with pytest.raises(ValueError):
+        binarize(jnp.ones(3), "stoch")
+
+
+def test_binarize_stochastic_grad_is_ste():
+    key = jax.random.PRNGKey(2)
+    x = jnp.array([-0.3, 0.4])
+    g = jax.grad(lambda v: binarize(v, "stoch", key=key).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), [1.0, 1.0])
+
+
+def test_quantize_det_matches_reference_formula():
+    # clamp(x*2^(b-1), -2^(b-1), 2^(b-1)-1) rounded, rescaled
+    # (models/binarized_modules.py:56-61).
+    x = jnp.array([-3.0, -0.7, 0.0, 0.3, 0.9, 3.0])
+    out = np.asarray(quantize(x, num_bits=4))
+    scale = 2.0**3
+    expected = np.round(np.clip(np.asarray(x) * scale, -scale, scale - 1)) / scale
+    np.testing.assert_allclose(out, expected, rtol=0, atol=1e-7)
+
+
+def test_quantize_grad_identity():
+    x = jnp.linspace(-2, 2, 9)
+    g = jax.grad(lambda v: quantize(v, num_bits=8).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), np.ones(9))
+
+
+def test_quantize_stochastic_unbiased_ish():
+    key = jax.random.PRNGKey(3)
+    x = jnp.full((50000,), 0.3)
+    out = quantize(x, "stoch", num_bits=4, key=key)
+    assert abs(float(out.mean()) - 0.3) < 0.01
+
+
+def test_binarize_jit_compatible():
+    f = jax.jit(lambda v: binarize(v))
+    np.testing.assert_array_equal(
+        np.asarray(f(jnp.array([-1.0, 2.0]))), [-1.0, 1.0]
+    )

@@ -1,0 +1,52 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_mnist_bnns_tpu.ops import pack_bits, packed_dim, unpack_bits
+from distributed_mnist_bnns_tpu.ops.bitpack import pack_bits_np
+
+
+def _rand_pm1(key, shape):
+    return jnp.sign(jax.random.normal(key, shape)) + (
+        jax.random.normal(key, shape) == 0
+    ).astype(jnp.float32)
+
+
+def test_packed_dim():
+    assert packed_dim(32) == 1
+    assert packed_dim(33) == 2
+    assert packed_dim(784) == 25
+    assert packed_dim(784, multiple=128) == 128
+
+
+def test_pack_unpack_roundtrip():
+    key = jax.random.PRNGKey(0)
+    for k in (32, 33, 100, 784):
+        x = _rand_pm1(key, (5, k))
+        words = pack_bits(x)
+        assert words.dtype == jnp.int32
+        assert words.shape == (5, packed_dim(k))
+        back = unpack_bits(words, k)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_pack_bits_np_matches_jax():
+    rng = np.random.RandomState(0)
+    x = np.sign(rng.randn(7, 131)).astype(np.float32)
+    x[x == 0] = 1
+    np.testing.assert_array_equal(
+        pack_bits_np(x), np.asarray(pack_bits(jnp.asarray(x)))
+    )
+
+
+def test_popcount_dot_identity():
+    # K - 2*popcount(xor) equals the ±1 dot product.
+    key = jax.random.PRNGKey(1)
+    k = 100
+    a = _rand_pm1(key, (k,))
+    b = _rand_pm1(jax.random.PRNGKey(2), (k,))
+    pa, pb = pack_bits(a), pack_bits(b)
+    mism = int(
+        jnp.sum(jax.lax.population_count(jnp.bitwise_xor(pa, pb)))
+    )
+    assert k - 2 * mism == int(jnp.dot(a, b))

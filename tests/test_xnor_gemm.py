@@ -1,0 +1,65 @@
+"""XNOR-popcount GEMM vs fp32 ±1 matmul equivalence (SURVEY.md §4), across
+all backends including the Pallas kernel in interpret mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.ops import binary_matmul, xnor_matmul
+from distributed_mnist_bnns_tpu.ops.xnor_gemm import _xnor_matmul_jnp
+
+
+def _pm1(key, shape):
+    x = jnp.sign(jax.random.normal(key, shape))
+    return jnp.where(x == 0, 1.0, x)
+
+
+@pytest.mark.parametrize("m,k,n", [(4, 32, 8), (16, 784, 64), (3, 100, 10)])
+def test_jnp_xnor_matches_fp32(m, k, n):
+    x = _pm1(jax.random.PRNGKey(0), (m, k))
+    w = _pm1(jax.random.PRNGKey(1), (k, n))
+    oracle = np.asarray(jnp.dot(x, w))
+    out = np.asarray(_xnor_matmul_jnp(x, w))
+    np.testing.assert_array_equal(out, oracle)
+
+
+@pytest.mark.parametrize("m,k,n", [(8, 128, 128), (16, 784, 192), (130, 300, 70)])
+def test_pallas_xnor_interpret_matches_fp32(m, k, n):
+    x = _pm1(jax.random.PRNGKey(2), (m, k))
+    w = _pm1(jax.random.PRNGKey(3), (k, n))
+    oracle = np.asarray(jnp.dot(x, w))
+    out = np.asarray(xnor_matmul(x, w, interpret=True))
+    np.testing.assert_array_equal(out, oracle)
+
+
+@pytest.mark.parametrize("backend", ["xla", "bf16", "xnor"])
+def test_binary_matmul_backends_exact(backend):
+    x = _pm1(jax.random.PRNGKey(4), (8, 256))
+    w = _pm1(jax.random.PRNGKey(5), (256, 32))
+    oracle = np.asarray(jnp.dot(x, w))
+    out = np.asarray(binary_matmul(x, w, backend))
+    np.testing.assert_array_equal(out, oracle)
+
+
+def test_binary_matmul_gradients_match_dot():
+    x = _pm1(jax.random.PRNGKey(6), (4, 64))
+    w = _pm1(jax.random.PRNGKey(7), (64, 16))
+
+    def via_binary(x, w):
+        return (binary_matmul(x, w, "xnor") ** 2).sum()
+
+    def via_dot(x, w):
+        return (jnp.dot(x, w) ** 2).sum()
+
+    gx1, gw1 = jax.grad(via_binary, argnums=(0, 1))(x, w)
+    gx2, gw2 = jax.grad(via_dot, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2), rtol=1e-5)
+
+
+def test_binary_matmul_jit():
+    x = _pm1(jax.random.PRNGKey(8), (8, 128))
+    w = _pm1(jax.random.PRNGKey(9), (128, 8))
+    f = jax.jit(lambda a, b: binary_matmul(a, b, "xnor"))
+    np.testing.assert_array_equal(np.asarray(f(x, w)), np.asarray(jnp.dot(x, w)))
