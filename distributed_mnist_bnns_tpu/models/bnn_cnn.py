@@ -1,0 +1,50 @@
+"""Fully-binarized CNN for MNIST (XNOR-Net style) — the "MNIST
+BinarizeConv2d CNN" configuration from BASELINE.json. The reference defines
+BinarizeConv2d (models/binarized_modules.py:87-107) but never uses it in a
+model; this model family exercises it end to end the TPU way: binarized
+convs lower to bf16 MXU convs or to patch-extraction + bitplane XNOR GEMM.
+
+First conv consumes raw pixels (binarize_input=False — the explicit form of
+the reference's RGB/first-layer channel check, models/binarized_modules.py:94).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.xnor_gemm import Backend
+from .layers import BinarizedConv, BinarizedDense
+
+
+class BinarizedCNN(nn.Module):
+    num_classes: int = 10
+    widths: tuple[int, int] = (64, 128)
+    hidden: int = 1024
+    backend: Backend | None = None
+    ste: str = "identity"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], 28, 28, 1)
+        bn = lambda: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5
+        )
+        w1, w2 = self.widths
+        x = BinarizedConv(
+            w1, (3, 3), binarize_input=False, ste=self.ste, backend=self.backend
+        )(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))  # 28 -> 14
+        x = BinarizedConv(w2, (3, 3), ste=self.ste, backend=self.backend)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))  # 14 -> 7
+        x = x.reshape(x.shape[0], -1)
+        x = BinarizedDense(self.hidden, ste=self.ste, backend=self.backend)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x)

@@ -1,0 +1,53 @@
+"""Deeper fp32 CNN — parity with the reference's ``CNN``
+(mnist-cnn server.py:7-52, byte-identical client):
+
+  3 conv blocks 1->32->64->128 (3x3, SAME, ReLU, MaxPool 2x2; the 3rd pool
+  has padding=1, so 28 -> 14 -> 7 -> 4 spatially) ->
+  FC 2048->625 (Xavier init, ReLU, Dropout keep_prob=0.5) ->
+  FC 625->10 (Xavier init).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _max_pool_padded(x: jnp.ndarray) -> jnp.ndarray:
+    """2x2/2 max pool with torch-style padding=1 (pad both sides, floor):
+    7x7 -> 4x4, matching MaxPool2d(2, 2, padding=1) in the reference."""
+    return jax.lax.reduce_window(
+        x,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+
+
+class DeepCNN(nn.Module):
+    num_classes: int = 10
+    dropout_rate: float = 0.5  # torch keep_prob=0.5 -> drop 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], 28, 28, 1)
+        x = x.astype(self.dtype)
+        for i, features in enumerate((32, 64, 128)):
+            x = nn.Conv(features, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            if i < 2:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = _max_pool_padded(x)
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)  # (B, 4*4*128)
+        x = nn.Dense(625, kernel_init=nn.initializers.xavier_uniform())(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(
+            self.num_classes, kernel_init=nn.initializers.xavier_uniform()
+        )(x)

@@ -1,0 +1,39 @@
+"""fp32 ConvNet — parity with the reference's ``ConvNet``
+(mnist-dist.py:31-51 and its byte-identical duplicates in mnist.py,
+mnist-mixed.py, the change-master/node pairs):
+
+  Conv(1->16, 5x5, pad 2) -> BN -> ReLU -> MaxPool(2)
+  Conv(16->32, 5x5, pad 2) -> BN -> ReLU -> MaxPool(2)
+  Linear(7*7*32 -> 10)
+
+TPU-native: NHWC layout, bf16 compute optional via dtype, MXU-friendly conv
+shapes; no binarization anywhere (this is the fp32 baseline model).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvNet(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        if x.ndim == 2:  # (B, 784) -> (B, 28, 28, 1)
+            x = x.reshape(x.shape[0], 28, 28, 1)
+        x = x.astype(self.dtype)
+        for features in (16, 32):
+            x = nn.Conv(features, (5, 5), padding=2, dtype=self.dtype)(x)
+            x = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=self.dtype,
+            )(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)  # (B, 7*7*32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )

@@ -1,0 +1,73 @@
+"""BNN MLP family — the reference's flagship model.
+
+Parity target: ``Net`` in mnist-dist2.py:46-76 (large, infl_ratio=3:
+784 -> BinLinear 3072 -> BN -> Hardtanh -> BinLinear 1536 -> BN -> Hardtanh
+-> BinLinear 768 -> Dropout(0.3) -> BN -> Hardtanh -> fp32 Linear 10 ->
+LogSoftmax) and mnist-dist3.py:40-70 (small: width 192 throughout).
+
+Quirks preserved on purpose (documented, reference-faithful):
+  * dropout is applied *before* the third BatchNorm (mnist-dist2.py:72-74);
+  * the final fp32 Linear feeds LogSoftmax even though training uses
+    cross-entropy on top (mnist-dist2.py:75,124) — harmless (shift
+    invariance), kept so logits match the reference's scale;
+  * the first BinarizedDense consumes raw pixels un-binarized — the
+    explicit-flag version of the reference's input.size(1)==784 check
+    (models/binarized_modules.py:75).
+
+BatchNorm uses per-replica statistics under data parallelism (DDP default in
+the reference; SURVEY.md §7 "hard parts"), torch-default eps=1e-5 and an
+EMA equivalent to torch momentum=0.1 (flax momentum=0.9).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.xnor_gemm import Backend
+from .layers import BinarizedDense
+
+
+class BnnMLP(nn.Module):
+    """Binarized MLP with fp32 first/last-layer boundaries per the reference."""
+
+    hidden: Sequence[int] = (3072, 1536, 768)
+    num_classes: int = 10
+    dropout_rate: float = 0.3
+    backend: Backend | None = None
+    ste: str = "identity"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1)
+        h1, h2, h3 = self.hidden
+        bn = lambda: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5
+        )
+        # fc1: raw pixels in, not binarized (first-layer passthrough).
+        x = BinarizedDense(h1, binarize_input=False, ste=self.ste, backend=self.backend)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = BinarizedDense(h2, ste=self.ste, backend=self.backend)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = BinarizedDense(h3, ste=self.ste, backend=self.backend)(x)
+        # Reference order: dropout THEN bn3 (mnist-dist2.py:72-74).
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = nn.Dense(self.num_classes)(x)  # fp32 classifier head
+        return nn.log_softmax(x)
+
+
+def bnn_mlp_large(infl_ratio: int = 3, **kw) -> BnnMLP:
+    """784 -> 1024r -> 512r -> 256r -> 10 (mnist-dist2.py:48-76, r=3)."""
+    return BnnMLP(hidden=(1024 * infl_ratio, 512 * infl_ratio, 256 * infl_ratio), **kw)
+
+
+def bnn_mlp_small(infl_ratio: int = 3, **kw) -> BnnMLP:
+    """784 -> 64r -> 64r -> 64r -> 10 (mnist-dist3.py:42-70, r=3)."""
+    w = 64 * infl_ratio
+    return BnnMLP(hidden=(w, w, w), **kw)

@@ -1,0 +1,66 @@
+"""Model registry + the latent-weight clamp mask.
+
+The registry plays the role of the reference's per-script hardcoded ``Net``
+classes (SURVEY.md §2.2): one name -> constructor map covering every model
+family the reference defines, plus the binarized CNN stretch config.
+
+``latent_clamp_mask`` identifies which parameters are binarized-layer
+latents: exactly the params the reference tags with ``.org`` (kernel *and*
+bias of BinarizeLinear/BinarizeConv2d — both get ``.org`` in
+models/binarized_modules.py:77-84) and therefore clamps to [-1, 1] after
+each optimizer step (mnist-dist2.py:135-137).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+from flax import linen as nn
+
+from .bnn_cnn import BinarizedCNN
+from .cnn import DeepCNN
+from .convnet import ConvNet
+from .mlp import bnn_mlp_large, bnn_mlp_small
+
+MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
+    # flagship BNN MLPs (mnist-dist2.py:46-76 / mnist-dist3.py:40-70)
+    "bnn-mlp-large": bnn_mlp_large,
+    "bnn-mlp-small": bnn_mlp_small,
+    # fp32 baselines (mnist-dist.py:31-51, mnist-cnn server.py:7-52)
+    "convnet": ConvNet,
+    "deep-cnn": DeepCNN,
+    # binarized CNN (BASELINE.json config; uses BinarizeConv2d capability)
+    "bnn-cnn": BinarizedCNN,
+}
+
+
+def get_model(name: str, **kwargs: Any) -> nn.Module:
+    try:
+        return MODEL_REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
+        ) from None
+
+
+def latent_clamp_mask(params: Any) -> Any:
+    """Bool pytree: True for every leaf living under a Binarized* module.
+
+    Works on a flax params dict; matching is by module path component
+    prefix ("BinarizedDense_0", "BinarizedConv_1", ...), so both kernel and
+    bias of binarized layers are selected — the same set the reference
+    restores/clamps via the ``.org`` protocol (mnist-dist2.py:131-137).
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def is_latent(path) -> bool:
+        return any(
+            getattr(p, "key", "").startswith("Binarized")
+            for p in path
+            if hasattr(p, "key")
+        )
+
+    mask_flat = [is_latent(path) for path, _ in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, mask_flat)

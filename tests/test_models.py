@@ -1,0 +1,125 @@
+"""Model zoo tests: shapes, param structure, clamp-mask coverage, and
+forward determinism (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.models import (
+    BinarizedCNN,
+    BnnMLP,
+    ConvNet,
+    DeepCNN,
+    bnn_mlp_large,
+    bnn_mlp_small,
+    get_model,
+    latent_clamp_mask,
+)
+
+
+def _init_and_run(model, x, train=False):
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x,
+        train=train,
+    )
+    out = model.apply(
+        variables,
+        x,
+        train=train,
+        rngs={"dropout": jax.random.PRNGKey(2)} if train else None,
+        mutable=["batch_stats"] if train else False,
+    )
+    return variables, out
+
+
+def test_bnn_mlp_large_widths():
+    model = bnn_mlp_large()
+    assert model.hidden == (3072, 1536, 768)
+    x = jnp.zeros((4, 784))
+    variables, out = _init_and_run(model, x)
+    assert out.shape == (4, 10)
+    p = variables["params"]
+    assert p["BinarizedDense_0"]["kernel"].shape == (784, 3072)
+    assert p["BinarizedDense_1"]["kernel"].shape == (3072, 1536)
+    assert p["BinarizedDense_2"]["kernel"].shape == (1536, 768)
+    assert p["Dense_0"]["kernel"].shape == (768, 10)
+
+
+def test_bnn_mlp_small_widths():
+    model = bnn_mlp_small()
+    assert model.hidden == (192, 192, 192)
+    _, out = _init_and_run(model, jnp.zeros((2, 784)))
+    assert out.shape == (2, 10)
+
+
+def test_bnn_mlp_output_is_log_probs():
+    _, out = _init_and_run(
+        bnn_mlp_small(), jax.random.normal(jax.random.PRNGKey(3), (2, 784))
+    )
+    sums = np.exp(np.asarray(out)).sum(axis=-1)
+    np.testing.assert_allclose(sums, 1.0, rtol=1e-4)
+
+
+def test_convnet_shapes():
+    _, out = _init_and_run(ConvNet(), jnp.zeros((3, 28, 28, 1)))
+    assert out.shape == (3, 10)
+
+
+def test_deep_cnn_shapes_and_pool_padding():
+    model = DeepCNN()
+    variables, out = _init_and_run(model, jnp.zeros((2, 28, 28, 1)))
+    assert out.shape == (2, 10)
+    # fc1 must see 4*4*128 = 2048 features (28->14->7->4 with padded pool),
+    # matching the reference's Linear(2048, 625) (mnist-cnn server.py:40).
+    assert variables["params"]["Dense_0"]["kernel"].shape == (2048, 625)
+
+
+def test_binarized_cnn_shapes():
+    _, out = _init_and_run(BinarizedCNN(), jnp.zeros((2, 28, 28, 1)))
+    assert out.shape == (2, 10)
+
+
+def test_clamp_mask_selects_binarized_layers_only():
+    model = bnn_mlp_small()
+    variables, _ = _init_and_run(model, jnp.zeros((1, 784)))
+    mask = latent_clamp_mask(variables["params"])
+    flat = dict(
+        jax.tree_util.tree_flatten_with_path(mask)[0].__iter__()
+        if False
+        else [
+            ("/".join(str(getattr(p, "key", p)) for p in path), leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(mask)[0]
+        ]
+    )
+    assert flat["BinarizedDense_0/kernel"] is True
+    assert flat["BinarizedDense_0/bias"] is True
+    assert flat["Dense_0/kernel"] is False
+    assert all(not v for k, v in flat.items() if k.startswith("BatchNorm"))
+
+
+def test_registry():
+    model = get_model("bnn-mlp-large")
+    assert isinstance(model, BnnMLP)
+    with pytest.raises(ValueError):
+        get_model("nope")
+
+
+def test_train_mode_dropout_varies():
+    model = bnn_mlp_large()
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 784))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x,
+        train=True,
+    )
+    out1, _ = model.apply(
+        variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(5)},
+        mutable=["batch_stats"],
+    )
+    out2, _ = model.apply(
+        variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(6)},
+        mutable=["batch_stats"],
+    )
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
