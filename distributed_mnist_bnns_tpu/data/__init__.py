@@ -1,0 +1,19 @@
+from .mnist import (
+    MnistData,
+    load_idx,
+    load_mnist,
+    shard_indices,
+    batch_iterator,
+    MNIST_MEAN,
+    MNIST_STD,
+)
+
+__all__ = [
+    "MnistData",
+    "load_idx",
+    "load_mnist",
+    "shard_indices",
+    "batch_iterator",
+    "MNIST_MEAN",
+    "MNIST_STD",
+]

@@ -1,0 +1,13 @@
+from .optim import OPTIMIZER_REGISTRY, make_optimizer, RegimeSchedule
+from .trainer import TrainConfig, Trainer, TrainState, make_train_step, make_eval_step
+
+__all__ = [
+    "OPTIMIZER_REGISTRY",
+    "make_optimizer",
+    "RegimeSchedule",
+    "TrainConfig",
+    "Trainer",
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+]

@@ -1,0 +1,326 @@
+"""Functional trainer: the TPU-native equivalent of the reference's
+per-script ``train(gpu, args)`` loops (flagship: mnist-dist2.py:79-155).
+
+The reference's BNN "STE dance" (mnist-dist2.py:131-137):
+    p.data <- p.org; optimizer.step(); p.org <- clamp(p.data, -1, 1)
+becomes, functionally (SURVEY.md §3.2):
+    grads w.r.t. fp32 latent params (custom_vjp STE inside the model)
+    -> optax update on the latent params
+    -> clamp(-1, 1) projection on binarized-layer latents.
+The numerics-equivalence of the two formulations is covered by
+tests/test_train.py::test_ste_dance_matches_torch_semantics.
+
+Other reference behaviors carried over:
+  * CE loss on the (log-softmax) outputs (mnist-dist2.py:90,124);
+  * LR decay x0.1 every ``lr_decay_epochs`` — applied per *epoch* (the
+    reference applies it inside the batch loop, a documented bug,
+    mnist-dist2.py:126-127 / SURVEY §2.8);
+  * per-batch/per-epoch wall-time accounting via AverageMeter with CSV
+    dumps (mnist-dist2.py:112-115,139-155);
+  * rank-0-only logging at ``log_interval``.
+
+TPU-first: one jitted train_step (static shapes, drop_last batching), bf16
+GEMMs on the MXU by default, optional donation of the state to keep HBM
+traffic minimal; device sync only at log boundaries (block_until_ready),
+not per step.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import core, struct
+
+from ..data import batch_iterator
+from ..models import get_model, latent_clamp_mask
+from ..ops.losses import cross_entropy_loss
+from ..utils.meters import AverageMeter
+from ..utils.results import ResultsLog
+from .optim import RegimeSchedule, make_optimizer
+
+log = logging.getLogger(__name__)
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: core.FrozenDict
+    batch_stats: core.FrozenDict
+    opt_state: optax.OptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+def clamp_latent(params: Any, mask: Any) -> Any:
+    """The projection half of the STE dance: clamp binarized-layer latent
+    params to [-1, 1] (mnist-dist2.py:135-137)."""
+    return jax.tree.map(
+        lambda p, m: jnp.clip(p, -1.0, 1.0) if m else p, params, mask
+    )
+
+
+def make_train_step(
+    clamp_mask: Any,
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted train step: fwd -> loss -> bwd -> optax -> clamp."""
+
+    def train_step(
+        state: TrainState,
+        images: jnp.ndarray,
+        labels: jnp.ndarray,
+        rng: jax.Array,
+    ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def compute_loss(params):
+            outs, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                rngs={"dropout": dropout_rng},
+                mutable=["batch_stats"],
+            )
+            return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
+
+        (loss, (outs, new_bs)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_params = clamp_latent(new_params, clamp_mask)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=core.freeze(new_bs) if new_bs else state.batch_stats,
+            opt_state=new_opt_state,
+        )
+        acc = (jnp.argmax(outs, -1) == labels).mean() * 100.0
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
+    """Jitted eval step returning summed loss and top-1/top-5 correct counts
+    (so results can be exactly aggregated across batches/hosts)."""
+
+    def eval_step(
+        state: TrainState, images: jnp.ndarray, labels: jnp.ndarray
+    ) -> Dict[str, jnp.ndarray]:
+        outs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        n = labels.shape[0]
+        top5 = jnp.argsort(outs, axis=-1)[:, ::-1][:, :5]
+        correct1 = (top5[:, 0] == labels).sum()
+        correct5 = (top5 == labels[:, None]).any(-1).sum()
+        return {
+            "loss_sum": loss_fn(outs, labels) * n,
+            "correct1": correct1,
+            "correct5": correct5,
+            "count": jnp.asarray(n),
+        }
+
+    return jax.jit(eval_step)
+
+
+@dataclass
+class TrainConfig:
+    """One config covering what the reference scatters across argparse flags
+    and hardcoded constants (SURVEY §5 'Config / flag system')."""
+
+    model: str = "bnn-mlp-large"
+    model_kwargs: Dict[str, Any] = field(default_factory=dict)
+    epochs: int = 5
+    batch_size: int = 64
+    optimizer: str = "adam"
+    learning_rate: float = 0.01
+    lr_decay_epochs: int = 40      # x0.1 every N epochs (mnist-dist2.py:126-127)
+    lr_decay_factor: float = 0.1
+    regime: Optional[Dict[int, Dict[str, Any]]] = None
+    seed: int = 42
+    log_interval: int = 100
+    loss: str = "ce"
+    backend: Optional[str] = None  # GEMM backend override for binarized layers
+    results_path: Optional[str] = None
+    timing_csv_prefix: Optional[str] = None  # write per-batch/epoch CSVs
+
+
+class Trainer:
+    """Single-host trainer; the distributed variants wrap the same step
+    functions with meshes/shardings (parallel/)."""
+
+    def __init__(self, config: TrainConfig, input_shape=(28, 28, 1)):
+        self.config = config
+        mk = dict(config.model_kwargs)
+        if config.backend is not None:
+            mk.setdefault("backend", config.backend)
+        self.model = get_model(config.model, **mk)
+        self.rng = jax.random.PRNGKey(config.seed)
+        self.regime = RegimeSchedule(config.regime)
+
+        init_rng, self.data_rng = jax.random.split(self.rng)
+        dummy = jnp.zeros((1, *input_shape), jnp.float32)
+        variables = self.model.init(
+            {"params": init_rng, "dropout": jax.random.PRNGKey(0)},
+            dummy,
+            train=True,
+        )
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", core.freeze({}))
+        self.clamp_mask = latent_clamp_mask(params)
+        tx = make_optimizer(config.optimizer, config.learning_rate)
+        self.state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            apply_fn=self.model.apply,
+            tx=tx,
+        )
+        self.train_step = make_train_step(self.clamp_mask)
+        self.eval_step = make_eval_step()
+        self.results = ResultsLog(config.results_path or "results.csv")
+        self.batch_meter = AverageMeter()
+
+    # -- epoch-level hyperparameter control ---------------------------------
+
+    def _lr_for_epoch(self, epoch: int) -> float:
+        base = self.regime.config_at(epoch).get(
+            "learning_rate", self.config.learning_rate
+        )
+        decays = epoch // max(self.config.lr_decay_epochs, 1)
+        return base * (self.config.lr_decay_factor**decays)
+
+    def _apply_epoch_regime(self, epoch: int) -> None:
+        cfg = self.regime.config_at(epoch)
+        if self.regime.optimizer_changed(epoch):
+            # Optimizer class switch: rebuild transform, fresh moments
+            # (adjust_optimizer reconstructs the torch class the same way,
+            # utils.py:120-126).
+            tx = make_optimizer(
+                cfg["optimizer"], cfg.get("learning_rate", self.config.learning_rate)
+            )
+            self.state = self.state.replace(
+                tx=tx, opt_state=tx.init(self.state.params)
+            )
+            self.train_step = make_train_step(self.clamp_mask)
+        hp = getattr(self.state.opt_state, "hyperparams", None)
+        if hp is not None and "learning_rate" in hp:
+            hp["learning_rate"] = jnp.asarray(
+                self._lr_for_epoch(epoch), jnp.float32
+            )
+
+    # -- loops --------------------------------------------------------------
+
+    def train_epoch(self, data, epoch: int) -> Dict[str, float]:
+        cfg = self.config
+        self._apply_epoch_regime(epoch)
+        losses, accs = AverageMeter(), AverageMeter()
+        self.batch_meter.reset()
+        batch_times = []
+        it = batch_iterator(
+            data.train_images,
+            data.train_labels,
+            cfg.batch_size,
+            epoch=epoch,
+            seed=cfg.seed,
+            host_id=jax.process_index(),
+            num_hosts=jax.process_count(),
+        )
+        epoch_start = time.perf_counter()
+        for i, (images, labels) in enumerate(it):
+            t0 = time.perf_counter()
+            self.state, metrics = self.train_step(
+                self.state, jnp.asarray(images), jnp.asarray(labels), self.rng
+            )
+            if i == 0 or (i + 1) % cfg.log_interval == 0:
+                # sync only at log boundaries to keep the device pipeline full
+                metrics = jax.tree.map(lambda x: float(x), metrics)
+                losses.update(metrics["loss"], len(labels))
+                accs.update(metrics["accuracy"], len(labels))
+                if jax.process_index() == 0:
+                    log.info(
+                        "epoch %d step %d loss %.4f acc %.2f%% (%.2f ms/batch)",
+                        epoch, i + 1, metrics["loss"], metrics["accuracy"],
+                        self.batch_meter.avg * 1e3,
+                    )
+            dt = time.perf_counter() - t0
+            self.batch_meter.update(dt)
+            batch_times.append(dt)
+        jax.block_until_ready(self.state.params)
+        epoch_time = time.perf_counter() - epoch_start
+        if cfg.timing_csv_prefix and jax.process_index() == 0:
+            self._dump_timing_csvs(epoch, batch_times, epoch_time)
+        return {
+            "train_loss": losses.avg,
+            "train_acc": accs.avg,
+            "epoch_time_s": epoch_time,
+            "batch_time_s": self.batch_meter.avg,
+        }
+
+    def evaluate(self, data, batch_size: Optional[int] = None) -> Dict[str, float]:
+        bs = batch_size or self.config.batch_size
+        totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
+        for images, labels in batch_iterator(
+            data.test_images, data.test_labels, bs,
+            shuffle=False, drop_last=False,
+        ):
+            out = self.eval_step(
+                self.state, jnp.asarray(images), jnp.asarray(labels)
+            )
+            for k in totals:
+                totals[k] += float(out[k])
+        n = max(totals["count"], 1.0)
+        return {
+            "test_loss": totals["loss_sum"] / n,
+            "test_acc": totals["correct1"] / n * 100.0,
+            "test_acc_top5": totals["correct5"] / n * 100.0,
+        }
+
+    def fit(self, data, eval_every: int = 1) -> list[Dict[str, float]]:
+        history = []
+        for epoch in range(self.config.epochs):
+            row: Dict[str, float] = {"epoch": epoch}
+            row.update(self.train_epoch(data, epoch))
+            if eval_every and (epoch + 1) % eval_every == 0:
+                row.update(self.evaluate(data))
+            history.append(row)
+            if jax.process_index() == 0:
+                log.info(
+                    "epoch %d done: %s", epoch,
+                    {k: round(v, 4) for k, v in row.items() if k != "epoch"},
+                )
+                self.results.add(**row)
+                if self.config.results_path:
+                    self.results.save()
+        return history
+
+    def _dump_timing_csvs(self, epoch, batch_times, epoch_time) -> None:
+        """Per-batch and per-epoch wall-time CSVs — the two benchmark
+        artifacts the flagship reference run produced (mnist-dist2.py:152-155),
+        with explicit headers instead of raw pandas dumps."""
+        prefix = self.config.timing_csv_prefix
+        mode = "w" if epoch == 0 else "a"
+        with open(f"{prefix}_batch_time.csv", mode) as f:
+            if epoch == 0:
+                f.write("epoch,batch,seconds\n")
+            for i, t in enumerate(batch_times):
+                f.write(f"{epoch},{i},{t:.6f}\n")
+        with open(f"{prefix}_epoch_time.csv", mode) as f:
+            if epoch == 0:
+                f.write("epoch,seconds\n")
+            f.write(f"{epoch},{epoch_time:.6f}\n")

@@ -1,0 +1,12 @@
+from .logging_utils import setup_logging, is_primary_host
+from .meters import AverageMeter
+from .results import ResultsLog
+from .metrics import accuracy
+
+__all__ = [
+    "setup_logging",
+    "is_primary_host",
+    "AverageMeter",
+    "ResultsLog",
+    "accuracy",
+]

@@ -1,0 +1,53 @@
+"""Logging setup — parity with the reference's ``setup_logging``
+(utils.py:16-28): DEBUG to a fresh file, INFO to console; plus the
+rank-0-only emission pattern used by every reference training loop
+(mnist-dist2.py:141-149), expressed as process_index()==0 in JAX.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def is_primary_host() -> bool:
+    """True on the JAX process that should own logging/checkpoint writes.
+
+    Falls back to True when JAX isn't initialized (pure-host tooling)."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def setup_logging(
+    log_file: str = "log.txt", *, level: int = logging.DEBUG,
+    console_level: int = logging.INFO, primary_only: bool = True,
+) -> logging.Logger:
+    """Root logger: DEBUG -> file (truncate), INFO -> console.
+
+    With primary_only (default), non-primary hosts get a WARNING-level
+    console logger and no file handler, so multi-host runs produce one
+    coherent log stream (the reference achieves this with `if rank == 0`
+    guards around every print)."""
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.setLevel(level)
+    fmt = logging.Formatter(
+        "%(asctime)s - %(levelname)s - %(message)s", "%Y-%m-%d %H:%M:%S"
+    )
+    primary = is_primary_host() or not primary_only
+    console = logging.StreamHandler()
+    console.setLevel(console_level if primary else logging.WARNING)
+    console.setFormatter(fmt)
+    root.addHandler(console)
+    if primary and log_file:
+        os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+        fh = logging.FileHandler(log_file, mode="w")
+        fh.setLevel(level)
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+    return root

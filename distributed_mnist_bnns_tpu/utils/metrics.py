@@ -1,0 +1,24 @@
+"""Classification metrics — parity with the reference's ``accuracy``
+(utils.py:142-155): precision@k for a tuple of k values, as percentages."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+def accuracy(
+    output: jnp.ndarray, target: jnp.ndarray, topk: Sequence[int] = (1,)
+) -> list[jnp.ndarray]:
+    """precision@k over a batch of logits/log-probs.
+
+    Returns a list of scalars in [0, 100], one per k (the reference's
+    percentage convention)."""
+    maxk = max(topk)
+    topk_idx = jnp.argsort(output, axis=-1)[:, ::-1][:, :maxk]
+    correct = topk_idx == target[:, None]
+    res = []
+    for k in topk:
+        res.append(correct[:, :k].any(axis=-1).mean() * 100.0)
+    return res

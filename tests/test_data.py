@@ -1,0 +1,68 @@
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.data import (
+    batch_iterator,
+    load_mnist,
+    shard_indices,
+)
+
+
+def test_load_mnist_any_source():
+    data = load_mnist()
+    assert data.train_images.ndim == 4
+    assert data.train_images.shape[1:] == (28, 28, 1)
+    assert data.train_labels.dtype == np.int32
+    assert data.source in ("mnist", "t10k-split", "synthetic")
+    assert set(np.unique(data.test_labels)) <= set(range(10))
+
+
+def test_load_mnist_synthetic_explicit():
+    data = load_mnist("/nonexistent", synthetic_ok=True,
+                      synthetic_sizes=(512, 128))
+    assert data.source == "synthetic"
+    assert len(data.train_labels) == 512
+    assert len(data.test_labels) == 128
+
+
+def test_load_mnist_raises_without_fallback():
+    with pytest.raises(FileNotFoundError):
+        load_mnist("/nonexistent", synthetic_ok=False)
+
+
+def test_shard_indices_partition_and_determinism():
+    n, hosts = 103, 4
+    shards = [
+        shard_indices(n, epoch=2, seed=7, host_id=h, num_hosts=hosts)
+        for h in range(hosts)
+    ]
+    sizes = {len(s) for s in shards}
+    assert sizes == {26}  # padded to 104, equal shares
+    union = np.concatenate(shards)
+    assert set(union) == set(range(n))  # covers all, only wraparound dups
+    again = shard_indices(n, epoch=2, seed=7, host_id=1, num_hosts=hosts)
+    np.testing.assert_array_equal(shards[1], again)
+    other_epoch = shard_indices(n, epoch=3, seed=7, host_id=1, num_hosts=hosts)
+    assert not np.array_equal(shards[1], other_epoch)
+
+
+def test_batch_iterator_static_shapes():
+    imgs = np.zeros((100, 28, 28, 1), np.float32)
+    labels = np.arange(100, dtype=np.int32) % 10
+    batches = list(batch_iterator(imgs, labels, 32, epoch=0, seed=0))
+    assert len(batches) == 3  # drop_last
+    assert all(b[0].shape == (32, 28, 28, 1) for b in batches)
+
+
+def test_batch_iterator_hosts_disjoint():
+    imgs = np.zeros((64, 28, 28, 1), np.float32)
+    labels = np.arange(64, dtype=np.int32)
+    seen = []
+    for h in range(2):
+        for _, y in batch_iterator(
+            imgs, labels, 8, epoch=1, seed=3, host_id=h, num_hosts=2
+        ):
+            seen.append(y)
+    all_labels = np.concatenate(seen)
+    assert len(all_labels) == 64
+    assert set(all_labels) == set(range(64))

@@ -1,0 +1,161 @@
+"""Trainer tests: STE-dance equivalence vs a torch oracle (SURVEY.md §7
+"hard parts"), clamp projection, regime scheduling, and end-to-end
+convergence on MNIST (integration test per SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_mnist_bnns_tpu.data import load_mnist
+from distributed_mnist_bnns_tpu.train import (
+    RegimeSchedule,
+    TrainConfig,
+    Trainer,
+    make_optimizer,
+)
+from distributed_mnist_bnns_tpu.train.trainer import clamp_latent
+
+
+def test_clamp_latent_respects_mask():
+    params = {"a": {"kernel": jnp.array([-3.0, 0.5, 2.0])},
+              "b": {"kernel": jnp.array([-3.0, 0.5, 2.0])}}
+    mask = {"a": {"kernel": True}, "b": {"kernel": False}}
+    out = clamp_latent(params, mask)
+    np.testing.assert_array_equal(np.asarray(out["a"]["kernel"]), [-1.0, 0.5, 1.0])
+    np.testing.assert_array_equal(np.asarray(out["b"]["kernel"]), [-3.0, 0.5, 2.0])
+
+
+def test_regime_sticky_merge():
+    sched = RegimeSchedule({0: {"optimizer": "adam", "learning_rate": 0.01},
+                            10: {"learning_rate": 0.001},
+                            20: {"optimizer": "sgd"}})
+    assert sched.config_at(5) == {"optimizer": "adam", "learning_rate": 0.01}
+    assert sched.config_at(15)["learning_rate"] == 0.001
+    assert sched.config_at(25)["optimizer"] == "sgd"
+    assert not sched.optimizer_changed(15)
+    assert sched.optimizer_changed(20)
+
+
+def test_make_optimizer_registry_and_hyperparams():
+    tx = make_optimizer("adam", 0.01)
+    params = {"w": jnp.ones(3)}
+    state = tx.init(params)
+    assert float(state.hyperparams["learning_rate"]) == pytest.approx(0.01)
+    with pytest.raises(ValueError):
+        make_optimizer("nope", 0.1)
+
+
+def test_asgd_keeps_polyak_average():
+    tx = make_optimizer("asgd", 0.5)
+    params = {"w": jnp.zeros(2)}
+    state = tx.init(params)
+    grads = {"w": jnp.ones(2)}
+    for _ in range(3):
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    inner = state.inner_state
+    # params walked 3 steps of -0.5; average over the 3 visited points
+    np.testing.assert_allclose(np.asarray(params["w"]), -1.5)
+    np.testing.assert_allclose(np.asarray(inner.avg["w"]), -1.0, rtol=1e-6)
+
+
+def test_ste_dance_matches_torch_semantics():
+    """Our (custom_vjp STE + optax sgd + clamp) must reproduce the
+    reference's restore/step/clamp data-swap loop (mnist-dist2.py:131-137)
+    step for step, for a BinarizeLinear layer trained with plain SGD."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    from distributed_mnist_bnns_tpu.models import BinarizedDense
+    from distributed_mnist_bnns_tpu.ops.losses import cross_entropy_loss
+
+    rng = np.random.RandomState(0)
+    in_dim, out_dim, bs, steps, lr = 16, 6, 8, 6, 0.2
+    w0 = rng.uniform(-0.9, 0.9, size=(in_dim, out_dim)).astype(np.float32)
+    b0 = rng.uniform(-0.2, 0.2, size=(out_dim,)).astype(np.float32)
+    xs = rng.randn(steps, bs, in_dim).astype(np.float32)
+    ys = rng.randint(0, out_dim, size=(steps, bs))
+
+    # --- torch oracle implementing the reference's training semantics ---
+    w_t = torch.nn.Parameter(torch.tensor(w0.T.copy()))  # torch is (out, in)
+    b_t = torch.nn.Parameter(torch.tensor(b0.copy()))
+    w_org = w_t.data.clone()
+    opt = torch.optim.SGD([w_t, b_t], lr=lr)
+    sign = lambda t: torch.where(t >= 0, torch.ones_like(t), -torch.ones_like(t))
+    for s in range(steps):
+        x = torch.tensor(xs[s])
+        w_t.data = sign(w_org)                      # binarize from master
+        out = F.linear(sign(x), w_t) + b_t
+        loss = F.cross_entropy(out, torch.tensor(ys[s]))
+        opt.zero_grad()
+        loss.backward()
+        w_t.data.copy_(w_org)                       # restore fp32 master
+        opt.step()                                  # step on fp32
+        w_org = w_t.data.clamp(-1, 1).clone()       # clamp projection
+        b_t.data.clamp_(-1, 1)
+
+    # --- our functional path ---
+    model = BinarizedDense(out_dim, binarize_input=True, backend="xla")
+    params = {"kernel": jnp.asarray(w0), "bias": jnp.asarray(b0)}
+    tx = optax.sgd(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            out = model.apply({"params": p}, x)
+            return cross_entropy_loss(out, y)
+
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = jax.tree.map(lambda p: jnp.clip(p, -1, 1), params)
+        return params, opt_state
+
+    for s in range(steps):
+        params, opt_state = step(
+            params, opt_state, jnp.asarray(xs[s]), jnp.asarray(ys[s])
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(params["kernel"]).T, w_org.numpy(), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["bias"]), b_t.detach().numpy(), atol=2e-5
+    )
+
+
+def test_trainer_end_to_end_convergence():
+    """Minimum end-to-end slice (SURVEY §7.3): BNN MLP small learns MNIST
+    (real t10k split if available, synthetic otherwise)."""
+    data = load_mnist(synthetic_sizes=(4096, 512))
+    config = TrainConfig(
+        model="bnn-mlp-small",
+        epochs=1,
+        batch_size=64,
+        learning_rate=0.01,
+        log_interval=50,
+        backend="xla",
+        seed=0,
+    )
+    trainer = Trainer(config)
+    first_metrics = trainer.evaluate(data)
+    history = trainer.fit(data)
+    final = history[-1]
+    assert final["test_acc"] > 55.0, (data.source, final)
+    assert final["test_acc"] > first_metrics["test_acc"] + 20.0
+    assert final["train_loss"] < 2.0
+
+
+def test_trainer_lr_decay_per_epoch():
+    config = TrainConfig(
+        model="bnn-mlp-small", epochs=1, learning_rate=0.01,
+        lr_decay_epochs=2, backend="xla",
+    )
+    trainer = Trainer(config)
+    assert trainer._lr_for_epoch(0) == pytest.approx(0.01)
+    assert trainer._lr_for_epoch(1) == pytest.approx(0.01)
+    assert trainer._lr_for_epoch(2) == pytest.approx(0.001)
+    assert trainer._lr_for_epoch(4) == pytest.approx(0.0001)
