@@ -1,0 +1,20 @@
+from .mesh import make_mesh
+from .distributed import initialize_multihost
+from .data_parallel import (
+    make_dp_train_step,
+    make_shardmap_dp_train_step,
+    shard_batch,
+    replicate,
+)
+from .model_parallel import bnn_mlp_tp_rules, make_tp_train_step
+
+__all__ = [
+    "make_mesh",
+    "initialize_multihost",
+    "make_dp_train_step",
+    "make_shardmap_dp_train_step",
+    "shard_batch",
+    "replicate",
+    "bnn_mlp_tp_rules",
+    "make_tp_train_step",
+]

@@ -1,0 +1,52 @@
+"""Multi-host initialization — the TPU-native replacement for the
+reference's env:// rendezvous + process-group setup
+(MASTER_ADDR/MASTER_PORT + dist.init_process_group('gloo'|'nccl'),
+mnist-dist2.py:41-43,83).
+
+One JAX process per host; devices are auto-discovered after
+jax.distributed.initialize connects every process to the coordinator.
+All collectives thereafter are XLA collectives compiled onto ICI/DCN —
+there is no hand-rolled transport (the reference's raw-TCP checkpoint
+shipping, mnist change master.py:117-124, is subsumed by the checkpoint
+component writing to shared storage; utils/checkpoint.py)."""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Connect this process to a multi-host JAX cluster.
+
+    Mirrors the reference CLI contract (-n nodes, -nr node_rank with a
+    master address) but via jax.distributed: pass
+    coordinator_address="host:port", num_processes=n_hosts,
+    process_id=this_host_rank. With no arguments, auto-detects from the
+    cluster environment (TPU pod metadata / SLURM) or stays single-process.
+
+    Returns a summary dict {process_id, num_processes, local_devices,
+    global_devices} for logging.
+    """
+    if coordinator_address is not None or num_processes not in (None, 1):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    info = {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+    if jax.process_index() == 0:
+        log.info("distributed runtime: %s", info)
+    return info

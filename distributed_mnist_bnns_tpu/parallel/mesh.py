@@ -1,0 +1,40 @@
+"""Device-mesh construction.
+
+The reference's "mesh" is rank arithmetic: world_size = gpus*nodes,
+rank = nr*gpus + gpu (mnist-dist2.py:40,82). TPU-native, the same role is
+played by a jax.sharding.Mesh whose axes name the parallelism dimensions;
+collectives then ride ICI within a slice and DCN across slices, placed by
+XLA from sharding annotations rather than hand-written NCCL/Gloo calls
+(SURVEY §2.3)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    data: int | None = None,
+    model: int = 1,
+    *,
+    axis_names: Sequence[str] = ("data", "model"),
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a (data x model) mesh over the available devices.
+
+    data=None uses every remaining device for data parallelism — the
+    analogue of the reference's world_size = gpus * nodes.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devs) % model:
+            raise ValueError(f"{len(devs)} devices not divisible by model={model}")
+        data = len(devs) // model
+    need = data * model
+    if need > len(devs):
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(data, model)
+    return Mesh(grid, axis_names=tuple(axis_names))

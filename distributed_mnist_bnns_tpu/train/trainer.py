@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from flax import core, struct
+from flax import struct
 
 from ..data import batch_iterator
 from ..models import get_model, latent_clamp_mask
@@ -50,8 +50,8 @@ log = logging.getLogger(__name__)
 
 class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
-    params: core.FrozenDict
-    batch_stats: core.FrozenDict
+    params: Any
+    batch_stats: Any
     opt_state: optax.OptState
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
@@ -102,7 +102,7 @@ def make_train_step(
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
-            batch_stats=core.freeze(new_bs) if new_bs else state.batch_stats,
+            batch_stats=new_bs if new_bs else state.batch_stats,
             opt_state=new_opt_state,
         )
         acc = (jnp.argmax(outs, -1) == labels).mean() * 100.0
@@ -180,7 +180,7 @@ class Trainer:
             train=True,
         )
         params = variables["params"]
-        batch_stats = variables.get("batch_stats", core.freeze({}))
+        batch_stats = variables.get("batch_stats", {})
         self.clamp_mask = latent_clamp_mask(params)
         tx = make_optimizer(config.optimizer, config.learning_rate)
         self.state = TrainState(

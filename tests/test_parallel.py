@@ -1,0 +1,182 @@
+"""Distributed-runtime tests on the 8-device virtual CPU mesh (the
+fake-backend replacement, SURVEY.md §4): GSPMD DP, explicit shard_map+psum
+DP, tensor-parallel sharding, and DP-vs-single-device equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from distributed_mnist_bnns_tpu.models import (
+    BinarizedDense,
+    bnn_mlp_small,
+    bnn_mlp_large,
+    latent_clamp_mask,
+)
+from distributed_mnist_bnns_tpu.parallel import (
+    bnn_mlp_tp_rules,
+    make_dp_train_step,
+    make_mesh,
+    make_shardmap_dp_train_step,
+    make_tp_train_step,
+    replicate,
+    shard_batch,
+)
+from distributed_mnist_bnns_tpu.train import make_train_step
+from distributed_mnist_bnns_tpu.train.trainer import TrainState
+
+
+class TinyBNN(nn.Module):
+    """BN/dropout-free BNN so DP must match single-device bit-for-bit."""
+
+    @nn.compact
+    def __call__(self, x, *, train=False):
+        x = BinarizedDense(64, binarize_input=False, backend="xla")(x)
+        x = nn.hard_tanh(x)
+        x = BinarizedDense(10, backend="xla")(x)
+        return nn.log_softmax(x)
+
+
+def _make_state(model, x, lr=0.05, seed=0):
+    variables = model.init(
+        {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(1)},
+        x,
+        train=True,
+    )
+    params = variables["params"]
+    tx = optax.sgd(lr)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    ), latent_clamp_mask(params)
+
+
+def _batch(key, n=64, d=784):
+    x = jax.random.normal(key, (n, d))
+    y = jax.random.randint(jax.random.PRNGKey(99), (n,), 0, 10)
+    return x, y
+
+
+def test_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.devices.shape == (8, 1)
+    mesh2 = make_mesh(model=2)
+    assert mesh2.devices.shape == (4, 2)
+    with pytest.raises(ValueError):
+        make_mesh(data=16, model=1)
+
+
+def test_gspmd_dp_matches_single_device():
+    model = TinyBNN()
+    x, y = _batch(jax.random.PRNGKey(0))
+    state_a, mask = _make_state(model, x[:1])
+    state_b, _ = _make_state(model, x[:1])
+    rng = jax.random.PRNGKey(7)
+
+    single = make_train_step(mask, donate=False)
+    new_a, met_a = single(state_a, x, y, rng)
+
+    mesh = make_mesh()
+    dp = make_dp_train_step(mask, mesh, donate=False)
+    state_b = replicate(state_b, mesh)
+    xb, yb = shard_batch(x, mesh), shard_batch(y, mesh)
+    new_b, met_b = dp(state_b, xb, yb, replicate(rng, mesh))
+
+    assert float(met_a["loss"]) == pytest.approx(float(met_b["loss"]), rel=1e-5)
+    for pa, pb in zip(
+        jax.tree.leaves(new_a.params), jax.tree.leaves(new_b.params)
+    ):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb), atol=1e-5)
+
+
+def test_shardmap_dp_psum_matches_single_device():
+    model = TinyBNN()
+    x, y = _batch(jax.random.PRNGKey(1))
+    state_a, mask = _make_state(model, x[:1])
+    state_b, _ = _make_state(model, x[:1])
+    rng = jax.random.PRNGKey(3)
+
+    single = make_train_step(mask, donate=False)
+    new_a, met_a = single(state_a, x, y, rng)
+
+    mesh = make_mesh()
+    dp = make_shardmap_dp_train_step(mask, mesh)
+    new_b, met_b = dp(replicate(state_b, mesh), shard_batch(x, mesh),
+                      shard_batch(y, mesh), replicate(rng, mesh))
+
+    # mean-of-shard-means == global mean for equal shards; grads identical
+    assert float(met_a["loss"]) == pytest.approx(float(met_b["loss"]), rel=1e-5)
+    for pa, pb in zip(
+        jax.tree.leaves(new_a.params), jax.tree.leaves(new_b.params)
+    ):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb), atol=1e-5)
+
+
+def test_gspmd_dp_full_mlp_with_bn_runs_and_learns():
+    model = bnn_mlp_small(backend="xla")
+    x, y = _batch(jax.random.PRNGKey(2))
+    state, mask = _make_state(model, x[:1], lr=0.01)
+    mesh = make_mesh()
+    dp = make_dp_train_step(mask, mesh, donate=False)
+    state = replicate(state, mesh)
+    rng = replicate(jax.random.PRNGKey(0), mesh)
+    xb, yb = shard_batch(x, mesh), shard_batch(y, mesh)
+    losses = []
+    for _ in range(10):
+        state, met = dp(state, xb, yb, rng)
+        losses.append(float(met["loss"]))
+    assert losses[-1] < losses[0]
+    # latent clamp invariant holds under DP
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    for path, leaf in flat:
+        if any(getattr(p, "key", "").startswith("Binarized") for p in path):
+            assert float(jnp.abs(leaf).max()) <= 1.0 + 1e-6
+
+
+def test_tp_rules_cover_all_params():
+    model = bnn_mlp_large(backend="xla")
+    x = jnp.zeros((1, 784))
+    state, _ = _make_state(model, x)
+    specs = bnn_mlp_tp_rules(state.params)
+    flat_p = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    flat_s = jax.tree.leaves(specs, is_leaf=lambda s: isinstance(s, P))
+    assert len(flat_p) == len(flat_s)
+    by_path = {
+        "/".join(str(getattr(q, "key", q)) for q in path): spec
+        for (path, _), spec in zip(flat_p, flat_s)
+    }
+    assert by_path["BinarizedDense_0/kernel"] == P(None, "model")
+    assert by_path["BinarizedDense_1/kernel"] == P("model", None)
+    assert by_path["Dense_0/kernel"] == P("model", None)
+
+
+def test_tp_dp_train_step_runs():
+    """Combined dp x mp over a 4x2 mesh: the declarative version of the
+    reference's DDP + 2-device layer-split demo
+    (mnist-distributed-BNNS2.py:193-213)."""
+    model = bnn_mlp_small(backend="xla")
+    x, y = _batch(jax.random.PRNGKey(5), n=32)
+    state, mask = _make_state(model, x[:1], lr=0.01)
+    mesh = make_mesh(model=2)
+    specs = bnn_mlp_tp_rules(state.params)
+    base = make_train_step(mask, donate=False)
+    # unwrap: base is jitted; reuse its python fn via make_train_step's closure
+    from distributed_mnist_bnns_tpu.train.trainer import make_train_step as mts
+
+    step, placed = make_tp_train_step(base, mesh, state, specs)
+    with mesh:
+        xb = jax.device_put(x, jax.NamedSharding(mesh, P("data")))
+        yb = jax.device_put(y, jax.NamedSharding(mesh, P("data")))
+        rng = jax.device_put(jax.random.PRNGKey(0), jax.NamedSharding(mesh, P()))
+        new_state, met = step(placed, xb, yb, rng)
+    assert np.isfinite(float(met["loss"]))
+    # params actually sharded over the model axis
+    k0 = new_state.params["BinarizedDense_0"]["kernel"]
+    assert k0.sharding.spec == P(None, "model")
