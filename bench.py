@@ -1,0 +1,89 @@
+"""Benchmark harness — trains the flagship BNN MLP (the reference's
+mnist-dist2.py configuration: 784->3072->1536->768->10, Adam) and reports
+steady-state training throughput in images/sec.
+
+Baseline (BASELINE.md): the reference's committed run does ~7,270 images/s
+(60,000 images / 8.25 s per epoch, batch 64, "PersonalCom" hardware).
+``vs_baseline`` is our images/s divided by that number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Flags let the driver/judge vary the setup (--batch-size, --backend,
+--steps); defaults are chosen for a single TPU chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--backend", default="bf16",
+                   choices=["xla", "bf16", "xnor", "pallas_xnor"])
+    p.add_argument("--model", default="bnn-mlp-large")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model=args.model,
+        batch_size=args.batch_size,
+        optimizer="adam",
+        learning_rate=0.01,
+        backend=args.backend,
+        seed=0,
+    )
+    trainer = Trainer(config)
+
+    key = jax.random.PRNGKey(0)
+    images = jax.random.normal(key, (args.batch_size, 28, 28, 1), jnp.float32)
+    labels = jax.random.randint(key, (args.batch_size,), 0, 10)
+    images = jax.device_put(images)
+    labels = jax.device_put(labels)
+
+    # compile + warmup
+    for _ in range(args.warmup):
+        trainer.state, metrics = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+    jax.block_until_ready(trainer.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        trainer.state, metrics = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+    jax.block_until_ready(trainer.state.params)
+    dt = time.perf_counter() - t0
+
+    step_time = dt / args.steps
+    ips = args.batch_size / step_time
+    baseline_ips = 7270.0  # BASELINE.md derived throughput
+    result = {
+        "metric": "train_throughput_mnist_bnn_mlp_large",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / baseline_ips, 2),
+        "batch_size": args.batch_size,
+        "step_time_ms": round(step_time * 1e3, 3),
+        "epoch_time_equiv_s": round(60000.0 / ips, 3),
+        "backend": args.backend,
+        "device": str(jax.devices()[0]),
+        "loss_finite": bool(float(metrics["loss"]) == float(metrics["loss"])),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""Command-line entry point.
+
+Covers the reference's argparse surface (identical flag set across its
+scripts: -n/--nodes, -g/--gpus, -nr, --epochs, --lr, --seed,
+--log-interval; mnist-dist2.py:23-38) plus everything the reference
+hardcodes (batch size, backend, master address, normalization), as flags.
+
+Usage examples:
+  python -m distributed_mnist_bnns_tpu.cli train --model bnn-mlp-large \
+      --epochs 5 --batch-size 64 --lr 0.01
+  python -m distributed_mnist_bnns_tpu.cli train --model convnet --dp auto
+  python -m distributed_mnist_bnns_tpu.cli eval --checkpoint-dir ckpts
+  # multi-host (one process per host; replaces env:// rendezvous):
+  python -m distributed_mnist_bnns_tpu.cli train --nodes 2 --node-rank 0 \
+      --coordinator 10.0.0.1:8888
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="distributed_mnist_bnns_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--model", default="bnn-mlp-large")
+        sp.add_argument("--infl-ratio", type=int, default=3,
+                        help="width multiplier for the BNN MLPs")
+        sp.add_argument("--epochs", type=int, default=5)
+        sp.add_argument("--batch-size", type=int, default=64)
+        sp.add_argument("--optimizer", default="adam")
+        sp.add_argument("--lr", type=float, default=0.01)
+        sp.add_argument("--seed", type=int, default=42)
+        sp.add_argument("--log-interval", type=int, default=100)
+        sp.add_argument("--backend", default=None,
+                        choices=[None, "xla", "bf16", "xnor", "pallas_xnor"])
+        sp.add_argument("--data-dir", default=None)
+        sp.add_argument("--norm", default="mnist",
+                        choices=["mnist", "half", "none"])
+        sp.add_argument("--synthetic-sizes", type=int, nargs=2,
+                        default=(60000, 10000), metavar=("TRAIN", "TEST"),
+                        help="fallback synthetic dataset sizes")
+        sp.add_argument("--checkpoint-dir", default=None)
+        sp.add_argument("--save-all", action="store_true")
+        sp.add_argument("--resume", action="store_true")
+        sp.add_argument("--results", default=None)
+        sp.add_argument("--timing-csv", default=None,
+                        help="prefix for per-batch/per-epoch timing CSVs")
+        # parallelism
+        sp.add_argument("--dp", default="1",
+                        help="'auto' = all devices, or an integer")
+        sp.add_argument("--log-file", default="log.txt")
+        # multi-host rendezvous (replaces MASTER_ADDR/MASTER_PORT env://)
+        sp.add_argument("--nodes", type=int, default=1)
+        sp.add_argument("--node-rank", type=int, default=0)
+        sp.add_argument("--coordinator", default=None,
+                        help="host:port of process 0")
+
+    t = sub.add_parser("train", help="train a model")
+    common(t)
+    e = sub.add_parser("eval", help="evaluate latest/best checkpoint")
+    common(e)
+    e.add_argument("--best", action="store_true")
+    return p
+
+
+def _make_trainer(args):
+    from .train import TrainConfig, Trainer
+
+    model_kwargs = {}
+    if args.model.startswith("bnn-mlp"):
+        model_kwargs["infl_ratio"] = args.infl_ratio
+    config = TrainConfig(
+        model=args.model,
+        model_kwargs=model_kwargs,
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        optimizer=args.optimizer,
+        learning_rate=args.lr,
+        seed=args.seed,
+        log_interval=args.log_interval,
+        backend=args.backend,
+        results_path=args.results,
+        timing_csv_prefix=args.timing_csv,
+        checkpoint_dir=args.checkpoint_dir,
+        save_all_epochs=args.save_all,
+        resume=args.resume,
+    )
+    return Trainer(config)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .utils import setup_logging
+
+    setup_logging(args.log_file)
+
+    if args.nodes > 1 or args.coordinator:
+        from .parallel import initialize_multihost
+
+        initialize_multihost(
+            coordinator_address=args.coordinator,
+            num_processes=args.nodes,
+            process_id=args.node_rank,
+        )
+
+    import jax
+
+    from .data import load_mnist
+
+    data = load_mnist(
+        args.data_dir, norm=args.norm,
+        synthetic_sizes=tuple(args.synthetic_sizes),
+    )
+    log.info("data source: %s (%d train / %d test)", data.source,
+             len(data.train_labels), len(data.test_labels))
+
+    trainer = _make_trainer(args)
+
+    if args.cmd == "train":
+        dp = len(jax.devices()) if args.dp == "auto" else int(args.dp)
+        if dp > 1:
+            from .parallel import make_dp_train_step, make_mesh, replicate
+
+            mesh = make_mesh(data=dp)
+            trainer.train_step = _dp_wrapped_step(trainer, mesh)
+            trainer.state = replicate(trainer.state, mesh)
+            log.info("data-parallel over %d devices", dp)
+        history = trainer.fit(data)
+        final = history[-1] if history else {}
+        log.info("final: %s", final)
+        return 0
+
+    if args.cmd == "eval":
+        if not args.checkpoint_dir:
+            log.error("eval requires --checkpoint-dir")
+            return 2
+        from .utils.checkpoint import load_checkpoint
+
+        trainer.state = load_checkpoint(
+            trainer.state, args.checkpoint_dir, best=args.best
+        )
+        metrics = trainer.evaluate(data)
+        log.info("eval: %s", metrics)
+        print(metrics)
+        return 0
+    return 2
+
+
+def _dp_wrapped_step(trainer, mesh):
+    """Wrap the DP step so the Trainer's host-side loop can feed it plain
+    numpy batches (they get sharded over the mesh on the way in)."""
+    from .parallel import make_dp_train_step, shard_batch
+
+    dp_step = make_dp_train_step(trainer.clamp_mask, mesh)
+
+    def step(state, images, labels, rng):
+        return dp_step(
+            state,
+            shard_batch(images, mesh),
+            shard_batch(labels, mesh),
+            rng,
+        )
+
+    return step
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,12 @@ from flax import struct
 from ..data import batch_iterator
 from ..models import get_model, latent_clamp_mask
 from ..ops.losses import cross_entropy_loss
+from ..utils.checkpoint import (
+    latest_exists,
+    load_checkpoint,
+    read_meta,
+    save_checkpoint,
+)
 from ..utils.meters import AverageMeter
 from ..utils.results import ResultsLog
 from .optim import RegimeSchedule, make_optimizer
@@ -157,6 +163,9 @@ class TrainConfig:
     backend: Optional[str] = None  # GEMM backend override for binarized layers
     results_path: Optional[str] = None
     timing_csv_prefix: Optional[str] = None  # write per-batch/epoch CSVs
+    checkpoint_dir: Optional[str] = None
+    save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
+    resume: bool = False           # restore latest checkpoint before fit
 
 
 class Trainer:
@@ -168,7 +177,12 @@ class Trainer:
         mk = dict(config.model_kwargs)
         if config.backend is not None:
             mk.setdefault("backend", config.backend)
-        self.model = get_model(config.model, **mk)
+        try:
+            self.model = get_model(config.model, **mk)
+        except TypeError:
+            # fp32 models (ConvNet/DeepCNN) take no GEMM-backend knob
+            mk.pop("backend", None)
+            self.model = get_model(config.model, **mk)
         self.rng = jax.random.PRNGKey(config.seed)
         self.regime = RegimeSchedule(config.regime)
 
@@ -291,14 +305,43 @@ class Trainer:
             "test_acc_top5": totals["correct5"] / n * 100.0,
         }
 
+    def try_resume(self) -> int:
+        """Restore the latest checkpoint if present; returns start epoch."""
+        ckpt = self.config.checkpoint_dir
+        if not (ckpt and latest_exists(ckpt)):
+            return 0
+        self.state = load_checkpoint(self.state, ckpt)
+        meta = read_meta(ckpt)
+        self.best_acc = float(meta.get("best_acc") or 0.0)
+        start = int(meta.get("epoch", -1)) + 1
+        log.info("resumed from %s at epoch %d (step %d)", ckpt, start,
+                 int(self.state.step))
+        return start
+
     def fit(self, data, eval_every: int = 1) -> list[Dict[str, float]]:
         history = []
-        for epoch in range(self.config.epochs):
+        self.best_acc = getattr(self, "best_acc", 0.0)
+        start_epoch = self.try_resume() if self.config.resume else 0
+        for epoch in range(start_epoch, self.config.epochs):
             row: Dict[str, float] = {"epoch": epoch}
             row.update(self.train_epoch(data, epoch))
             if eval_every and (epoch + 1) % eval_every == 0:
                 row.update(self.evaluate(data))
             history.append(row)
+            if self.config.checkpoint_dir:
+                acc = row.get("test_acc", 0.0)
+                is_best = acc > self.best_acc
+                self.best_acc = max(self.best_acc, acc)
+                save_checkpoint(
+                    self.state,
+                    self.config.checkpoint_dir,
+                    is_best=is_best,
+                    epoch=epoch,
+                    save_all=self.config.save_all_epochs,
+                    extra_meta={"best_acc": self.best_acc, **{
+                        k: v for k, v in row.items() if isinstance(v, float)
+                    }},
+                )
             if jax.process_index() == 0:
                 log.info(
                     "epoch %d done: %s", epoch,

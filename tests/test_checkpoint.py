@@ -1,0 +1,86 @@
+"""Checkpoint/resume tests: roundtrip fidelity, best/per-epoch copies,
+atomicity, and trainer resume (SURVEY §5 checkpoint patterns)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_mnist_bnns_tpu.data import load_mnist
+from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+from distributed_mnist_bnns_tpu.utils.checkpoint import (
+    latest_exists,
+    load_checkpoint,
+    read_meta,
+    save_checkpoint,
+)
+
+
+def _tiny_trainer(tmp_path, epochs=1, resume=False):
+    return Trainer(
+        TrainConfig(
+            model="bnn-mlp-small",
+            epochs=epochs,
+            batch_size=32,
+            backend="xla",
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            save_all_epochs=True,
+            resume=resume,
+            seed=1,
+        )
+    )
+
+
+def test_roundtrip_preserves_state(tmp_path):
+    trainer = _tiny_trainer(tmp_path)
+    data = load_mnist("/nonexistent", synthetic_sizes=(256, 64))
+    trainer.fit(data)
+    path = str(tmp_path / "ckpts")
+    assert latest_exists(path)
+    fresh = _tiny_trainer(tmp_path)
+    restored = load_checkpoint(fresh.state, path)
+    for a, b in zip(
+        jax.tree.leaves(trainer.state.params), jax.tree.leaves(restored.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == int(trainer.state.step)
+    # optimizer moments restored too
+    for a, b in zip(
+        jax.tree.leaves(trainer.state.opt_state),
+        jax.tree.leaves(restored.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_best_and_epoch_copies(tmp_path):
+    trainer = _tiny_trainer(tmp_path, epochs=2)
+    data = load_mnist("/nonexistent", synthetic_sizes=(256, 64))
+    trainer.fit(data)
+    path = tmp_path / "ckpts"
+    assert (path / "model_best.msgpack").exists()
+    assert (path / "checkpoint_epoch_0.msgpack").exists()
+    assert (path / "checkpoint_epoch_1.msgpack").exists()
+    meta = read_meta(str(path))
+    assert meta["epoch"] == 1
+    assert "best_acc" in meta
+
+
+def test_resume_continues_from_epoch(tmp_path):
+    data = load_mnist("/nonexistent", synthetic_sizes=(256, 64))
+    t1 = _tiny_trainer(tmp_path, epochs=1)
+    t1.fit(data)
+    step_after_1 = int(t1.state.step)
+    t2 = _tiny_trainer(tmp_path, epochs=2, resume=True)
+    history = t2.fit(data)
+    assert len(history) == 1  # only epoch 1 ran on resume
+    assert history[0]["epoch"] == 1
+    assert int(t2.state.step) > step_after_1
+
+
+def test_save_checkpoint_atomic_no_tmp_left(tmp_path):
+    trainer = _tiny_trainer(tmp_path)
+    path = str(tmp_path / "c2")
+    save_checkpoint(trainer.state, path, epoch=0)
+    assert latest_exists(path)
+    assert not any(f.endswith(".tmp") for f in os.listdir(path))

@@ -1,0 +1,60 @@
+"""CLI smoke tests: flag parity with the reference scripts and an
+end-to-end tiny train/eval cycle including DP over the virtual mesh."""
+
+import os
+
+import pytest
+
+from distributed_mnist_bnns_tpu.cli import build_parser, main
+
+
+def test_parser_covers_reference_flags():
+    p = build_parser()
+    args = p.parse_args(
+        ["train", "--nodes", "2", "--node-rank", "1", "--epochs", "3",
+         "--lr", "0.02", "--seed", "7", "--log-interval", "10"]
+    )
+    assert args.nodes == 2 and args.node_rank == 1
+    assert args.epochs == 3 and args.lr == 0.02
+    assert args.seed == 7 and args.log_interval == 10
+
+
+def test_cli_train_then_eval(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        ["train", "--model", "bnn-mlp-small", "--epochs", "1",
+         "--batch-size", "32", "--backend", "xla",
+         "--data-dir", "/nonexistent_use_synth",
+         "--synthetic-sizes", "512", "128",
+         "--checkpoint-dir", str(tmp_path / "ck"),
+         "--results", str(tmp_path / "results.csv"),
+         "--timing-csv", str(tmp_path / "bench"),
+         "--log-file", str(tmp_path / "log.txt")]
+    )
+    assert rc == 0
+    assert (tmp_path / "results.csv").exists()
+    assert (tmp_path / "results.html").exists()
+    assert (tmp_path / "bench_batch_time.csv").exists()
+    assert (tmp_path / "bench_epoch_time.csv").exists()
+    assert (tmp_path / "log.txt").exists()
+
+    rc = main(
+        ["eval", "--model", "bnn-mlp-small", "--backend", "xla",
+         "--data-dir", "/nonexistent_use_synth",
+         "--synthetic-sizes", "512", "128",
+         "--checkpoint-dir", str(tmp_path / "ck"),
+         "--log-file", str(tmp_path / "log2.txt")]
+    )
+    assert rc == 0
+
+
+def test_cli_train_dp_auto(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        ["train", "--model", "bnn-mlp-small", "--epochs", "1",
+         "--batch-size", "64", "--backend", "xla", "--dp", "auto",
+         "--data-dir", "/nonexistent_use_synth",
+         "--synthetic-sizes", "512", "128",
+         "--log-file", str(tmp_path / "log.txt")]
+    )
+    assert rc == 0
