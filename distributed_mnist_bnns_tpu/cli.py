@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--log-interval", type=int, default=100)
         sp.add_argument("--backend", default=None,
                         choices=[None, "xla", "bf16", "xnor", "pallas_xnor"])
+        sp.add_argument("--precision", default="fp32",
+                        choices=["fp32", "bf16"],
+                        help="bf16 = mixed precision (AMP O2 parity)")
         sp.add_argument("--data-dir", default=None)
         sp.add_argument("--norm", default="mnist",
                         choices=["mnist", "half", "none"])
@@ -85,6 +88,7 @@ def _make_trainer(args):
         learning_rate=args.lr,
         seed=args.seed,
         log_interval=args.log_interval,
+        precision=args.precision,
         backend=args.backend,
         results_path=args.results,
         timing_csv_prefix=args.timing_csv,

@@ -160,6 +160,7 @@ class TrainConfig:
     seed: int = 42
     log_interval: int = 100
     loss: str = "ce"
+    precision: str = "fp32"        # "bf16": AMP-O2 parity (mnist-mixed.py:70)
     backend: Optional[str] = None  # GEMM backend override for binarized layers
     results_path: Optional[str] = None
     timing_csv_prefix: Optional[str] = None  # write per-batch/epoch CSVs
@@ -177,11 +178,19 @@ class Trainer:
         mk = dict(config.model_kwargs)
         if config.backend is not None:
             mk.setdefault("backend", config.backend)
+        if config.precision == "bf16":
+            # bf16 compute with fp32 master params — the TPU equivalent of
+            # Apex AMP O2 (mnist-mixed.py:70,104); no loss scaling needed
+            # (bf16 shares fp32's exponent range).
+            mk.setdefault("dtype", jnp.bfloat16)
         try:
             self.model = get_model(config.model, **mk)
         except TypeError:
-            # fp32 models (ConvNet/DeepCNN) take no GEMM-backend knob
-            mk.pop("backend", None)
+            # binarized models take no dtype knob (their GEMMs are already
+            # bf16 on the MXU via backend="bf16"); fp32 models take no
+            # GEMM-backend knob — retry with the unsupported key dropped.
+            for k in ("dtype", "backend"):
+                mk.pop(k, None)
             self.model = get_model(config.model, **mk)
         self.rng = jax.random.PRNGKey(config.seed)
         self.regime = RegimeSchedule(config.regime)

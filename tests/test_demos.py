@@ -1,0 +1,22 @@
+"""The demo trio must run clean on the virtual mesh (the reference's demos
+are its only multi-process smoke tests, SURVEY §4)."""
+
+import numpy as np
+
+from distributed_mnist_bnns_tpu.examples.demos import (
+    demo_basic,
+    demo_checkpoint,
+    demo_model_parallel,
+)
+
+
+def test_demo_basic():
+    assert np.isfinite(demo_basic())
+
+
+def test_demo_checkpoint():
+    assert np.isfinite(demo_checkpoint())
+
+
+def test_demo_model_parallel():
+    assert np.isfinite(demo_model_parallel())
