@@ -45,7 +45,19 @@ _DEFAULT_DIRS = (
 
 
 def load_idx(path: str) -> np.ndarray:
-    """Parse an idx ubyte file (magic 0x0801 labels / 0x0803 images), gz ok."""
+    """Parse an idx ubyte file (magic 0x0801 labels / 0x0803 images), gz ok.
+
+    Uses the native C++ decoder (native/) for raw files when built; falls
+    back to the pure-python parser (always used for .gz)."""
+    if not path.endswith(".gz"):
+        try:
+            from .. import native
+
+            arr = native.load_idx_native(path)
+            if arr is not None:
+                return arr
+        except Exception:  # pragma: no cover - fall through to python
+            pass
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">I", f.read(4))[0]

@@ -1,0 +1,122 @@
+"""ctypes bindings for the native data-runtime (idx decode, normalize,
+bitpack). Builds libdmbnative.so on first use via make/g++ (toolchain is in
+the image; no pybind11 needed), with a transparent pure-python fallback —
+set DMB_TPU_NO_NATIVE=1 to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libdmbnative.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DMB_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(
+                ["make", "-s"], cwd=_DIR, check=True, capture_output=True,
+                timeout=120,
+            )
+        except Exception as e:  # pragma: no cover - toolchain always present
+            log.debug("native build failed (%s); using python fallback", e)
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:  # pragma: no cover
+        log.debug("native load failed (%s)", e)
+        return None
+    lib.idx_header.restype = ctypes.c_int
+    lib.idx_header.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.idx_read_u8.restype = ctypes.c_int
+    lib.idx_read_u8.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ]
+    lib.u8_normalize.restype = ctypes.c_int
+    lib.u8_normalize.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+    ]
+    lib.pack_bits_pm1.restype = ctypes.c_int
+    lib.pack_bits_pm1.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_idx_native(path: str) -> Optional[np.ndarray]:
+    """Native idx parse; None if the library is unavailable or the file is
+    gzipped (the python path handles .gz)."""
+    if path.endswith(".gz"):
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    dims = (ctypes.c_int64 * 4)()
+    ndim = lib.idx_header(path.encode(), dims)
+    if ndim < 1:
+        raise ValueError(f"{path}: bad idx file (code {ndim})")
+    shape = tuple(int(dims[i]) for i in range(ndim))
+    out = np.empty(int(np.prod(shape)), dtype=np.uint8)
+    rc = lib.idx_read_u8(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.size,
+    )
+    if rc != 0:
+        raise ValueError(f"{path}: idx payload read failed (code {rc})")
+    return out.reshape(shape)
+
+
+def normalize_native(images_u8: np.ndarray, mean: float, std: float
+                     ) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    flat = np.ascontiguousarray(images_u8, dtype=np.uint8).reshape(-1)
+    out = np.empty(flat.size, dtype=np.float32)
+    lib.u8_normalize(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat.size, ctypes.c_float(mean), ctypes.c_float(1.0 / std),
+    )
+    return out.reshape(images_u8.shape)
+
+
+def pack_bits_native(x: np.ndarray) -> Optional[np.ndarray]:
+    """(rows, k) ±1 float32 -> (rows, ceil(k/32)) int32 bitplanes."""
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    rows, k = x.shape
+    kw = -(-k // 32)
+    out = np.empty((rows, kw), dtype=np.int32)
+    lib.pack_bits_pm1(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        rows, k, kw,
+    )
+    return out

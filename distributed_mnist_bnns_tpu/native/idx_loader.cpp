@@ -1,0 +1,78 @@
+// Native data-runtime: MNIST idx decoding, normalization, and host-side
+// bitplane packing.
+//
+// Role: the fast host-side IO/preprocessing layer under data/mnist.py —
+// the part of the reference stack that lived in torch's native DataLoader
+// machinery (the reference itself ships no first-party native code; its
+// native layer is all third-party torch/NCCL/Gloo — SURVEY §2 note). The
+// TPU compute path stays JAX/XLA/Pallas; this library feeds it.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this image). All
+// functions return 0 on success, negative errno-style codes on failure.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+extern "C" {
+
+// Parse an idx header: magic 0x0000080N (u8 data, N dims), big-endian dims.
+// dims_out must hold >= 4 entries. Returns ndim, or <0 on error.
+int idx_header(const char* path, int64_t* dims_out) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char h[4];
+    if (std::fread(h, 1, 4, f) != 4) { std::fclose(f); return -2; }
+    if (h[0] != 0 || h[1] != 0 || h[2] != 0x08) { std::fclose(f); return -3; }
+    int ndim = h[3];
+    if (ndim < 1 || ndim > 4) { std::fclose(f); return -3; }
+    for (int i = 0; i < ndim; ++i) {
+        unsigned char d[4];
+        if (std::fread(d, 1, 4, f) != 4) { std::fclose(f); return -2; }
+        dims_out[i] = (int64_t(d[0]) << 24) | (int64_t(d[1]) << 16) |
+                      (int64_t(d[2]) << 8) | int64_t(d[3]);
+    }
+    std::fclose(f);
+    return ndim;
+}
+
+// Read the u8 payload (after the header) into out[0..n).
+int idx_read_u8(const char* path, uint8_t* out, int64_t n) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char h[4];
+    if (std::fread(h, 1, 4, f) != 4) { std::fclose(f); return -2; }
+    int ndim = h[3];
+    if (std::fseek(f, 4 + 4 * ndim, SEEK_SET) != 0) { std::fclose(f); return -2; }
+    size_t got = std::fread(out, 1, (size_t)n, f);
+    std::fclose(f);
+    return got == (size_t)n ? 0 : -4;
+}
+
+// out[i] = (in[i]/255 - mean) / std  — the torchvision Normalize transform.
+int u8_normalize(const uint8_t* in, float* out, int64_t n, float mean,
+                 float inv_std) {
+    const float scale = inv_std / 255.0f;
+    const float shift = -mean * inv_std;
+    for (int64_t i = 0; i < n; ++i) out[i] = in[i] * scale + shift;
+    return 0;
+}
+
+// Pack ±1 floats into int32 bitplanes along the last axis:
+// bit = 1 <=> value > 0; rows x kw output words, zero-padded tail.
+// Matches ops/bitpack.py pack_bits convention exactly.
+int pack_bits_pm1(const float* in, int32_t* out, int64_t rows, int64_t k,
+                  int64_t kw) {
+    for (int64_t r = 0; r < rows; ++r) {
+        const float* src = in + r * k;
+        int32_t* dst = out + r * kw;
+        std::memset(dst, 0, (size_t)kw * sizeof(int32_t));
+        for (int64_t j = 0; j < k; ++j) {
+            if (src[j] > 0.0f)
+                dst[j >> 5] |= (int32_t)(1u << (j & 31));
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,52 @@
+"""Native C++ data-runtime vs pure-python oracles."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu import native
+from distributed_mnist_bnns_tpu.data.mnist import load_idx, _find_file
+from distributed_mnist_bnns_tpu.ops.bitpack import pack_bits_np
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable"
+)
+
+
+def test_native_idx_matches_python(tmp_path):
+    # build a tiny idx file: 3 images of 4x5 u8
+    import struct
+
+    data = np.arange(60, dtype=np.uint8).reshape(3, 4, 5)
+    p = tmp_path / "mini-idx3-ubyte"
+    with open(p, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">3I", 3, 4, 5))
+        f.write(data.tobytes())
+    via_py = load_idx(str(p))
+    via_c = native.load_idx_native(str(p))
+    np.testing.assert_array_equal(via_py, via_c)
+
+
+def test_native_idx_on_real_mnist_if_present():
+    raw = "/root/reference/data/MNIST/raw"
+    path = _find_file(raw, "t10k-labels-idx1-ubyte")
+    if not path or path.endswith(".gz"):
+        pytest.skip("no raw t10k labels")
+    np.testing.assert_array_equal(load_idx(path), native.load_idx_native(path))
+
+
+def test_native_normalize_matches_numpy():
+    rng = np.random.RandomState(0)
+    u8 = rng.randint(0, 256, size=(7, 28, 28), dtype=np.uint8)
+    out = native.normalize_native(u8, 0.1307, 0.3081)
+    ref = (u8.astype(np.float32) / 255.0 - 0.1307) / 0.3081
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_native_pack_bits_matches_python():
+    rng = np.random.RandomState(1)
+    x = np.sign(rng.randn(13, 131)).astype(np.float32)
+    x[x == 0] = 1
+    np.testing.assert_array_equal(native.pack_bits_native(x), pack_bits_np(x))
