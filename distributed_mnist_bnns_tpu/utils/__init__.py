@@ -2,6 +2,9 @@ from .logging_utils import setup_logging, is_primary_host
 from .meters import AverageMeter
 from .results import ResultsLog
 from .metrics import accuracy
+from .checkpoint import save_checkpoint, load_checkpoint, read_meta, latest_exists
+from .profiling import StepTimer, trace, annotate
+from .recovery import run_with_recovery, TrainingFailure
 
 __all__ = [
     "setup_logging",
@@ -9,4 +12,13 @@ __all__ = [
     "AverageMeter",
     "ResultsLog",
     "accuracy",
+    "save_checkpoint",
+    "load_checkpoint",
+    "read_meta",
+    "latest_exists",
+    "StepTimer",
+    "trace",
+    "annotate",
+    "run_with_recovery",
+    "TrainingFailure",
 ]

@@ -1,0 +1,66 @@
+"""Tracing / profiling — the reference's only tracing is manual wall-clock
+timing (datetime/time.time deltas through AverageMeter,
+mnist-dist2.py:109-115,139-150; SURVEY §5). Here that pattern is kept
+(StepTimer) and upgraded with real device-level tracing via jax.profiler —
+traces are viewable in TensorBoard/Perfetto and capture XLA fusion, HBM
+traffic and ICI collectives, which wall-clock timing cannot see.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Iterator, Optional
+
+import jax
+
+from .meters import AverageMeter
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Device-level profiler trace: with trace('tb_logs'): step(...)
+
+    No-op when log_dir is None, so call sites can be left in place."""
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a trace (shows up in the profiler timeline)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepTimer:
+    """Per-step wall-clock accounting (the AverageMeter timing pattern of
+    the flagship loop) with optional device sync.
+
+    sync=False measures dispatch time only (keeps the device pipeline
+    full — the right default in a hot loop); sync=True blocks on the given
+    arrays for true step latency (use at log boundaries / benchmarks)."""
+
+    def __init__(self) -> None:
+        self.meter = AverageMeter()
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync_on=None) -> float:
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        self.meter.update(dt)
+        return dt
+
+    @property
+    def avg(self) -> float:
+        return self.meter.avg

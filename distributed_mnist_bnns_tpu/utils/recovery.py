@@ -1,0 +1,55 @@
+"""Failure detection / recovery.
+
+The reference has no elastic runtime; its only recovery artifact is
+"checkpoint on one machine, manually resume on another" over a raw TCP
+socket pair (mnist change node.py:85-90 -> mnist change master.py:56-59;
+SURVEY §5 deems periodic-checkpoint + restart-from-latest sufficient
+parity). This module automates exactly that: run the training closure,
+checkpoint every epoch (the Trainer already does), and on failure restart
+from the latest checkpoint up to a retry budget.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, TypeVar
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class TrainingFailure(RuntimeError):
+    """Raised when training keeps failing past the retry budget."""
+
+
+def run_with_recovery(
+    make_trainer: Callable[[], "object"],
+    run: Callable[[object], T],
+    *,
+    max_restarts: int = 2,
+    backoff_s: float = 1.0,
+) -> T:
+    """Execute ``run(trainer)``; on exception rebuild the trainer (which,
+    with TrainConfig.resume=True, restores the latest checkpoint) and
+    retry. This is the cold-restart recovery loop the reference performed
+    by hand across its two LAN machines."""
+    attempt = 0
+    while True:
+        trainer = make_trainer()
+        try:
+            return run(trainer)
+        except KeyboardInterrupt:  # pragma: no cover
+            raise
+        except Exception as e:
+            attempt += 1
+            if attempt > max_restarts:
+                raise TrainingFailure(
+                    f"training failed {attempt} times; giving up"
+                ) from e
+            log.warning(
+                "training attempt %d failed (%s: %s); restarting from latest "
+                "checkpoint in %.1fs", attempt, type(e).__name__, e, backoff_s,
+            )
+            time.sleep(backoff_s)

@@ -1,0 +1,97 @@
+"""Utility-layer tests: meters, results log, accuracy, profiling timer,
+recovery harness, logging setup."""
+
+import logging
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.utils import (
+    AverageMeter,
+    ResultsLog,
+    accuracy,
+    setup_logging,
+)
+from distributed_mnist_bnns_tpu.utils.profiling import StepTimer, annotate, trace
+from distributed_mnist_bnns_tpu.utils.recovery import (
+    TrainingFailure,
+    run_with_recovery,
+)
+
+
+def test_average_meter():
+    m = AverageMeter()
+    m.update(2.0)
+    m.update(4.0, n=3)
+    assert m.val == 4.0
+    assert m.count == 4
+    assert m.avg == pytest.approx((2.0 + 12.0) / 4)
+    m.reset()
+    assert m.count == 0 and m.avg == 0.0
+
+
+def test_results_log_roundtrip(tmp_path):
+    rl = ResultsLog(str(tmp_path / "r.csv"))
+    rl.add(epoch=0, loss=1.5, acc=50.0)
+    rl.add(epoch=1, loss=0.9, acc=70.0)
+    rl.save("t")
+    assert (tmp_path / "r.csv").exists()
+    html = (tmp_path / "r.html").read_text()
+    assert "<svg" in html and "loss" in html
+    rl2 = ResultsLog(str(tmp_path / "r.csv"))
+    rows = rl2.load()
+    assert rows[1]["acc"] == 70.0 and rows[0]["epoch"] == 0
+
+
+def test_accuracy_topk():
+    out = jnp.array([[0.1, 0.5, 0.2, 0.05], [0.9, 0.01, 0.02, 0.03]])
+    target = jnp.array([2, 0])
+    top1, top2 = accuracy(out, target, topk=(1, 2))
+    assert float(top1) == pytest.approx(50.0)   # second row correct@1
+    assert float(top2) == pytest.approx(100.0)  # first row correct@2
+
+
+def test_step_timer_and_trace_noop():
+    t = StepTimer()
+    x = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    t.start()
+    with trace(None), annotate("step"):
+        dt = t.stop(sync_on=x)
+    assert dt >= 0 and t.avg >= 0
+
+
+def test_setup_logging_writes_file(tmp_path):
+    logf = tmp_path / "log.txt"
+    setup_logging(str(logf))
+    logging.getLogger().debug("debug-line")
+    logging.getLogger().info("info-line")
+    for h in logging.getLogger().handlers:
+        h.flush()
+    content = logf.read_text()
+    assert "debug-line" in content and "info-line" in content
+
+
+def test_run_with_recovery_restarts_then_succeeds():
+    calls = {"n": 0}
+
+    def make_trainer():
+        return object()
+
+    def run(trainer):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("boom")
+        return "done"
+
+    out = run_with_recovery(make_trainer, run, max_restarts=3, backoff_s=0.0)
+    assert out == "done" and calls["n"] == 3
+
+
+def test_run_with_recovery_gives_up():
+    def run(trainer):
+        raise RuntimeError("always")
+
+    with pytest.raises(TrainingFailure):
+        run_with_recovery(object, run, max_restarts=1, backoff_s=0.0)
