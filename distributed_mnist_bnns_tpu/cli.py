@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--log-interval", type=int, default=100)
         sp.add_argument("--backend", default=None,
                         choices=[None, "xla", "bf16", "xnor", "pallas_xnor"])
+        sp.add_argument("--loss", default="ce",
+                        choices=["ce", "hinge", "sqrt_hinge"])
         sp.add_argument("--precision", default="fp32",
                         choices=["fp32", "bf16"],
                         help="bf16 = mixed precision (AMP O2 parity)")
@@ -88,6 +90,7 @@ def _make_trainer(args):
         learning_rate=args.lr,
         seed=args.seed,
         log_interval=args.log_interval,
+        loss=args.loss,
         precision=args.precision,
         backend=args.backend,
         results_path=args.results,

@@ -3,6 +3,7 @@ from .mlp import BnnMLP, bnn_mlp_large, bnn_mlp_small
 from .convnet import ConvNet
 from .cnn import DeepCNN
 from .bnn_cnn import BinarizedCNN
+from .resnet import XnorResNet, xnor_resnet18, xnor_resnet50
 from .registry import get_model, MODEL_REGISTRY, latent_clamp_mask
 
 __all__ = [
@@ -14,6 +15,9 @@ __all__ = [
     "ConvNet",
     "DeepCNN",
     "BinarizedCNN",
+    "XnorResNet",
+    "xnor_resnet18",
+    "xnor_resnet50",
     "get_model",
     "MODEL_REGISTRY",
     "latent_clamp_mask",

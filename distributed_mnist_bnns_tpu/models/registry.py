@@ -22,6 +22,7 @@ from .bnn_cnn import BinarizedCNN
 from .cnn import DeepCNN
 from .convnet import ConvNet
 from .mlp import bnn_mlp_large, bnn_mlp_small
+from .resnet import xnor_resnet18, xnor_resnet50
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     # flagship BNN MLPs (mnist-dist2.py:46-76 / mnist-dist3.py:40-70)
@@ -32,6 +33,9 @@ MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     "deep-cnn": DeepCNN,
     # binarized CNN (BASELINE.json config; uses BinarizeConv2d capability)
     "bnn-cnn": BinarizedCNN,
+    # stretch configs (BASELINE.json): binarized ResNets
+    "xnor-resnet18": xnor_resnet18,
+    "xnor-resnet50": xnor_resnet50,
 }
 
 

@@ -1,5 +1,5 @@
 from .binarize import binarize, binarize_ste, quantize
-from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss
+from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss, make_loss
 from .bitpack import pack_bits, unpack_bits, packed_dim
 from .xnor_gemm import xnor_matmul, binary_matmul, set_default_backend, get_default_backend
 
@@ -10,6 +10,7 @@ __all__ = [
     "hinge_loss",
     "sqrt_hinge_loss",
     "cross_entropy_loss",
+    "make_loss",
     "pack_bits",
     "unpack_bits",
     "packed_dim",

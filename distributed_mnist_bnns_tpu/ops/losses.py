@@ -54,6 +54,24 @@ def _sqrt_hinge_bwd(res, g):
 sqrt_hinge_loss.defvjp(_sqrt_hinge_fwd, _sqrt_hinge_bwd)
 
 
+def make_loss(name: str, num_classes: int = 10):
+    """Loss registry for the trainer: 'ce' (the reference training loops),
+    'hinge' / 'sqrt_hinge' (the reference's HingeLoss / SqrtHingeLoss
+    modules, models/binarized_modules.py:20-54, which take ±1-coded
+    targets — integer labels are one-hot ±1 encoded here)."""
+    if name == "ce":
+        return cross_entropy_loss
+    if name in ("hinge", "sqrt_hinge"):
+        base = hinge_loss if name == "hinge" else sqrt_hinge_loss
+
+        def loss(outputs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+            target_pm1 = 2.0 * jax.nn.one_hot(labels, num_classes) - 1.0
+            return base(outputs, target_pm1)
+
+        return loss
+    raise ValueError(f"unknown loss {name!r}; available: ce, hinge, sqrt_hinge")
+
+
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Mean softmax cross entropy over integer labels.
 

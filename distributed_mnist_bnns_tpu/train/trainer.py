@@ -214,8 +214,11 @@ class Trainer:
             apply_fn=self.model.apply,
             tx=tx,
         )
-        self.train_step = make_train_step(self.clamp_mask)
-        self.eval_step = make_eval_step()
+        from ..ops.losses import make_loss
+
+        loss_fn = make_loss(config.loss)
+        self.train_step = make_train_step(self.clamp_mask, loss_fn=loss_fn)
+        self.eval_step = make_eval_step(loss_fn=loss_fn)
         self.results = ResultsLog(config.results_path or "results.csv")
         self.batch_meter = AverageMeter()
 
