@@ -73,26 +73,37 @@ def _xnor_matmul_jnp(x_pm1: jnp.ndarray, w_pm1: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _xnor_kernel(x_ref, w_ref, o_ref, *, k_words: int, real_k: int):
+def _xnor_kernel(
+    x_ref, w_ref, o_ref, *, k_words: int, real_k: int, k_chunk: int = 8
+):
     """One (bm, bn) output tile: o = real_k - 2 * sum_w popcount(x ^ w).
 
     x_ref: (bm, KW) int32 packed activations
     w_ref: (bn, KW) int32 packed weights (N-major, packed along K)
-    The packed-K loop runs on the VPU: each step is a (bm, bn) xor+popcount.
+
+    The packed-K reduction runs on the VPU in chunks of ``k_chunk`` words:
+    each iteration XOR+popcounts a (bm, bn, k_chunk) broadcast and reduces
+    the chunk axis — fatter vector ops (and fewer loop trips) than a
+    per-word loop, while keeping the temporary well under VMEM limits
+    (bm*bn*k_chunk*4B = 512KB at 128x128x8).
     """
     x = x_ref[...]
     w = w_ref[...]
+    bm, bn = o_ref.shape
+    n_chunks = -(-k_words // k_chunk)
 
     def body(i, acc):
-        xw = jax.lax.dynamic_slice_in_dim(x, i, 1, axis=1)       # (bm, 1)
-        ww = jax.lax.dynamic_slice_in_dim(w, i, 1, axis=1)       # (bn, 1)
+        start = i * k_chunk
+        xw = jax.lax.dynamic_slice_in_dim(x, start, k_chunk, axis=1)
+        ww = jax.lax.dynamic_slice_in_dim(w, start, k_chunk, axis=1)
         mism = jax.lax.population_count(
-            jnp.bitwise_xor(xw, jnp.transpose(ww))               # (bm, bn)
+            jnp.bitwise_xor(xw[:, None, :], ww[None, :, :])  # (bm, bn, kc)
         )
-        return acc + mism
+        return acc + jnp.sum(mism, axis=-1)
 
-    bm, bn = o_ref.shape
-    acc = jax.lax.fori_loop(0, k_words, body, jnp.zeros((bm, bn), jnp.int32))
+    acc = jax.lax.fori_loop(
+        0, n_chunks, body, jnp.zeros((bm, bn), jnp.int32)
+    )
     o_ref[...] = (real_k - 2 * acc).astype(jnp.float32)
 
 
@@ -122,8 +133,11 @@ def xnor_matmul(
     mp = -(-m // bm) * bm
     np_ = -(-n // bn) * bn
 
-    xp = pack_bits(x_pm1)            # (M, KW)
-    wp = pack_bits(w_pm1.T)          # (N, KW)
+    # Pad packed-K to a multiple of the kernel's chunk so every
+    # dynamic_slice in the reduction is in-bounds; zero words pad *both*
+    # operands (equal bits -> zero extra mismatches -> formula stays exact).
+    xp = pack_bits(x_pm1, pad_words_to=8)    # (M, KW)
+    wp = pack_bits(w_pm1.T, pad_words_to=8)  # (N, KW)
     kw = xp.shape[-1]
     if mp != m:
         xp = jnp.pad(xp, ((0, mp - m), (0, 0)))
