@@ -7,6 +7,7 @@ from .data_parallel import (
     replicate,
 )
 from .model_parallel import bnn_mlp_tp_rules, make_tp_train_step
+from .ring_attention import attention_reference, make_ring_attention
 
 __all__ = [
     "make_mesh",
@@ -17,4 +18,6 @@ __all__ = [
     "replicate",
     "bnn_mlp_tp_rules",
     "make_tp_train_step",
+    "attention_reference",
+    "make_ring_attention",
 ]

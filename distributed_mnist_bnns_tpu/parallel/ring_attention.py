@@ -1,0 +1,114 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Not present in the reference (no attention/sequence models there, SURVEY
+§5 "long-context: absent"); included because long-context scaling is a
+first-class axis of this framework. The design is blockwise ring attention
+(Liu et al.): the sequence is sharded over a mesh axis, each device keeps
+its Q shard resident and streams K/V shards around the ring with
+``lax.ppermute`` (ICI neighbor exchange), accumulating exact softmax
+attention via the online (flash) max/sum rescaling — so the result is
+bit-for-bit-close to full attention while sequence length scales linearly
+with the number of devices.
+
+Shapes: (batch, seq, heads, head_dim); the 'seq' axis shards dim 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *, causal: bool = False
+) -> jnp.ndarray:
+    """Full softmax attention oracle: (B, L, H, D) -> (B, L, H, D)."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_attn_update(q, k, v, m, l, acc, *, scale, mask=None):
+    """One K/V block of online-softmax attention.
+
+    m: running row max (B, H, Lq, 1); l: running denom; acc: running
+    numerator (B, Lq, H, D)."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    # guard -inf (fully masked rows) -> exp(0)=1 on zero weights is avoided
+    # by the final l division; replace -inf diffs with large negatives.
+    p = jnp.exp(scores - m_new)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = (
+        acc * jnp.moveaxis(correction, 1, 2)
+        + jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    )
+    return m_new, l_new, acc_new
+
+
+def make_ring_attention(
+    mesh: Mesh, *, axis: str = "seq", causal: bool = False
+):
+    """Build a jitted ring-attention fn over ``mesh``'s ``axis``.
+
+    Returns f(q, k, v) taking globally-shaped arrays sharded on seq
+    (placement handled by in_shardings), computing exact attention.
+    With causal=True, block masking uses the global positions implied by
+    each shard's ring offset.
+    """
+    n_shards = mesh.shape[axis]
+
+    def local_fn(q, k, v):
+        # per-device shapes: (B, Lloc, H, D)
+        scale = q.shape[-1] ** -0.5
+        my_idx = jax.lax.axis_index(axis)
+        b, lq, h, d = q.shape
+        m = jnp.full((b, h, lq, 1), -jnp.inf, q.dtype)
+        l = jnp.zeros((b, h, lq, 1), q.dtype)
+        acc = jnp.zeros_like(q)
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+        def body(step, carry):
+            m, l, acc, k_cur, v_cur = carry
+            src_idx = (my_idx - step) % n_shards  # whose K/V we hold now
+            if causal:
+                q_pos = my_idx * lq + jnp.arange(lq)[:, None]
+                k_pos = src_idx * lq + jnp.arange(k_cur.shape[1])[None, :]
+                mask = (k_pos <= q_pos)[None, None]
+            else:
+                mask = None
+            m, l, acc = _block_attn_update(
+                q, k_cur, v_cur, m, l, acc, scale=scale, mask=mask
+            )
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return m, l, acc, k_nxt, v_nxt
+
+        m, l, acc, _, _ = jax.lax.fori_loop(
+            0, n_shards, body, (m, l, acc, k, v)
+        )
+        return acc / jnp.moveaxis(l, 1, 2)
+
+    seq_sharded = P(None, axis, None, None)
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(seq_sharded,) * 3,
+        out_specs=seq_sharded,
+        check_vma=False,
+    )
+    sh = NamedSharding(mesh, seq_sharded)
+    return jax.jit(fn, in_shardings=(sh,) * 3, out_shardings=sh)

@@ -1,0 +1,98 @@
+"""Checkpoint shipping between machines over TCP.
+
+Capability parity with the reference's hand-rolled master/node socket pair
+(mnist change master.py:117-124 binds/listens and replies with the file
+size; mnist change node.py:105-107 connects and ships the checkpoint
+filename after saving — code that is broken in the reference, SURVEY §2.8).
+On TPU pods the normal path is a shared filesystem/GCS bucket (see
+utils/checkpoint.py); this utility covers the no-shared-storage case the
+reference's socket pair addressed, with a correct length-prefixed protocol
+instead of the reference's filename/size handshake.
+
+Protocol (all big-endian):
+    8-byte name length | name utf-8 | 8-byte payload length | payload bytes
+Receiver replies with the 8-byte payload length as an ack (the analogue of
+the reference's size reply).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+from typing import Tuple
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">Q")
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-transfer")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_file(path: str, host: str, port: int, *, timeout: float = 30.0) -> int:
+    """Ship one file to a listening receiver; returns bytes sent."""
+    name = os.path.basename(path).encode()
+    with open(path, "rb") as f:
+        payload = f.read()
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(_LEN.pack(len(name)) + name + _LEN.pack(len(payload)))
+        s.sendall(payload)
+        ack = _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+    if ack != len(payload):
+        raise IOError(f"receiver acked {ack} bytes, sent {len(payload)}")
+    log.info("shipped %s (%d bytes) to %s:%d", path, len(payload), host, port)
+    return len(payload)
+
+
+def receive_file(
+    out_dir: str, port: int, *, host: str = "", timeout: float = 120.0
+) -> Tuple[str, int]:
+    """Accept one file; returns (path, bytes). Blocks until a sender
+    connects (the master's accept loop in the reference)."""
+    os.makedirs(out_dir, exist_ok=True)
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(1)
+        srv.settimeout(timeout)
+        conn, addr = srv.accept()
+        with conn:
+            conn.settimeout(timeout)
+            name_len = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
+            if name_len > 4096:
+                raise IOError(f"unreasonable name length {name_len}")
+            name = os.path.basename(_recv_exact(conn, name_len).decode())
+            size = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
+            payload = _recv_exact(conn, size)
+            out_path = os.path.join(out_dir, name)
+            tmp = out_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, out_path)
+            conn.sendall(_LEN.pack(size))  # size ack
+    log.info("received %s (%d bytes) from %s", out_path, size, addr)
+    return out_path, size
+
+
+def ship_checkpoint(ckpt_dir: str, host: str, port: int) -> int:
+    """Send the latest checkpoint artifact (the node side of the pair)."""
+    from .checkpoint import LATEST
+
+    return send_file(os.path.join(ckpt_dir, LATEST), host, port)
+
+
+def receive_checkpoint(ckpt_dir: str, port: int, **kw) -> str:
+    """Receive a checkpoint into ``ckpt_dir`` (the master side); the file
+    lands under the standard latest-checkpoint name, ready for
+    load_checkpoint + resume."""
+    path, _ = receive_file(ckpt_dir, port, **kw)
+    return path

@@ -1,0 +1,73 @@
+"""TCP checkpoint shipping: roundtrip on localhost, then resume from the
+shipped checkpoint — the working version of the reference's master/node
+socket experiment (SURVEY §3.4)."""
+
+import threading
+
+import jax
+import numpy as np
+
+from distributed_mnist_bnns_tpu.data import load_mnist
+from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+from distributed_mnist_bnns_tpu.utils.checkpoint import load_checkpoint
+from distributed_mnist_bnns_tpu.utils.transfer import (
+    receive_checkpoint,
+    receive_file,
+    send_file,
+    ship_checkpoint,
+)
+
+PORT = 29517
+
+
+def test_send_receive_roundtrip(tmp_path):
+    src = tmp_path / "artifact.bin"
+    payload = bytes(range(256)) * 1000
+    src.write_bytes(payload)
+    out_dir = tmp_path / "inbox"
+    result = {}
+
+    def recv():
+        result["path"], result["size"] = receive_file(str(out_dir), PORT)
+
+    t = threading.Thread(target=recv)
+    t.start()
+    import time
+
+    time.sleep(0.2)  # let the listener bind
+    sent = send_file(str(src), "127.0.0.1", PORT)
+    t.join(timeout=10)
+    assert sent == len(payload) == result["size"]
+    assert (out_dir / "artifact.bin").read_bytes() == payload
+
+
+def test_ship_checkpoint_and_resume_elsewhere(tmp_path):
+    """Node trains + ships; 'master' receives into its own dir and resumes —
+    end to end on localhost."""
+    data = load_mnist("/nonexistent", synthetic_sizes=(128, 64))
+    node_dir = tmp_path / "node_ck"
+    t1 = Trainer(TrainConfig(model="bnn-mlp-small", epochs=1, batch_size=32,
+                             backend="xla", checkpoint_dir=str(node_dir)))
+    t1.fit(data)
+
+    master_dir = tmp_path / "master_ck"
+    result = {}
+
+    def recv():
+        result["path"] = receive_checkpoint(str(master_dir), PORT + 1)
+
+    th = threading.Thread(target=recv)
+    th.start()
+    import time
+
+    time.sleep(0.2)
+    ship_checkpoint(str(node_dir), "127.0.0.1", PORT + 1)
+    th.join(timeout=10)
+
+    t2 = Trainer(TrainConfig(model="bnn-mlp-small", epochs=1, batch_size=32,
+                             backend="xla", checkpoint_dir=str(master_dir)))
+    restored = load_checkpoint(t2.state, str(master_dir))
+    for a, b in zip(
+        jax.tree.leaves(t1.state.params), jax.tree.leaves(restored.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
