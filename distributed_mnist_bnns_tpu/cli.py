@@ -98,6 +98,7 @@ def _make_trainer(args):
         checkpoint_dir=args.checkpoint_dir,
         save_all_epochs=args.save_all,
         resume=args.resume,
+        data_parallel=args.dp if args.dp == "auto" else int(args.dp),
     )
     return Trainer(config)
 
@@ -132,14 +133,6 @@ def main(argv=None) -> int:
     trainer = _make_trainer(args)
 
     if args.cmd == "train":
-        dp = len(jax.devices()) if args.dp == "auto" else int(args.dp)
-        if dp > 1:
-            from .parallel import make_dp_train_step, make_mesh, replicate
-
-            mesh = make_mesh(data=dp)
-            trainer.train_step = _dp_wrapped_step(trainer, mesh)
-            trainer.state = replicate(trainer.state, mesh)
-            log.info("data-parallel over %d devices", dp)
         history = trainer.fit(data)
         final = history[-1] if history else {}
         log.info("final: %s", final)
@@ -159,24 +152,6 @@ def main(argv=None) -> int:
         print(metrics)
         return 0
     return 2
-
-
-def _dp_wrapped_step(trainer, mesh):
-    """Wrap the DP step so the Trainer's host-side loop can feed it plain
-    numpy batches (they get sharded over the mesh on the way in)."""
-    from .parallel import make_dp_train_step, shard_batch
-
-    dp_step = make_dp_train_step(trainer.clamp_mask, mesh)
-
-    def step(state, images, labels, rng):
-        return dp_step(
-            state,
-            shard_batch(images, mesh),
-            shard_batch(labels, mesh),
-            rng,
-        )
-
-    return step
 
 
 if __name__ == "__main__":

@@ -167,6 +167,7 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
     resume: bool = False           # restore latest checkpoint before fit
+    data_parallel: Optional[object] = None  # None | "auto" | int devices
 
 
 class Trainer:
@@ -219,8 +220,51 @@ class Trainer:
         loss_fn = make_loss(config.loss)
         self.train_step = make_train_step(self.clamp_mask, loss_fn=loss_fn)
         self.eval_step = make_eval_step(loss_fn=loss_fn)
+        self.mesh = None
+        if config.data_parallel:
+            self._setup_data_parallel(loss_fn)
         self.results = ResultsLog(config.results_path or "results.csv")
         self.batch_meter = AverageMeter()
+
+    def _setup_data_parallel(self, loss_fn) -> None:
+        """Switch the train step to the GSPMD DP step over a 1-D mesh —
+        the DistributedDataParallel wrap of the reference
+        (mnist-dist2.py:93), done declaratively."""
+        from ..parallel import (  # local import: parallel depends on train
+            make_dp_train_step,
+            make_mesh,
+            replicate,
+            shard_batch,
+        )
+
+        dp = self.config.data_parallel
+        n = jax.device_count() if dp == "auto" else int(dp)
+        if n <= 1:
+            return
+        if self.config.batch_size % n:
+            raise ValueError(
+                f"batch_size {self.config.batch_size} not divisible by "
+                f"data_parallel={n}"
+            )
+        self.mesh = make_mesh(data=n)
+        dp_step = make_dp_train_step(self.clamp_mask, self.mesh, loss_fn=loss_fn)
+        mesh = self.mesh
+
+        def step(state, images, labels, rng):
+            return dp_step(
+                state, shard_batch(images, mesh), shard_batch(labels, mesh), rng
+            )
+
+        self.train_step = step
+        self.state = replicate(self.state, mesh)
+        log.info("data-parallel over %d devices", n)
+
+    def _eval_state(self):
+        """Single-device copy of the state for (variable-batch) eval when
+        training data-parallel."""
+        if self.mesh is None:
+            return self.state
+        return jax.device_put(jax.device_get(self.state), jax.devices()[0])
 
     # -- epoch-level hyperparameter control ---------------------------------
 
@@ -301,12 +345,13 @@ class Trainer:
     def evaluate(self, data, batch_size: Optional[int] = None) -> Dict[str, float]:
         bs = batch_size or self.config.batch_size
         totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
+        eval_state = self._eval_state()
         for images, labels in batch_iterator(
             data.test_images, data.test_labels, bs,
             shuffle=False, drop_last=False,
         ):
             out = self.eval_step(
-                self.state, jnp.asarray(images), jnp.asarray(labels)
+                eval_state, jnp.asarray(images), jnp.asarray(labels)
             )
             for k in totals:
                 totals[k] += float(out[k])

@@ -1,0 +1,27 @@
+"""Trainer-integrated data parallelism: fit() over the virtual mesh must
+learn and agree with the single-device trainer's data pipeline."""
+
+import pytest
+
+from distributed_mnist_bnns_tpu.data import load_mnist
+from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+
+def test_trainer_dp_auto_learns():
+    data = load_mnist(synthetic_sizes=(2048, 256))
+    trainer = Trainer(
+        TrainConfig(model="bnn-mlp-small", epochs=1, batch_size=64,
+                    backend="xla", data_parallel="auto", seed=0)
+    )
+    assert trainer.mesh is not None and trainer.mesh.devices.size == 8
+    first = trainer.evaluate(data)
+    history = trainer.fit(data)
+    assert history[-1]["test_acc"] > first["test_acc"] + 10.0
+
+
+def test_trainer_dp_batch_divisibility_check():
+    with pytest.raises(ValueError):
+        Trainer(
+            TrainConfig(model="bnn-mlp-small", batch_size=30,
+                        backend="xla", data_parallel=8)
+        )
