@@ -8,6 +8,7 @@ from .data_parallel import (
 )
 from .model_parallel import bnn_mlp_tp_rules, make_tp_train_step
 from .ring_attention import attention_reference, make_ring_attention
+from .pipeline import make_pipeline_fn, sequential_reference
 
 __all__ = [
     "make_mesh",
@@ -20,4 +21,6 @@ __all__ = [
     "make_tp_train_step",
     "attention_reference",
     "make_ring_attention",
+    "make_pipeline_fn",
+    "sequential_reference",
 ]

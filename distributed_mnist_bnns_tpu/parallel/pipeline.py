@@ -1,0 +1,103 @@
+"""Pipeline parallelism — GPipe-style microbatched stage pipeline over a
+mesh axis, built on shard_map + lax.ppermute.
+
+The reference has no pipeline parallelism (its 2-device split is naive
+layer placement with no micro-batching, SURVEY §2.3 "PP: absent"); this
+module exceeds parity. Semantics: a homogeneous chain of ``n_stages``
+stage functions (stage s owns its own parameter slice, sharded over the
+'pipe' axis), fed ``n_micro`` microbatches. Every device runs the same
+SPMD program; at each schedule tick it processes the activation it holds
+and hands the result to its ring neighbor (``ppermute`` over ICI). The
+bubble is the standard (n_stages - 1) ticks at fill and drain:
+total ticks = n_micro + n_stages - 1.
+
+Exactness: the pipelined result equals applying the stages sequentially —
+covered by tests/test_pipeline.py against a single-device loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_pipeline_fn(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    *,
+    axis: str = "pipe",
+    n_micro: int = 4,
+):
+    """Build f(stage_params, x) -> y running the stage chain as a pipeline.
+
+    stage_params: pytree whose leaves have leading dim n_stages (stage-major,
+    sharded over ``axis``). stage_fn(params_for_one_stage, x) -> x' must be
+    shape-preserving (homogeneous pipeline).
+    x: (B, ...) with B divisible by n_micro; replicated in, replicated out.
+    """
+    n_stages = mesh.shape[axis]
+
+    def local_fn(stage_params, x):
+        # stage_params leaves arrive as (1, ...) slices -> squeeze stage dim.
+        params = jax.tree.map(lambda p: p[0], stage_params)
+        idx = jax.lax.axis_index(axis)
+        b = x.shape[0]
+        mb = b // n_micro
+        micro = x.reshape(n_micro, mb, *x.shape[1:])
+        total_ticks = n_micro + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        buf = jnp.zeros_like(micro[0])
+        outputs = jnp.zeros_like(micro)
+
+        def tick(t, carry):
+            buf, outputs = carry
+            # stage 0 injects microbatch t (clamped; masked by validity)
+            inject = micro[jnp.clip(t, 0, n_micro - 1)]
+            x_in = jnp.where(idx == 0, inject, buf)
+            y = stage_fn(params, x_in)
+            # device s at tick t is working on microbatch (t - s)
+            mb_idx = t - idx
+            valid = (mb_idx >= 0) & (mb_idx < n_micro)
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+            # last stage banks its finished microbatch
+            is_last = idx == n_stages - 1
+            outputs = jax.lax.cond(
+                valid & is_last,
+                lambda o: o.at[jnp.clip(mb_idx, 0, n_micro - 1)].set(y),
+                lambda o: o,
+                outputs,
+            )
+            buf = jax.lax.ppermute(y, axis, perm)
+            return buf, outputs
+
+        _, outputs = jax.lax.fori_loop(0, total_ticks, tick, (buf, outputs))
+        # replicate the last stage's collected outputs to every device
+        outputs = jax.lax.psum(
+            jnp.where(idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis,
+        )
+        return outputs.reshape(b, *x.shape[1:])
+
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def sequential_reference(
+    stage_params: Any, x: jnp.ndarray, stage_fn: Callable
+) -> jnp.ndarray:
+    """Oracle: apply the stage chain sequentially on one device."""
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    for s in range(n_stages):
+        params = jax.tree.map(lambda p: p[s], stage_params)
+        x = stage_fn(params, x)
+    return x
