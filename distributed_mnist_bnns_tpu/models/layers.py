@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..ops.binarize import STEMode, binarize_ste
+from ..ops.binarize import STEMode, binarize, binarize_ste
 from ..ops.xnor_gemm import Backend, binary_matmul, get_default_backend
 
 Dtype = Any
@@ -69,8 +69,15 @@ class BinarizedDense(nn.Module):
     binarize_input: bool = True
     use_bias: bool = True
     ste: STEMode = "identity"
+    stochastic: bool = False  # reference quant_mode='stoch' on activations
     backend: Backend | None = None
     param_dtype: Dtype = jnp.float32
+
+    def _binarize_act(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.stochastic and self.has_rng("binarize"):
+            return binarize(x, "stoch", ste=self.ste,
+                            key=self.make_rng("binarize"))
+        return binarize_ste(x, self.ste)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -81,7 +88,7 @@ class BinarizedDense(nn.Module):
             self.param_dtype,
         )
         if self.binarize_input:
-            x = binarize_ste(x, self.ste)
+            x = self._binarize_act(x)
         wb = binarize_ste(kernel, self.ste)
         lead = x.shape[:-1]
         y = binary_matmul(
@@ -112,6 +119,7 @@ class BinarizedConv(nn.Module):
     binarize_input: bool = True
     use_bias: bool = True
     ste: STEMode = "identity"
+    stochastic: bool = False
     backend: Backend | None = None
     param_dtype: Dtype = jnp.float32
 
@@ -126,7 +134,11 @@ class BinarizedConv(nn.Module):
             self.param_dtype,
         )
         if self.binarize_input:
-            x = binarize_ste(x, self.ste)
+            if self.stochastic and self.has_rng("binarize"):
+                x = binarize(x, "stoch", ste=self.ste,
+                             key=self.make_rng("binarize"))
+            else:
+                x = binarize_ste(x, self.ste)
         wb = binarize_ste(kernel, self.ste)
 
         backend = self.backend or get_default_backend()

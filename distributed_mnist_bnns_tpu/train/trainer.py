@@ -168,6 +168,8 @@ class TrainConfig:
     save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
     resume: bool = False           # restore latest checkpoint before fit
     data_parallel: Optional[object] = None  # None | "auto" | int devices
+    profile_dir: Optional[str] = None  # jax.profiler trace of early steps
+    profile_steps: int = 5
 
 
 class Trainer:
@@ -311,12 +313,19 @@ class Trainer:
             host_id=jax.process_index(),
             num_hosts=jax.process_count(),
         )
+        profiling = bool(cfg.profile_dir and epoch == 0)
+        if profiling:
+            jax.profiler.start_trace(cfg.profile_dir)
         epoch_start = time.perf_counter()
         for i, (images, labels) in enumerate(it):
             t0 = time.perf_counter()
             self.state, metrics = self.train_step(
                 self.state, jnp.asarray(images), jnp.asarray(labels), self.rng
             )
+            if profiling and i + 1 == cfg.profile_steps:
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
+                profiling = False
             if i == 0 or (i + 1) % cfg.log_interval == 0:
                 # sync only at log boundaries to keep the device pipeline full
                 metrics = jax.tree.map(lambda x: float(x), metrics)
@@ -332,6 +341,8 @@ class Trainer:
             self.batch_meter.update(dt)
             batch_times.append(dt)
         jax.block_until_ready(self.state.params)
+        if profiling:  # epoch shorter than profile_steps
+            jax.profiler.stop_trace()
         epoch_time = time.perf_counter() - epoch_start
         if cfg.timing_csv_prefix and jax.process_index() == 0:
             self._dump_timing_csvs(epoch, batch_times, epoch_time)

@@ -123,3 +123,21 @@ def test_train_mode_dropout_varies():
         mutable=["batch_stats"],
     )
     assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+
+def test_stochastic_binarized_dense_varies_with_rng():
+    import jax
+    import jax.numpy as jnp
+    from distributed_mnist_bnns_tpu.models import BinarizedDense
+
+    layer = BinarizedDense(8, stochastic=True, backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16)) * 0.3
+    variables = layer.init(
+        {"params": jax.random.PRNGKey(1), "binarize": jax.random.PRNGKey(2)}, x
+    )
+    o1 = layer.apply(variables, x, rngs={"binarize": jax.random.PRNGKey(3)})
+    o2 = layer.apply(variables, x, rngs={"binarize": jax.random.PRNGKey(4)})
+    o3 = layer.apply(variables, x)  # no rng -> deterministic path
+    o4 = layer.apply(variables, x)
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(o3), np.asarray(o4))

@@ -95,3 +95,19 @@ def test_run_with_recovery_gives_up():
 
     with pytest.raises(TrainingFailure):
         run_with_recovery(object, run, max_restarts=1, backoff_s=0.0)
+
+
+def test_trainer_profile_dir_writes_trace(tmp_path):
+    from distributed_mnist_bnns_tpu.data import load_mnist
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    data = load_mnist("/nonexistent", synthetic_sizes=(128, 64))
+    trainer = Trainer(
+        TrainConfig(model="bnn-mlp-small", epochs=1, batch_size=32,
+                    backend="xla", profile_dir=str(tmp_path / "tb"),
+                    profile_steps=2)
+    )
+    trainer.fit(data, eval_every=0)
+    import glob
+
+    assert glob.glob(str(tmp_path / "tb" / "**" / "*"), recursive=True)
