@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--log-interval", type=int, default=100)
         sp.add_argument("--backend", default=None,
                         choices=[None, "xla", "bf16", "xnor", "pallas_xnor"])
+        sp.add_argument("--stochastic", action="store_true",
+                        help="stochastic activation binarization "
+                             "(reference quant_mode='stoch')")
+        sp.add_argument("--profile-dir", default=None,
+                        help="write a jax.profiler trace of the first "
+                             "trained epoch's early steps here")
         sp.add_argument("--loss", default="ce",
                         choices=["ce", "hinge", "sqrt_hinge"])
         sp.add_argument("--precision", default="fp32",
@@ -81,6 +87,8 @@ def _make_trainer(args):
     model_kwargs = {}
     if args.model.startswith("bnn-mlp"):
         model_kwargs["infl_ratio"] = args.infl_ratio
+    if args.stochastic:
+        model_kwargs["stochastic"] = True
     config = TrainConfig(
         model=args.model,
         model_kwargs=model_kwargs,
@@ -99,6 +107,7 @@ def _make_trainer(args):
         save_all_epochs=args.save_all,
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
+        profile_dir=args.profile_dir,
     )
     return Trainer(config)
 

@@ -23,11 +23,13 @@ class BinarizedCNN(nn.Module):
     hidden: int = 1024
     backend: Backend | None = None
     ste: str = "identity"
+    stochastic: bool = False  # stochastic activation binarization (train-time)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         if x.ndim == 2:
             x = x.reshape(x.shape[0], 28, 28, 1)
+        stoch = self.stochastic and train
         bn = lambda: nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5
         )
@@ -38,12 +40,14 @@ class BinarizedCNN(nn.Module):
         x = bn()(x)
         x = nn.hard_tanh(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))  # 28 -> 14
-        x = BinarizedConv(w2, (3, 3), ste=self.ste, backend=self.backend)(x)
+        x = BinarizedConv(w2, (3, 3), ste=self.ste, backend=self.backend,
+                          stochastic=stoch)(x)
         x = bn()(x)
         x = nn.hard_tanh(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))  # 14 -> 7
         x = x.reshape(x.shape[0], -1)
-        x = BinarizedDense(self.hidden, ste=self.ste, backend=self.backend)(x)
+        x = BinarizedDense(self.hidden, ste=self.ste, backend=self.backend,
+                           stochastic=stoch)(x)
         x = bn()(x)
         x = nn.hard_tanh(x)
         x = nn.Dense(self.num_classes)(x)

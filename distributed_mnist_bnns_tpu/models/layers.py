@@ -53,6 +53,19 @@ def _latent_init(scale: float = 1.0) -> Callable:
     return init
 
 
+def _binarize_activations(
+    mdl: nn.Module, x: jnp.ndarray, stochastic: bool, ste: STEMode
+) -> jnp.ndarray:
+    """Activation binarization shared by Dense/Conv: stochastic (reference
+    quant_mode='stoch', models/binarized_modules.py:12-15) when requested
+    and a 'binarize' rng stream is available, deterministic sign otherwise.
+    The Trainer always threads a 'binarize' rng, so stochastic=True is live
+    in the real training path."""
+    if stochastic and mdl.has_rng("binarize"):
+        return binarize(x, "stoch", ste=ste, key=mdl.make_rng("binarize"))
+    return binarize_ste(x, ste)
+
+
 class BinarizedDense(nn.Module):
     """y = binarize(x) @ binarize(W_latent) + b_fp32.
 
@@ -73,12 +86,6 @@ class BinarizedDense(nn.Module):
     backend: Backend | None = None
     param_dtype: Dtype = jnp.float32
 
-    def _binarize_act(self, x: jnp.ndarray) -> jnp.ndarray:
-        if self.stochastic and self.has_rng("binarize"):
-            return binarize(x, "stoch", ste=self.ste,
-                            key=self.make_rng("binarize"))
-        return binarize_ste(x, self.ste)
-
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         kernel = self.param(
@@ -88,7 +95,7 @@ class BinarizedDense(nn.Module):
             self.param_dtype,
         )
         if self.binarize_input:
-            x = self._binarize_act(x)
+            x = _binarize_activations(self, x, self.stochastic, self.ste)
         wb = binarize_ste(kernel, self.ste)
         lead = x.shape[:-1]
         y = binary_matmul(
@@ -134,11 +141,7 @@ class BinarizedConv(nn.Module):
             self.param_dtype,
         )
         if self.binarize_input:
-            if self.stochastic and self.has_rng("binarize"):
-                x = binarize(x, "stoch", ste=self.ste,
-                             key=self.make_rng("binarize"))
-            else:
-                x = binarize_ste(x, self.ste)
+            x = _binarize_activations(self, x, self.stochastic, self.ste)
         wb = binarize_ste(kernel, self.ste)
 
         backend = self.backend or get_default_backend()

@@ -38,11 +38,13 @@ class BnnMLP(nn.Module):
     dropout_rate: float = 0.3
     backend: Backend | None = None
     ste: str = "identity"
+    stochastic: bool = False  # stochastic activation binarization (train-time)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         x = x.reshape(x.shape[0], -1)
         h1, h2, h3 = self.hidden
+        stoch = self.stochastic and train
         bn = lambda: nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5
         )
@@ -50,10 +52,12 @@ class BnnMLP(nn.Module):
         x = BinarizedDense(h1, binarize_input=False, ste=self.ste, backend=self.backend)(x)
         x = bn()(x)
         x = nn.hard_tanh(x)
-        x = BinarizedDense(h2, ste=self.ste, backend=self.backend)(x)
+        x = BinarizedDense(h2, ste=self.ste, backend=self.backend,
+                           stochastic=stoch)(x)
         x = bn()(x)
         x = nn.hard_tanh(x)
-        x = BinarizedDense(h3, ste=self.ste, backend=self.backend)(x)
+        x = BinarizedDense(h3, ste=self.ste, backend=self.backend,
+                           stochastic=stoch)(x)
         # Reference order: dropout THEN bn3 (mnist-dist2.py:72-74).
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = bn()(x)

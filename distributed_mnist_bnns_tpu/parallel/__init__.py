@@ -9,6 +9,11 @@ from .data_parallel import (
 from .model_parallel import bnn_mlp_tp_rules, make_tp_train_step
 from .ring_attention import attention_reference, make_ring_attention
 from .pipeline import make_pipeline_fn, sequential_reference
+from .expert_parallel import (
+    init_expert_params,
+    make_expert_parallel_moe,
+    moe_reference,
+)
 
 __all__ = [
     "make_mesh",
@@ -23,4 +28,7 @@ __all__ = [
     "make_ring_attention",
     "make_pipeline_fn",
     "sequential_reference",
+    "init_expert_params",
+    "make_expert_parallel_moe",
+    "moe_reference",
 ]

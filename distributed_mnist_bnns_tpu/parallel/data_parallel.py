@@ -61,14 +61,15 @@ def make_dp_train_step(
     """GSPMD data-parallel train step (grad all-reduce inserted by XLA)."""
 
     def train_step(state, images, labels, rng):
-        dropout_rng = jax.random.fold_in(rng, state.step)
+        step_rng = jax.random.fold_in(rng, state.step)
+        dropout_rng, binarize_rng = jax.random.split(step_rng)
 
         def compute_loss(params):
             outs, mutated = state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images,
                 train=True,
-                rngs={"dropout": dropout_rng},
+                rngs={"dropout": dropout_rng, "binarize": binarize_rng},
                 mutable=["batch_stats"],
             )
             return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
@@ -111,17 +112,18 @@ def make_shardmap_dp_train_step(
     DDP's backward-hook all-reduce made visible (mnist-dist2.py:93,130)."""
 
     def local_step(state, images, labels, rng):
-        dropout_rng = jax.random.fold_in(
+        local_rng = jax.random.fold_in(
             jax.random.fold_in(rng, state.step),
-            jax.lax.axis_index(axis),  # decorrelate dropout across replicas
+            jax.lax.axis_index(axis),  # decorrelate rngs across replicas
         )
+        dropout_rng, binarize_rng = jax.random.split(local_rng)
 
         def compute_loss(params):
             outs, mutated = state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images,
                 train=True,
-                rngs={"dropout": dropout_rng},
+                rngs={"dropout": dropout_rng, "binarize": binarize_rng},
                 mutable=["batch_stats"],
             )
             return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))

@@ -1,0 +1,175 @@
+"""Expert parallelism — Mixture-of-Experts dispatch over a mesh axis.
+
+Not present in the reference (no MoE; its models are dense MLPs/CNNs,
+SURVEY §2.2/§5); included because expert parallelism is a first-class
+scaling axis of this framework, alongside dp/tp/pp/sp.
+
+Design (GShard-style, TPU-first): tokens and experts are both sharded over
+the ``expert`` mesh axis. Each device routes its local tokens with top-1
+gating into capacity-bounded slots, builds one-hot dispatch/combine tensors,
+and exchanges token blocks with the expert owners via two
+``lax.all_to_all`` collectives (ICI neighbor exchange) — the canonical
+einsum-dispatch formulation, so the whole thing stays static-shaped and
+MXU-friendly under jit:
+
+    dispatch (T, E, C) @ tokens (T, D) -> (E, C, D)
+    all_to_all: group by owner -> each owner holds (E_local, n*C, D)
+    vmapped expert_fn per local expert
+    all_to_all back -> (E, C, D_out), combine (T, E, C) -> (T, D_out)
+
+Routing is computed *per token shard* with per-shard capacity, which is the
+semantics ``moe_reference`` mirrors exactly (including token dropping), so
+the expert-parallel path can be tested for equality against the dense
+oracle on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.binarize import binarize
+from ..ops.xnor_gemm import binary_matmul
+
+
+def top1_dispatch(
+    gates: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 routing with capacity-bounded one-hot dispatch.
+
+    gates: (T, E) router probabilities. Returns (dispatch, combine), both
+    (T, E, C): dispatch is the 0/1 token->slot assignment (tokens beyond
+    ``capacity`` per expert are dropped, in token order); combine is
+    dispatch scaled by the chosen expert's gate probability.
+    """
+    t, e = gates.shape
+    expert_idx = jnp.argmax(gates, axis=-1)                      # (T,)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=gates.dtype)    # (T, E)
+    # 1-based arrival position of each token within its chosen expert.
+    pos = jnp.cumsum(onehot, axis=0) * onehot                    # (T, E)
+    keep = (pos > 0) & (pos <= capacity)
+    slot = jnp.where(keep, pos - 1, 0).astype(jnp.int32)
+    dispatch = (
+        keep.astype(gates.dtype)[..., None]
+        * jax.nn.one_hot(slot, capacity, dtype=gates.dtype)      # (T, E, C)
+    )
+    gate_val = jnp.sum(gates * onehot, axis=-1)                  # (T,)
+    combine = gate_val[:, None, None] * dispatch
+    return dispatch, combine
+
+
+def binarized_expert(params: Any, x: jnp.ndarray) -> jnp.ndarray:
+    """One BNN expert: sign(x) @ sign(W) + b — the BinarizeLinear math
+    (reference models/binarized_modules.py:68-85) as an MoE expert body.
+
+    params: {"w": (D, D_out) fp32 latent, "b": (D_out,)}; x: (S, D).
+    """
+    y = binary_matmul(binarize(x), binarize(params["w"]))
+    return y + params["b"]
+
+
+def init_expert_params(
+    key: jax.Array, num_experts: int, d_in: int, d_out: int
+) -> dict:
+    """Stacked per-expert latent params, leading dim = experts (the dim the
+    ``expert`` mesh axis shards)."""
+    kw, _ = jax.random.split(key)
+    scale = d_in ** -0.5
+    return {
+        "w": jax.random.uniform(
+            kw, (num_experts, d_in, d_out), minval=-scale, maxval=scale
+        ),
+        "b": jnp.zeros((num_experts, d_out), jnp.float32),
+    }
+
+
+def moe_reference(
+    expert_params: Any,
+    gate_w: jnp.ndarray,
+    x: jnp.ndarray,
+    *,
+    capacity: int,
+    expert_fn: Callable[[Any, jnp.ndarray], jnp.ndarray] = binarized_expert,
+    n_shards: int = 1,
+) -> jnp.ndarray:
+    """Dense single-device MoE oracle with per-shard routing.
+
+    Routing runs independently per token shard (vmapped), with per-shard
+    ``capacity`` — exactly the semantics of the expert-parallel path, so
+    outputs match it including which tokens get dropped.
+    """
+    t, d = x.shape
+    assert t % n_shards == 0, (t, n_shards)
+    xs = x.reshape(n_shards, t // n_shards, d)
+
+    def route(x_s):
+        gates = jax.nn.softmax(x_s @ gate_w)
+        return top1_dispatch(gates, capacity)
+
+    dispatch, combine = jax.vmap(route)(xs)                  # (S, Tl, E, C)
+    ex_in = jnp.einsum("stec,std->escd", dispatch, xs)       # (E, S, C, D)
+    e = ex_in.shape[0]
+    ex_out = jax.vmap(expert_fn)(
+        expert_params, ex_in.reshape(e, n_shards * capacity, d)
+    )                                                        # (E, S*C, Do)
+    ex_out = ex_out.reshape(e, n_shards, capacity, -1)
+    out = jnp.einsum("stec,escd->std", combine, ex_out)
+    return out.reshape(t, -1)
+
+
+def make_expert_parallel_moe(
+    mesh: Mesh,
+    *,
+    axis: str = "expert",
+    capacity: int,
+    expert_fn: Callable[[Any, jnp.ndarray], jnp.ndarray] = binarized_expert,
+) -> Callable:
+    """Build a jitted expert-parallel MoE over ``mesh``'s ``axis``.
+
+    Returns f(expert_params, gate_w, x): expert_params leaves are stacked
+    (E, ...) and sharded on the leading dim; x is (T, D) sharded on tokens;
+    gate_w (D, E) is replicated. The axis size must divide both E and T.
+    """
+    n = mesh.shape[axis]
+
+    def local_fn(params_local, gate_w, x_local):
+        # Per-device: params (E_local, ...), x (T_local, D).
+        gates = jax.nn.softmax(x_local @ gate_w)             # (Tl, E)
+        dispatch, combine = top1_dispatch(gates, capacity)
+        ex_in = jnp.einsum("tec,td->ecd", dispatch, x_local)  # (E, C, D)
+        # Scatter expert groups to their owners; gather my experts' slices
+        # from every source device: (E, C, D) -> (E_local, n*C, D).
+        ex_in = jax.lax.all_to_all(
+            ex_in, axis, split_axis=0, concat_axis=1, tiled=True
+        )
+        ex_out = jax.vmap(expert_fn)(params_local, ex_in)     # (El, n*C, Do)
+        # Return each source device its tokens' results: -> (E, C, Do).
+        ex_out = jax.lax.all_to_all(
+            ex_out, axis, split_axis=1, concat_axis=0, tiled=True
+        )
+        return jnp.einsum("tec,ecd->td", combine, ex_out)
+
+    params_spec = P(axis)   # leading (expert) dim sharded on every leaf
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(params_spec, P(), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    fn = jax.jit(fn)
+
+    def moe(expert_params, gate_w, x):
+        e = jax.tree.leaves(expert_params)[0].shape[0]
+        t = x.shape[0]
+        if e % n or t % n:
+            raise ValueError(
+                f"expert axis {axis!r} of size {n} must divide both the "
+                f"expert count ({e}) and the token count ({t})"
+            )
+        return fn(expert_params, gate_w, x)
+
+    return moe

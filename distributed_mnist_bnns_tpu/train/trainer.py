@@ -85,14 +85,15 @@ def make_train_step(
         labels: jnp.ndarray,
         rng: jax.Array,
     ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
-        dropout_rng = jax.random.fold_in(rng, state.step)
+        step_rng = jax.random.fold_in(rng, state.step)
+        dropout_rng, binarize_rng = jax.random.split(step_rng)
 
         def compute_loss(params):
             outs, mutated = state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images,
                 train=True,
-                rngs={"dropout": dropout_rng},
+                rngs={"dropout": dropout_rng, "binarize": binarize_rng},
                 mutable=["batch_stats"],
             )
             return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
@@ -186,15 +187,11 @@ class Trainer:
             # Apex AMP O2 (mnist-mixed.py:70,104); no loss scaling needed
             # (bf16 shares fp32's exponent range).
             mk.setdefault("dtype", jnp.bfloat16)
-        try:
-            self.model = get_model(config.model, **mk)
-        except TypeError:
-            # binarized models take no dtype knob (their GEMMs are already
-            # bf16 on the MXU via backend="bf16"); fp32 models take no
-            # GEMM-backend knob — retry with the unsupported key dropped.
-            for k in ("dtype", "backend"):
-                mk.pop(k, None)
-            self.model = get_model(config.model, **mk)
+        # Not every model takes every knob (binarized models have no dtype
+        # knob — their GEMMs are already bf16 on the MXU via backend="bf16";
+        # fp32 models take no GEMM-backend/stochastic knobs). Drop only the
+        # specific kwargs the constructor rejects, keeping the ones it takes.
+        self.model = self._build_model(config.model, mk)
         self.rng = jax.random.PRNGKey(config.seed)
         self.regime = RegimeSchedule(config.regime)
 
@@ -227,6 +224,26 @@ class Trainer:
             self._setup_data_parallel(loss_fn)
         self.results = ResultsLog(config.results_path or "results.csv")
         self.batch_meter = AverageMeter()
+        self._profiled = False  # trace the first epoch this trainer runs
+
+    @staticmethod
+    def _build_model(name: str, mk: Dict[str, Any]):
+        optional = ("dtype", "backend", "stochastic")
+        while True:
+            try:
+                return get_model(name, **mk)
+            except TypeError as e:
+                # "... got an unexpected keyword argument 'stochastic'"
+                msg = str(e)
+                bad = next(
+                    (k for k in optional
+                     if k in mk and f"keyword argument '{k}'" in msg),
+                    None,
+                )
+                if bad is None:
+                    raise
+                mk.pop(bad)
+                log.warning("model %r does not take %r; ignored", name, bad)
 
     def _setup_data_parallel(self, loss_fn) -> None:
         """Switch the train step to the GSPMD DP step over a 1-D mesh —
@@ -313,36 +330,45 @@ class Trainer:
             host_id=jax.process_index(),
             num_hosts=jax.process_count(),
         )
-        profiling = bool(cfg.profile_dir and epoch == 0)
+        # Profile the first epoch actually run (resume may skip epoch 0);
+        # stop_trace in a finally so a failing step can't leave the global
+        # profiler started (which would crash any later start_trace).
+        profiling = bool(cfg.profile_dir and not self._profiled)
         if profiling:
+            self._profiled = True
             jax.profiler.start_trace(cfg.profile_dir)
         epoch_start = time.perf_counter()
-        for i, (images, labels) in enumerate(it):
-            t0 = time.perf_counter()
-            self.state, metrics = self.train_step(
-                self.state, jnp.asarray(images), jnp.asarray(labels), self.rng
-            )
-            if profiling and i + 1 == cfg.profile_steps:
-                jax.block_until_ready(self.state.params)
+        try:
+            for i, (images, labels) in enumerate(it):
+                t0 = time.perf_counter()
+                self.state, metrics = self.train_step(
+                    self.state, jnp.asarray(images), jnp.asarray(labels),
+                    self.rng,
+                )
+                if i == 0 or (i + 1) % cfg.log_interval == 0:
+                    # sync only at log boundaries to keep the pipeline full
+                    metrics = jax.tree.map(lambda x: float(x), metrics)
+                    losses.update(metrics["loss"], len(labels))
+                    accs.update(metrics["accuracy"], len(labels))
+                    if jax.process_index() == 0:
+                        log.info(
+                            "epoch %d step %d loss %.4f acc %.2f%% (%.2f ms/batch)",
+                            epoch, i + 1, metrics["loss"], metrics["accuracy"],
+                            self.batch_meter.avg * 1e3,
+                        )
+                dt = time.perf_counter() - t0
+                self.batch_meter.update(dt)
+                batch_times.append(dt)
+                # Stop the trace outside the timed region so the sync +
+                # trace-dump I/O doesn't pollute the recorded batch time.
+                if profiling and i + 1 == cfg.profile_steps:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
+            jax.block_until_ready(self.state.params)
+        finally:
+            if profiling:  # epoch shorter than profile_steps, or a raise
                 jax.profiler.stop_trace()
-                profiling = False
-            if i == 0 or (i + 1) % cfg.log_interval == 0:
-                # sync only at log boundaries to keep the device pipeline full
-                metrics = jax.tree.map(lambda x: float(x), metrics)
-                losses.update(metrics["loss"], len(labels))
-                accs.update(metrics["accuracy"], len(labels))
-                if jax.process_index() == 0:
-                    log.info(
-                        "epoch %d step %d loss %.4f acc %.2f%% (%.2f ms/batch)",
-                        epoch, i + 1, metrics["loss"], metrics["accuracy"],
-                        self.batch_meter.avg * 1e3,
-                    )
-            dt = time.perf_counter() - t0
-            self.batch_meter.update(dt)
-            batch_times.append(dt)
-        jax.block_until_ready(self.state.params)
-        if profiling:  # epoch shorter than profile_steps
-            jax.profiler.stop_trace()
         epoch_time = time.perf_counter() - epoch_start
         if cfg.timing_csv_prefix and jax.process_index() == 0:
             self._dump_timing_csvs(epoch, batch_times, epoch_time)

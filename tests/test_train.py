@@ -159,3 +159,27 @@ def test_trainer_lr_decay_per_epoch():
     assert trainer._lr_for_epoch(1) == pytest.approx(0.01)
     assert trainer._lr_for_epoch(2) == pytest.approx(0.001)
     assert trainer._lr_for_epoch(4) == pytest.approx(0.0001)
+
+
+def test_stochastic_binarization_live_through_trainer():
+    """Regression: stochastic=True must be reachable via the Trainer's own
+    train step (it threads a 'binarize' rng), not only via manual apply."""
+    import jax.numpy as jnp
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    config = TrainConfig(
+        model="bnn-mlp-small",
+        model_kwargs={"infl_ratio": 1, "stochastic": True, "backend": "xla"},
+        batch_size=8,
+        seed=0,
+    )
+    trainer = Trainer(config)
+    images = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 1)) * 0.3
+    labels = jnp.zeros((8,), jnp.int32)
+    # Same state, same data, different rng -> stochastic binarization must
+    # change the loss. (With the deterministic fallback both are equal.)
+    # The step donates its input state, so run each call on a fresh copy.
+    copy = lambda: jax.tree.map(jnp.copy, trainer.state)
+    _, m1 = trainer.train_step(copy(), images, labels, jax.random.PRNGKey(1))
+    _, m2 = trainer.train_step(copy(), images, labels, jax.random.PRNGKey(2))
+    assert float(m1["loss"]) != float(m2["loss"])
