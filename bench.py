@@ -52,22 +52,32 @@ def main() -> None:
     images = jax.device_put(images)
     labels = jax.device_put(labels)
 
-    # compile + warmup
-    for _ in range(args.warmup):
-        trainer.state, metrics = trainer.train_step(
-            trainer.state, images, labels, trainer.rng
-        )
-    jax.block_until_ready(trainer.state.params)
+    # Timing note: on remote-tunneled TPU backends, jax.block_until_ready can
+    # return before device execution finishes, inflating throughput by >100x
+    # (verified against a known-FLOPs matmul). The only trustworthy sync is a
+    # host fetch of a value that depends on the timed work, and the fixed
+    # tunnel round-trip must be cancelled out. So: time two runs of different
+    # lengths, each ended by fetching the final loss, and report the
+    # *marginal* per-step time between them.
+    def timed_run(n_steps: int):
+        # The train step donates its state argument, so each run continues
+        # from (and replaces) trainer.state rather than reusing a donated
+        # buffer.
+        metrics = None
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            trainer.state, metrics = trainer.train_step(
+                trainer.state, images, labels, trainer.rng
+            )
+        loss = float(metrics["loss"])  # host fetch = true device sync
+        return time.perf_counter() - t0, loss
 
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        trainer.state, metrics = trainer.train_step(
-            trainer.state, images, labels, trainer.rng
-        )
-    jax.block_until_ready(trainer.state.params)
-    dt = time.perf_counter() - t0
-
-    step_time = dt / args.steps
+    base = max(5, args.warmup)
+    timed_run(max(1, args.warmup))    # compile + warmup
+    t_short, _ = timed_run(base)
+    t_long, last_loss = timed_run(base + args.steps)
+    step_time = (t_long - t_short) / args.steps
+    metrics = {"loss": last_loss}
     ips = args.batch_size / step_time
     baseline_ips = 7270.0  # BASELINE.md derived throughput
     result = {

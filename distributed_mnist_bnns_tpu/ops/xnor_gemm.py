@@ -73,38 +73,47 @@ def _xnor_matmul_jnp(x_pm1: jnp.ndarray, w_pm1: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _xnor_kernel(
-    x_ref, w_ref, o_ref, *, k_words: int, real_k: int, k_chunk: int = 8
-):
-    """One (bm, bn) output tile: o = real_k - 2 * sum_w popcount(x ^ w).
+def _xnor_kernel(x_ref, wt_ref, o_ref, *, real_k: int):
+    """One (bm, bn, k-chunk) grid step: o -= 2 * sum_w popcount(x ^ w).
 
-    x_ref: (bm, KW) int32 packed activations
-    w_ref: (bn, KW) int32 packed weights (N-major, packed along K)
+    x_ref:  (bm, kc) int32 packed activations for this K chunk
+    wt_ref: (kc, bn) int32 packed weights, *K-major* (pre-transposed on the
+            host side so each packed word of w is a natural lane vector)
 
-    The packed-K reduction runs on the VPU in chunks of ``k_chunk`` words:
-    each iteration XOR+popcounts a (bm, bn, k_chunk) broadcast and reduces
-    the chunk axis — fatter vector ops (and fewer loop trips) than a
-    per-word loop, while keeping the temporary well under VMEM limits
-    (bm*bn*k_chunk*4B = 512KB at 128x128x8).
+    The packed-K reduction is the *innermost grid dimension* (sequential on
+    TPU), revisiting the same output tile: step 0 seeds ``o = real_k`` and
+    every step subtracts twice its chunk's mismatch count. Mosaic supports
+    this accumulation pattern natively, whereas slicing a loaded tile with
+    a loop-carried offset (dynamic_slice on values) does not lower.
+
+    Within the block, the all-pairs XOR is a statically unrolled loop of
+    rank-1 outer products — a (bm, 1) lane-broadcast of x's word column XOR
+    a (1, bn) sublane-broadcast of w's word row (the same broadcast pattern
+    attention kernels use for row-max expansion). Every temporary is a 2-D
+    (bm, bn) int32 vreg tile, so nothing gets lane-padded and VMEM stays at
+    O(bm*kc + kc*bn + bm*bn). fp32 accumulation is exact: |o| <= K <= 2^24.
     """
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _seed():
+        o_ref[...] = jnp.full(o_ref.shape, float(real_k), jnp.float32)
+
     x = x_ref[...]
-    w = w_ref[...]
+    wt = wt_ref[...]
+    kc = x.shape[-1]
     bm, bn = o_ref.shape
-    n_chunks = -(-k_words // k_chunk)
-
-    def body(i, acc):
-        start = i * k_chunk
-        xw = jax.lax.dynamic_slice_in_dim(x, start, k_chunk, axis=1)
-        ww = jax.lax.dynamic_slice_in_dim(w, start, k_chunk, axis=1)
-        mism = jax.lax.population_count(
-            jnp.bitwise_xor(xw[:, None, :], ww[None, :, :])  # (bm, bn, kc)
+    mism = jnp.zeros((bm, bn), jnp.int32)
+    for t in range(kc):
+        xc = jax.lax.slice_in_dim(x, t, t + 1, axis=1)    # (bm, 1)
+        wr = jax.lax.slice_in_dim(wt, t, t + 1, axis=0)   # (1, bn)
+        mism += jax.lax.population_count(
+            jnp.bitwise_xor(
+                jnp.broadcast_to(xc, (bm, bn)),
+                jnp.broadcast_to(wr, (bm, bn)),
+            )
         )
-        return acc + jnp.sum(mism, axis=-1)
-
-    acc = jax.lax.fori_loop(
-        0, n_chunks, body, jnp.zeros((bm, bn), jnp.int32)
-    )
-    o_ref[...] = (real_k - 2 * acc).astype(jnp.float32)
+    o_ref[...] -= (2 * mism).astype(jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
@@ -133,28 +142,39 @@ def xnor_matmul(
     mp = -(-m // bm) * bm
     np_ = -(-n // bn) * bn
 
-    # Pad packed-K to a multiple of the kernel's chunk so every
-    # dynamic_slice in the reduction is in-bounds; zero words pad *both*
-    # operands (equal bits -> zero extra mismatches -> formula stays exact).
-    xp = pack_bits(x_pm1, pad_words_to=8)    # (M, KW)
-    wp = pack_bits(w_pm1.T, pad_words_to=8)  # (N, KW)
+    # The packed-K axis becomes the innermost (sequential) grid dimension.
+    # Mosaic requires the last block dim to be 128-divisible or equal to the
+    # whole array dim, so: one chunk of the full packed-K when it is small,
+    # otherwise 128-word (4096-bit) chunks. Zero words pad *both* operands
+    # (equal bits -> zero extra mismatches -> the popcount formula stays
+    # exact).
+    xp = pack_bits(x_pm1)                     # (M, KW)
+    wtp = pack_bits(w_pm1.T).T                # (KW, N)  K-major for the kernel
     kw = xp.shape[-1]
+    if kw <= 128:
+        kc = kw
+    else:
+        kc = 128
+        kw_p = -(-kw // kc) * kc
+        xp = jnp.pad(xp, ((0, 0), (0, kw_p - kw)))
+        wtp = jnp.pad(wtp, ((0, kw_p - kw), (0, 0)))
+        kw = kw_p
     if mp != m:
         xp = jnp.pad(xp, ((0, mp - m), (0, 0)))
     if np_ != n:
-        wp = jnp.pad(wp, ((0, np_ - n), (0, 0)))
+        wtp = jnp.pad(wtp, ((0, 0), (0, np_ - n)))
 
     out = pl.pallas_call(
-        functools.partial(_xnor_kernel, k_words=kw, real_k=k),
+        functools.partial(_xnor_kernel, real_k=k),
         out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
-        grid=(mp // bm, np_ // bn),
+        grid=(mp // bm, np_ // bn, kw // kc),
         in_specs=[
-            pl.BlockSpec((bm, kw), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, kw), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, kc), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((kc, bn), lambda i, j, kk: (kk, j)),
         ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         interpret=interpret,
-    )(xp, wp)
+    )(xp, wtp)
     return out[:m, :n]
 
 
