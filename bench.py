@@ -72,11 +72,14 @@ def main() -> None:
         loss = float(metrics["loss"])  # host fetch = true device sync
         return time.perf_counter() - t0, loss
 
+    steps = max(1, args.steps)
     base = max(5, args.warmup)
     timed_run(max(1, args.warmup))    # compile + warmup
     t_short, _ = timed_run(base)
-    t_long, last_loss = timed_run(base + args.steps)
-    step_time = (t_long - t_short) / args.steps
+    t_long, last_loss = timed_run(base + steps)
+    # Floor the marginal delta: with tiny --steps, host/tunnel jitter can
+    # make the two runs cross over; never emit a zero/negative step time.
+    step_time = max((t_long - t_short) / steps, 1e-9)
     metrics = {"loss": last_loss}
     ips = args.batch_size / step_time
     baseline_ips = 7270.0  # BASELINE.md derived throughput

@@ -51,11 +51,14 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--precision", default="fp32",
                         choices=["fp32", "bf16"],
                         help="bf16 = mixed precision (AMP O2 parity)")
+        sp.add_argument("--dataset", default="mnist",
+                        choices=["mnist", "cifar10"])
         sp.add_argument("--data-dir", default=None)
-        sp.add_argument("--norm", default="mnist",
-                        choices=["mnist", "half", "none"])
+        sp.add_argument("--norm", default=None,
+                        choices=["mnist", "cifar", "half", "none"],
+                        help="default: the dataset's own statistics")
         sp.add_argument("--synthetic-sizes", type=int, nargs=2,
-                        default=(60000, 10000), metavar=("TRAIN", "TEST"),
+                        default=None, metavar=("TRAIN", "TEST"),
                         help="fallback synthetic dataset sizes")
         sp.add_argument("--checkpoint-dir", default=None)
         sp.add_argument("--save-all", action="store_true")
@@ -81,7 +84,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _make_trainer(args):
+def _make_trainer(args, input_shape=(28, 28, 1)):
     from .train import TrainConfig, Trainer
 
     model_kwargs = {}
@@ -109,11 +112,18 @@ def _make_trainer(args):
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
         profile_dir=args.profile_dir,
     )
-    return Trainer(config)
+    return Trainer(config, input_shape=input_shape)
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.norm is not None and args.norm not in (
+        "half", "none", {"mnist": "mnist", "cifar10": "cifar"}[args.dataset]
+    ):
+        parser.error(
+            f"--norm {args.norm} is not valid for --dataset {args.dataset}"
+        )
 
     from .utils import setup_logging
 
@@ -130,16 +140,18 @@ def main(argv=None) -> int:
 
     import jax
 
-    from .data import load_mnist
+    from .data import load_dataset
 
-    data = load_mnist(
-        args.data_dir, norm=args.norm,
-        synthetic_sizes=tuple(args.synthetic_sizes),
-    )
-    log.info("data source: %s (%d train / %d test)", data.source,
-             len(data.train_labels), len(data.test_labels))
+    kwargs = {}
+    if args.norm is not None:
+        kwargs["norm"] = args.norm
+    if args.synthetic_sizes is not None:
+        kwargs["synthetic_sizes"] = tuple(args.synthetic_sizes)
+    data = load_dataset(args.dataset, args.data_dir, **kwargs)
+    log.info("data source: %s/%s (%d train / %d test)", args.dataset,
+             data.source, len(data.train_labels), len(data.test_labels))
 
-    trainer = _make_trainer(args)
+    trainer = _make_trainer(args, input_shape=data.input_shape)
 
     if args.cmd == "train":
         history = trainer.fit(data)

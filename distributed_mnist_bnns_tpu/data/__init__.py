@@ -1,3 +1,5 @@
+from .cifar import CIFAR10_MEAN, CIFAR10_STD, load_cifar10
+from .common import ImageClassData
 from .mnist import (
     MnistData,
     load_idx,
@@ -8,12 +10,27 @@ from .mnist import (
     MNIST_STD,
 )
 
+
+def load_dataset(name: str, data_dir=None, **kwargs) -> ImageClassData:
+    """Dispatch to a dataset pipeline by name ("mnist" | "cifar10")."""
+    if name == "mnist":
+        return load_mnist(data_dir, **kwargs)
+    if name in ("cifar10", "cifar"):
+        return load_cifar10(data_dir, **kwargs)
+    raise ValueError(f"unknown dataset {name!r} (have: mnist, cifar10)")
+
+
 __all__ = [
+    "ImageClassData",
     "MnistData",
     "load_idx",
     "load_mnist",
+    "load_cifar10",
+    "load_dataset",
     "shard_indices",
     "batch_iterator",
     "MNIST_MEAN",
     "MNIST_STD",
+    "CIFAR10_MEAN",
+    "CIFAR10_STD",
 ]

@@ -27,10 +27,11 @@ import gzip
 import logging
 import os
 import struct
-from dataclasses import dataclass
 from typing import Iterator, Tuple
 
 import numpy as np
+
+from .common import ImageClassData, normalize_u8, synthetic_blobs
 
 log = logging.getLogger(__name__)
 
@@ -77,42 +78,19 @@ def _find_file(data_dir: str, stem: str) -> str | None:
     return None
 
 
-@dataclass
-class MnistData:
-    """Train/test images in [0,1]-then-normalized float32 NHWC, int32 labels."""
-
-    train_images: np.ndarray  # (N, 28, 28, 1) float32, normalized
-    train_labels: np.ndarray  # (N,) int32
-    test_images: np.ndarray
-    test_labels: np.ndarray
-    source: str = "mnist"  # "mnist" | "t10k-split" | "synthetic"
+# Backwards-compatible name: MNIST returns the shared dataset container.
+MnistData = ImageClassData
 
 
 def _normalize(images_u8: np.ndarray, norm: str) -> np.ndarray:
-    x = images_u8.astype(np.float32) / 255.0
-    if norm == "mnist":
-        x = (x - MNIST_MEAN) / MNIST_STD
-    elif norm == "half":
-        x = (x - 0.5) / 0.5
-    elif norm != "none":
-        raise ValueError(f"unknown norm {norm!r}")
+    x = normalize_u8(
+        images_u8, norm, stats_name="mnist", mean=MNIST_MEAN, std=MNIST_STD
+    )
     return x[..., None]  # NHWC with 1 channel
 
 
 def _synthetic(n_train: int, n_test: int, seed: int) -> Tuple[np.ndarray, ...]:
-    """Class-conditional blobs: each digit d gets a fixed random 28x28
-    template; samples are the template + noise. Linearly separable enough
-    for convergence tests while shaped exactly like MNIST."""
-    rng = np.random.RandomState(seed)
-    templates = rng.rand(10, 28, 28).astype(np.float32)
-    def make(n):
-        labels = rng.randint(0, 10, size=n).astype(np.int32)
-        imgs = templates[labels] + 0.3 * rng.randn(n, 28, 28).astype(np.float32)
-        imgs = np.clip(imgs, 0.0, 1.0)
-        return (imgs * 255).astype(np.uint8), labels
-    tr_x, tr_y = make(n_train)
-    te_x, te_y = make(n_test)
-    return tr_x, tr_y, te_x, te_y
+    return synthetic_blobs((28, 28), n_train, n_test, seed)
 
 
 def load_mnist(

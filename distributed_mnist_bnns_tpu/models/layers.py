@@ -33,7 +33,12 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..ops.binarize import STEMode, binarize, binarize_ste
-from ..ops.xnor_gemm import Backend, binary_matmul, get_default_backend
+from ..ops.xnor_gemm import (
+    Backend,
+    binary_conv2d,
+    binary_matmul,
+    get_default_backend,
+)
 
 Dtype = Any
 
@@ -163,13 +168,12 @@ class BinarizedConv(nn.Module):
             y = y.reshape(n, ho, wo, self.features)
         else:
             dtype = jnp.bfloat16 if backend == "bf16" else x.dtype
-            y = jax.lax.conv_general_dilated(
-                x.astype(dtype),
-                wb.astype(dtype),
-                window_strides=tuple(self.strides),
-                padding=self.padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                preferred_element_type=jnp.float32,
+            padding = (
+                self.padding if isinstance(self.padding, str)
+                else tuple(tuple(p) for p in self.padding)
+            )
+            y = binary_conv2d(
+                x, wb, tuple(self.strides), padding, dtype
             )
         if self.use_bias:
             bias = self.param(

@@ -1,7 +1,13 @@
 from .binarize import binarize, binarize_ste, quantize
 from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss, make_loss
 from .bitpack import pack_bits, unpack_bits, packed_dim
-from .xnor_gemm import xnor_matmul, binary_matmul, set_default_backend, get_default_backend
+from .xnor_gemm import (
+    xnor_matmul,
+    binary_matmul,
+    binary_conv2d,
+    set_default_backend,
+    get_default_backend,
+)
 
 __all__ = [
     "binarize",
@@ -16,6 +22,7 @@ __all__ = [
     "packed_dim",
     "xnor_matmul",
     "binary_matmul",
+    "binary_conv2d",
     "set_default_backend",
     "get_default_backend",
 ]

@@ -227,3 +227,61 @@ def _bmm_bwd(backend, interpret, res, g):
 
 
 binary_matmul.defvjp(_bmm_fwd, _bmm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Differentiable conv for the dense (MXU) backends
+# ---------------------------------------------------------------------------
+
+
+def _conv_fwd_impl(x, w, strides, padding, dtype):
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        w.astype(dtype),
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def binary_conv2d(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    strides: tuple = (1, 1),
+    padding="SAME",
+    dtype=jnp.bfloat16,
+):
+    """NHWC conv on ±1 (or raw first-layer) values: forward on the MXU in
+    ``dtype`` with fp32 accumulation, backward as the fp32 conv VJP.
+
+    The explicit VJP exists because JAX's transpose rule for a mixed-dtype
+    conv (bf16 operands, fp32 preferred_element_type output) rejects the
+    fp32 cotangent against the bf16 operands; computing the backward as the
+    VJP of the equivalent fp32 conv sidesteps that while keeping the exact
+    gradients the reference's autograd produces through conv2d on binarized
+    values (models/binarized_modules.py:97-104, SURVEY §3.2). Exactness of
+    the forward: ±1 operands are exactly representable in bf16 and the MXU
+    accumulates in fp32, so dense-backend conv outputs are integers, exact
+    for |dot| <= 2^24.
+    """
+    return _conv_fwd_impl(x, w, strides, padding, dtype)
+
+
+def _bconv_fwd(x, w, strides, padding, dtype):
+    return _conv_fwd_impl(x, w, strides, padding, dtype), (x, w)
+
+
+def _bconv_bwd(strides, padding, dtype, res, g):
+    x, w = res
+    _, vjp = jax.vjp(
+        lambda xx, ww: _conv_fwd_impl(xx, ww, strides, padding, jnp.float32),
+        x.astype(jnp.float32),
+        w.astype(jnp.float32),
+    )
+    gx, gw = vjp(g.astype(jnp.float32))
+    return gx.astype(x.dtype), gw.astype(w.dtype)
+
+
+binary_conv2d.defvjp(_bconv_fwd, _bconv_bwd)

@@ -1,0 +1,108 @@
+"""CIFAR-10 pipeline: on-disk layout parsing (pickle + binary), synthetic
+fallback, dispatcher, and an end-to-end CLI smoke on the XNOR-ResNet
+stretch config (BASELINE.json / SURVEY.md §7 step 8)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.data import (
+    ImageClassData,
+    load_cifar10,
+    load_dataset,
+)
+
+
+def _fake_rows(rng, n):
+    return rng.randint(0, 256, size=(n, 3072), dtype=np.uint8)
+
+
+def _write_py_layout(root, n_per_batch=4):
+    d = os.path.join(root, "cifar-10-batches-py")
+    os.makedirs(d)
+    rng = np.random.RandomState(0)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        batch = {
+            b"data": _fake_rows(rng, n_per_batch),
+            b"labels": list(rng.randint(0, 10, n_per_batch)),
+        }
+        with open(os.path.join(d, name), "wb") as f:
+            pickle.dump(batch, f)
+
+
+def _write_bin_layout(root, n_per_batch=4):
+    d = os.path.join(root, "cifar-10-batches-bin")
+    os.makedirs(d)
+    rng = np.random.RandomState(0)
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)] + [
+        "test_batch.bin"
+    ]:
+        rec = np.concatenate(
+            [
+                rng.randint(0, 10, (n_per_batch, 1)).astype(np.uint8),
+                _fake_rows(rng, n_per_batch),
+            ],
+            axis=1,
+        )
+        rec.tofile(os.path.join(d, name))
+
+
+@pytest.mark.parametrize("writer", [_write_py_layout, _write_bin_layout])
+def test_load_cifar10_layouts(tmp_path, writer):
+    writer(str(tmp_path))
+    data = load_cifar10(str(tmp_path))
+    assert data.source == "cifar10"
+    assert data.train_images.shape == (20, 32, 32, 3)
+    assert data.test_images.shape == (4, 32, 32, 3)
+    assert data.train_images.dtype == np.float32
+    assert data.train_labels.dtype == np.int32
+    assert data.train_labels.min() >= 0 and data.train_labels.max() < 10
+    assert data.input_shape == (32, 32, 3)
+
+
+def test_cifar10_channel_layout_roundtrip(tmp_path):
+    """A pixel written at (plane c, row h, col w) lands at NHWC [h, w, c]."""
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    row = np.zeros(3072, np.uint8)
+    c, h, w = 2, 5, 7
+    row[c * 1024 + h * 32 + w] = 255
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        with open(d / name, "wb") as f:
+            pickle.dump({b"data": row[None], b"labels": [3]}, f)
+    data = load_cifar10(str(tmp_path), norm="none")
+    assert data.train_images.shape == (5, 32, 32, 3)  # 5 batches of 1
+    assert data.train_images[0, h, w, c] == 1.0
+    assert data.train_images.sum() == 5.0  # exactly that pixel per image
+
+
+def test_synthetic_fallback_and_dispatch(tmp_path):
+    data = load_dataset(
+        "cifar10", str(tmp_path / "nope"), synthetic_sizes=(32, 8)
+    )
+    assert isinstance(data, ImageClassData)
+    assert data.source == "synthetic"
+    assert data.train_images.shape == (32, 32, 32, 3)
+    with pytest.raises(ValueError):
+        load_dataset("imagenet")
+
+
+def test_cli_trains_xnor_resnet_on_cifar(tmp_path):
+    from distributed_mnist_bnns_tpu.cli import main
+
+    rc = main(
+        [
+            "train",
+            "--dataset", "cifar10",
+            "--data-dir", str(tmp_path / "nope"),
+            "--synthetic-sizes", "48", "16",
+            "--model", "xnor-resnet18",
+            "--epochs", "1",
+            "--batch-size", "16",
+            "--log-file", str(tmp_path / "log.txt"),
+            "--results", str(tmp_path / "results.csv"),
+        ]
+    )
+    assert rc == 0

@@ -63,3 +63,42 @@ def test_binary_matmul_jit():
     w = _pm1(jax.random.PRNGKey(9), (128, 8))
     f = jax.jit(lambda a, b: binary_matmul(a, b, "xnor"))
     np.testing.assert_array_equal(np.asarray(f(x, w)), np.asarray(jnp.dot(x, w)))
+
+
+def test_binary_conv2d_exact_and_grads():
+    """bf16-MXU conv forward is exact on ±1 operands and its explicit VJP
+    matches the fp32 conv's gradients (the transpose rule of a mixed-dtype
+    conv would reject the fp32 cotangent — the reason binary_conv2d exists)."""
+    from distributed_mnist_bnns_tpu.ops import binary_conv2d
+
+    x = _pm1(jax.random.PRNGKey(10), (2, 8, 8, 16))
+    w = _pm1(jax.random.PRNGKey(11), (3, 3, 16, 8))
+
+    def fp32_conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    out = binary_conv2d(x, w, (1, 1), "SAME", jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(fp32_conv(x, w)))
+
+    def loss_b(x, w):
+        return (binary_conv2d(x, w, (1, 1), "SAME", jnp.bfloat16) ** 2).sum()
+
+    def loss_f(x, w):
+        return (fp32_conv(x, w) ** 2).sum()
+
+    gb = jax.grad(loss_b, argnums=(0, 1))(x, w)
+    gf = jax.grad(loss_f, argnums=(0, 1))(x, w)
+    for a, b in zip(gb, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+    # strided + jitted under value_and_grad (the Trainer's usage pattern)
+    f = jax.jit(
+        lambda x, w: jax.value_and_grad(
+            lambda xx: (binary_conv2d(xx, w, (2, 2), "SAME", jnp.bfloat16)).sum()
+        )(x)
+    )
+    v, g = f(x, w)
+    assert np.isfinite(float(v)) and np.isfinite(np.asarray(g)).all()
