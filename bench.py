@@ -26,8 +26,12 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--backend", default="bf16",
-                   choices=["xla", "bf16", "xnor", "pallas_xnor"])
+                   choices=["xla", "bf16", "int8", "xnor", "pallas_xnor"])
     p.add_argument("--model", default="bnn-mlp-large")
+    p.add_argument("--input-shape", type=int, nargs=3, default=None,
+                   metavar=("H", "W", "C"),
+                   help="default: (28,28,1); xnor-resnet models get the "
+                        "CIFAR shape (32,32,3)")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
@@ -35,6 +39,13 @@ def main() -> None:
     import jax.numpy as jnp
 
     from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    if args.input_shape is not None:
+        input_shape = tuple(args.input_shape)
+    elif args.model.startswith("xnor-resnet"):
+        input_shape = (32, 32, 3)
+    else:
+        input_shape = (28, 28, 1)
 
     config = TrainConfig(
         model=args.model,
@@ -44,10 +55,12 @@ def main() -> None:
         backend=args.backend,
         seed=0,
     )
-    trainer = Trainer(config)
+    trainer = Trainer(config, input_shape=input_shape)
 
     key = jax.random.PRNGKey(0)
-    images = jax.random.normal(key, (args.batch_size, 28, 28, 1), jnp.float32)
+    images = jax.random.normal(
+        key, (args.batch_size, *input_shape), jnp.float32
+    )
     labels = jax.random.randint(key, (args.batch_size,), 0, 10)
     images = jax.device_put(images)
     labels = jax.device_put(labels)
@@ -82,12 +95,22 @@ def main() -> None:
     step_time = max((t_long - t_short) / steps, 1e-9)
     metrics = {"loss": last_loss}
     ips = args.batch_size / step_time
-    baseline_ips = 7270.0  # BASELINE.md derived throughput
+    # The baseline only describes the flagship model (BASELINE.md covers
+    # mnist-dist2.py's bnn-mlp-large); any other model has no reference
+    # number to compare against.
+    baseline_ips = 7270.0 if args.model == "bnn-mlp-large" else None
+    metric_name = (
+        "train_throughput_mnist_bnn_mlp_large"
+        if args.model == "bnn-mlp-large"
+        else f"train_throughput_{args.model.replace('-', '_')}"
+    )
     result = {
-        "metric": "train_throughput_mnist_bnn_mlp_large",
+        "metric": metric_name,
         "value": round(ips, 1),
         "unit": "images/sec",
-        "vs_baseline": round(ips / baseline_ips, 2),
+        "vs_baseline": (
+            round(ips / baseline_ips, 2) if baseline_ips else None
+        ),
         "batch_size": args.batch_size,
         "step_time_ms": round(step_time * 1e3, 3),
         "epoch_time_equiv_s": round(60000.0 / ips, 3),

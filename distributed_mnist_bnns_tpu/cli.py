@@ -39,7 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--seed", type=int, default=42)
         sp.add_argument("--log-interval", type=int, default=100)
         sp.add_argument("--backend", default=None,
-                        choices=[None, "xla", "bf16", "xnor", "pallas_xnor"])
+                        choices=[None, "xla", "bf16", "int8", "xnor", "pallas_xnor"])
         sp.add_argument("--stochastic", action="store_true",
                         help="stochastic activation binarization "
                              "(reference quant_mode='stoch')")

@@ -79,11 +79,17 @@ def _load_bin_batches(d: str) -> Tuple[np.ndarray, ...] | None:
         return None
 
     def load(name):
-        rec = np.fromfile(os.path.join(d, name), np.uint8).reshape(-1, 3073)
+        raw = np.fromfile(os.path.join(d, name), np.uint8)
+        if raw.size == 0 or raw.size % 3073:
+            return None  # truncated/corrupt — treat the layout as absent
+        rec = raw.reshape(-1, 3073)
         return rec[:, 1:], rec[:, 0].astype(np.int32)
 
-    xs, ys = zip(*(load(n) for n in names))
-    te_x, te_y = load("test_batch.bin")
+    loaded = [load(n) for n in names + ["test_batch.bin"]]
+    if any(b is None for b in loaded):
+        return None
+    xs, ys = zip(*loaded[:-1])
+    te_x, te_y = loaded[-1]
     return (
         _rows_to_nhwc(np.concatenate(xs)),
         np.concatenate(ys),

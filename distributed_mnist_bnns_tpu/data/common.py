@@ -26,10 +26,6 @@ class ImageClassData:
     def input_shape(self):
         return tuple(self.train_images.shape[1:])
 
-    @property
-    def num_classes(self) -> int:
-        return int(self.train_labels.max()) + 1
-
 
 def normalize_u8(
     images_u8: np.ndarray,

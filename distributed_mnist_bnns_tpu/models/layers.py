@@ -58,6 +58,16 @@ def _latent_init(scale: float = 1.0) -> Callable:
     return init
 
 
+def _layer_backend(mdl: nn.Module) -> Backend:
+    """Resolve this layer's GEMM backend. The int8 MXU path is only exact
+    on ±1 operands, so first layers fed raw (non-binarized) activations
+    fall back to bf16 — matching the reference's fp32 first layer."""
+    backend = mdl.backend or get_default_backend()
+    if backend == "int8" and not mdl.binarize_input:
+        return "bf16"
+    return backend
+
+
 def _binarize_activations(
     mdl: nn.Module, x: jnp.ndarray, stochastic: bool, ste: STEMode
 ) -> jnp.ndarray:
@@ -103,9 +113,8 @@ class BinarizedDense(nn.Module):
             x = _binarize_activations(self, x, self.stochastic, self.ste)
         wb = binarize_ste(kernel, self.ste)
         lead = x.shape[:-1]
-        y = binary_matmul(
-            x.reshape(-1, x.shape[-1]), wb, self.backend or get_default_backend()
-        )
+        backend = _layer_backend(self)
+        y = binary_matmul(x.reshape(-1, x.shape[-1]), wb, backend)
         y = y.reshape(*lead, self.features)
         if self.use_bias:
             bias = self.param(
@@ -149,7 +158,7 @@ class BinarizedConv(nn.Module):
             x = _binarize_activations(self, x, self.stochastic, self.ste)
         wb = binarize_ste(kernel, self.ste)
 
-        backend = self.backend or get_default_backend()
+        backend = _layer_backend(self)
         if backend in ("xnor", "pallas_xnor"):
             # Patch-extraction (im2col) + bitplane GEMM: each output pixel's
             # receptive field becomes a K=kh*kw*in_ch ±1 dot product.
@@ -167,7 +176,9 @@ class BinarizedConv(nn.Module):
             y = binary_matmul(patches.reshape(-1, k), wmat, backend)
             y = y.reshape(n, ho, wo, self.features)
         else:
-            dtype = jnp.bfloat16 if backend == "bf16" else x.dtype
+            dtype = {"bf16": jnp.bfloat16, "int8": jnp.int8}.get(
+                backend, x.dtype
+            )
             padding = (
                 self.padding if isinstance(self.padding, str)
                 else tuple(tuple(p) for p in self.padding)

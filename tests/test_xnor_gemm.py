@@ -33,7 +33,7 @@ def test_pallas_xnor_interpret_matches_fp32(m, k, n):
     np.testing.assert_array_equal(out, oracle)
 
 
-@pytest.mark.parametrize("backend", ["xla", "bf16", "xnor"])
+@pytest.mark.parametrize("backend", ["xla", "bf16", "int8", "xnor"])
 def test_binary_matmul_backends_exact(backend):
     x = _pm1(jax.random.PRNGKey(4), (8, 256))
     w = _pm1(jax.random.PRNGKey(5), (256, 32))
@@ -102,3 +102,54 @@ def test_binary_conv2d_exact_and_grads():
     )
     v, g = f(x, w)
     assert np.isfinite(float(v)) and np.isfinite(np.asarray(g)).all()
+
+
+def test_int8_backend_trains_with_bf16_first_layer_fallback():
+    """int8 MXU path end-to-end: hidden binarized layers run int8, the raw
+    first layer silently falls back to bf16 (raw pixels are not ±1), and a
+    train step produces finite loss and grads identical to the bf16 path
+    (both backends are exact on ±1 operands)."""
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.models import BnnMLP, latent_clamp_mask
+    from distributed_mnist_bnns_tpu.train import make_train_step
+    from distributed_mnist_bnns_tpu.train.trainer import TrainState
+    import optax
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 10)
+
+    losses = {}
+    for backend in ("bf16", "int8"):
+        model = BnnMLP(hidden=(96, 64, 32), backend=backend)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(2), "dropout": jax.random.PRNGKey(3)},
+            x, train=True,
+        )
+        tx = optax.sgd(0.1)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(variables["params"]),
+            apply_fn=model.apply, tx=tx,
+        )
+        step = make_train_step(latent_clamp_mask(variables["params"]),
+                               donate=False)
+        new_state, metrics = step(state, x, y, jax.random.PRNGKey(4))
+        losses[backend] = float(metrics["loss"])
+        assert np.isfinite(losses[backend])
+    assert losses["int8"] == pytest.approx(losses["bf16"], rel=1e-5)
+
+
+def test_binary_conv2d_int8_exact():
+    from distributed_mnist_bnns_tpu.ops import binary_conv2d
+
+    x = _pm1(jax.random.PRNGKey(12), (2, 8, 8, 16))
+    w = _pm1(jax.random.PRNGKey(13), (3, 3, 16, 8))
+    oracle = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    out = binary_conv2d(x, w, (1, 1), "SAME", jnp.int8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(oracle))
