@@ -38,8 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--lr", type=float, default=0.01)
         sp.add_argument("--seed", type=int, default=42)
         sp.add_argument("--log-interval", type=int, default=100)
+        from .ops.xnor_gemm import BACKENDS
+
         sp.add_argument("--backend", default=None,
-                        choices=[None, "xla", "bf16", "int8", "xnor", "pallas_xnor"])
+                        choices=[None, *BACKENDS])
         sp.add_argument("--stochastic", action="store_true",
                         help="stochastic activation binarization "
                              "(reference quant_mode='stoch')")
@@ -51,6 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--precision", default="fp32",
                         choices=["fp32", "bf16"],
                         help="bf16 = mixed precision (AMP O2 parity)")
+        sp.add_argument("--remat", action="store_true",
+                        help="rematerialize activations in backward "
+                             "(jax.checkpoint) to cut HBM use")
         sp.add_argument("--dataset", default="mnist",
                         choices=["mnist", "cifar10"])
         sp.add_argument("--data-dir", default=None)
@@ -111,6 +116,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
         profile_dir=args.profile_dir,
+        remat=args.remat,
     )
     return Trainer(config, input_shape=input_shape)
 

@@ -1,5 +1,5 @@
 from .cifar import CIFAR10_MEAN, CIFAR10_STD, load_cifar10
-from .common import ImageClassData
+from .common import ImageClassData, prefetch_to_device
 from .mnist import (
     MnistData,
     load_idx,
@@ -22,6 +22,7 @@ def load_dataset(name: str, data_dir=None, **kwargs) -> ImageClassData:
 
 __all__ = [
     "ImageClassData",
+    "prefetch_to_device",
     "MnistData",
     "load_idx",
     "load_mnist",

@@ -72,3 +72,32 @@ def synthetic_blobs(
     tr_x, tr_y = make(n_train)
     te_x, te_y = make(n_test)
     return tr_x, tr_y, te_x, te_y
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Wrap a host batch iterator so device transfers run ahead of compute.
+
+    Keeps ``size`` batches in flight: each is jax.device_put (optionally
+    with a Sharding for distributed layouts) as soon as a slot frees, so the
+    H2D copy of batch k+1 overlaps the computation of batch k — the role
+    torch DataLoader's pin_memory/non_blocking copy plays in the reference's
+    hot loop (mnist-dist2.py:119-120), done JAX-natively. device_put is
+    async; the queue just bounds how far the host runs ahead.
+    """
+    import collections
+
+    import jax
+
+    queue = collections.deque()
+
+    def put(batch):
+        if sharding is None:
+            return jax.tree.map(jax.device_put, batch)
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+    for batch in iterator:
+        queue.append(put(batch))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -57,6 +57,7 @@ def make_dp_train_step(
     *,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    remat: bool = False,
 ) -> Callable:
     """GSPMD data-parallel train step (grad all-reduce inserted by XLA)."""
 
@@ -73,6 +74,9 @@ def make_dp_train_step(
                 mutable=["batch_stats"],
             )
             return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
+
+        if remat:
+            compute_loss = jax.checkpoint(compute_loss)
 
         (loss, (outs, new_bs)), grads = jax.value_and_grad(
             compute_loss, has_aux=True

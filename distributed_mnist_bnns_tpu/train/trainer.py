@@ -38,7 +38,7 @@ import numpy as np
 import optax
 from flax import struct
 
-from ..data import batch_iterator
+from ..data import batch_iterator, prefetch_to_device
 from ..models import get_model, latent_clamp_mask
 from ..ops.losses import cross_entropy_loss
 from ..utils.checkpoint import (
@@ -76,8 +76,15 @@ def make_train_step(
     *,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    remat: bool = False,
 ) -> Callable:
-    """Build the jitted train step: fwd -> loss -> bwd -> optax -> clamp."""
+    """Build the jitted train step: fwd -> loss -> bwd -> optax -> clamp.
+
+    ``remat=True`` wraps the forward in jax.checkpoint, discarding
+    activations and recomputing them in backward — the HBM-for-FLOPs trade
+    that lets batch sizes (or models) that would not otherwise fit run on a
+    chip. No reference counterpart (SURVEY §5: no memory management at all);
+    this is a TPU-first addition."""
 
     def train_step(
         state: TrainState,
@@ -97,6 +104,9 @@ def make_train_step(
                 mutable=["batch_stats"],
             )
             return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
+
+        if remat:
+            compute_loss = jax.checkpoint(compute_loss)
 
         (loss, (outs, new_bs)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
@@ -169,6 +179,7 @@ class TrainConfig:
     save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
     resume: bool = False           # restore latest checkpoint before fit
     data_parallel: Optional[object] = None  # None | "auto" | int devices
+    remat: bool = False            # jax.checkpoint the forward (HBM saver)
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     profile_steps: int = 5
 
@@ -217,7 +228,10 @@ class Trainer:
         from ..ops.losses import make_loss
 
         loss_fn = make_loss(config.loss)
-        self.train_step = make_train_step(self.clamp_mask, loss_fn=loss_fn)
+        self._loss_fn = loss_fn
+        self.train_step = make_train_step(
+            self.clamp_mask, loss_fn=loss_fn, remat=config.remat
+        )
         self.eval_step = make_eval_step(loss_fn=loss_fn)
         self.mesh = None
         if config.data_parallel:
@@ -250,10 +264,8 @@ class Trainer:
         the DistributedDataParallel wrap of the reference
         (mnist-dist2.py:93), done declaratively."""
         from ..parallel import (  # local import: parallel depends on train
-            make_dp_train_step,
             make_mesh,
             replicate,
-            shard_batch,
         )
 
         dp = self.config.data_parallel
@@ -266,7 +278,17 @@ class Trainer:
                 f"data_parallel={n}"
             )
         self.mesh = make_mesh(data=n)
-        dp_step = make_dp_train_step(self.clamp_mask, self.mesh, loss_fn=loss_fn)
+        self._set_dp_step(loss_fn)
+        self.state = replicate(self.state, self.mesh)
+        log.info("data-parallel over %d devices", n)
+
+    def _set_dp_step(self, loss_fn) -> None:
+        from ..parallel import make_dp_train_step, shard_batch
+
+        dp_step = make_dp_train_step(
+            self.clamp_mask, self.mesh, loss_fn=loss_fn,
+            remat=self.config.remat,
+        )
         mesh = self.mesh
 
         def step(state, images, labels, rng):
@@ -275,8 +297,6 @@ class Trainer:
             )
 
         self.train_step = step
-        self.state = replicate(self.state, mesh)
-        log.info("data-parallel over %d devices", n)
 
     def _eval_state(self):
         """Single-device copy of the state for (variable-batch) eval when
@@ -306,7 +326,16 @@ class Trainer:
             self.state = self.state.replace(
                 tx=tx, opt_state=tx.init(self.state.params)
             )
-            self.train_step = make_train_step(self.clamp_mask)
+            # Rebuild the step with the same loss/remat config — and the DP
+            # wrapper if training data-parallel (a bare rebuild would
+            # silently drop the mesh sharding).
+            if self.mesh is not None:
+                self._set_dp_step(self._loss_fn)
+            else:
+                self.train_step = make_train_step(
+                    self.clamp_mask, loss_fn=self._loss_fn,
+                    remat=self.config.remat,
+                )
         hp = getattr(self.state.opt_state, "hyperparams", None)
         if hp is not None and "learning_rate" in hp:
             hp["learning_rate"] = jnp.asarray(
@@ -330,6 +359,10 @@ class Trainer:
             host_id=jax.process_index(),
             num_hosts=jax.process_count(),
         )
+        if self.mesh is None:
+            # Run H2D copies ahead of compute (the DP step shards its own
+            # inputs, so prefetch only applies to the single-mesh path).
+            it = prefetch_to_device(it)
         # Profile the first epoch actually run (resume may skip epoch 0);
         # stop_trace in a finally so a failing step can't leave the global
         # profiler started (which would crash any later start_trace).

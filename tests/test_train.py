@@ -183,3 +183,61 @@ def test_stochastic_binarization_live_through_trainer():
     _, m1 = trainer.train_step(copy(), images, labels, jax.random.PRNGKey(1))
     _, m2 = trainer.train_step(copy(), images, labels, jax.random.PRNGKey(2))
     assert float(m1["loss"]) != float(m2["loss"])
+
+
+def test_remat_train_step_matches_plain():
+    """jax.checkpoint must not change numerics — only memory/FLOPs."""
+    import optax
+
+    from distributed_mnist_bnns_tpu.models import BnnMLP, latent_clamp_mask
+    from distributed_mnist_bnns_tpu.train.trainer import (
+        TrainState,
+        make_train_step,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 10)
+    model = BnnMLP(hidden=(64, 32, 16))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(2), "dropout": jax.random.PRNGKey(3)},
+        x, train=True,
+    )
+    tx = optax.adam(1e-2)
+
+    def fresh_state():
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(variables["params"]),
+            apply_fn=model.apply, tx=tx,
+        )
+
+    mask = latent_clamp_mask(variables["params"])
+    rng = jax.random.PRNGKey(4)
+    plain = make_train_step(mask, donate=False)
+    remat = make_train_step(mask, donate=False, remat=True)
+    s1, m1 = plain(fresh_state(), x, y, rng)
+    s2, m2 = remat(fresh_state(), x, y, rng)
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), abs=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        s1.params, s2.params,
+    )
+
+
+def test_prefetch_to_device_preserves_order_and_values():
+    from distributed_mnist_bnns_tpu.data.common import prefetch_to_device
+
+    batches = [
+        (np.full((4, 2), i, np.float32), np.full((4,), i, np.int32))
+        for i in range(7)
+    ]
+    out = list(prefetch_to_device(iter(batches), size=3))
+    assert len(out) == 7
+    for i, (xb, yb) in enumerate(out):
+        assert float(np.asarray(xb)[0, 0]) == i
+        assert int(np.asarray(yb)[0]) == i
+        assert isinstance(xb, jax.Array)
