@@ -1,6 +1,7 @@
 from .binarize import binarize, binarize_ste, quantize
 from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss, make_loss
 from .bitpack import pack_bits, unpack_bits, packed_dim
+from .flash_attention import flash_attention
 from .xnor_gemm import (
     xnor_matmul,
     binary_matmul,
@@ -23,6 +24,7 @@ __all__ = [
     "xnor_matmul",
     "binary_matmul",
     "binary_conv2d",
+    "flash_attention",
     "set_default_backend",
     "get_default_backend",
 ]

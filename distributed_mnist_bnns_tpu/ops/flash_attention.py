@@ -1,0 +1,222 @@
+"""Flash attention as a Pallas TPU kernel.
+
+No reference counterpart (the reference has no attention at all, SURVEY §5
+"long-context: absent"); this is the on-chip half of the framework's
+long-context story. parallel/ring_attention.py scales sequence length
+*across* chips (K/V stream over ICI with online-softmax accumulation);
+this kernel is the same online-softmax algorithm *within* a chip: Q blocks
+stay resident in VMEM, K/V blocks stream through as the innermost
+(sequential) grid dimension, and the running (max, denom, accumulator)
+carry lives in VMEM scratch that persists across those grid steps — so the
+(Lq, Lk) score matrix never materializes in HBM.
+
+Exactness: same math as softmax(QK^T)V with fp32 accumulation; the only
+difference from the naive oracle is reassociation of the exp/sum, the
+standard flash rescaling.
+
+Backward: custom_vjp that recomputes attention in fp32 and differentiates
+the oracle — O(L^2) memory in backward, fine at the sizes this framework
+trains; the forward kernel is the HBM-bound hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # finite stand-in for -inf (keeps exp() NaN-free)
+
+
+def _pick_block(n: int, cap: int = 512) -> int:
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if cand <= cap and n % cand == 0:
+            return cand
+    return n
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, scale: float, causal: bool, n_kblocks: int
+):
+    """One (batch*head, q-block, k-block) grid step.
+
+    Scratch (persists across the sequential k-block axis):
+      m_ref  (bq, 1)  running row max
+      l_ref  (bq, 1)  running softmax denominator
+      acc_ref(bq, d)  running output numerator
+    """
+    from jax.experimental import pallas as pl
+
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _reset():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # (bq, d)
+    k = k_ref[0]  # (bk, d)
+    v = v_ref[0]  # (bk, d)
+    bq, bk = q.shape[0], k.shape[0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (bq, bk)
+    if causal:
+        q_pos = pl.program_id(1) * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0
+        )
+        k_pos = kk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+    m_prev = m_ref[...]
+    l_prev = l_ref[...]
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    # Guard fully-masked blocks: with every score at NEG_INF, m_new stays
+    # NEG_INF and exp(s - m_new) would be exp(0)=1; zero those explicitly.
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+    corr = jnp.where(
+        m_prev > NEG_INF / 2, jnp.exp(m_prev - m_new), 0.0
+    )
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(kk == n_kblocks - 1)
+    def _finalize():
+        l = l_ref[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = acc_ref[...] / safe_l
+        # log-sum-exp of this device's scores per q row — what a ring-level
+        # merge needs to combine per-shard results exactly.
+        lse_ref[0] = jnp.where(
+            l == 0.0, NEG_INF, m_ref[...] + jnp.log(safe_l)
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_fwd_impl(
+    q3: jnp.ndarray, k3: jnp.ndarray, v3: jnp.ndarray,
+    *, causal: bool, block_q: int, block_k: int, interpret: bool
+) -> jnp.ndarray:
+    """(BH, L, D) flash attention."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    bq = _pick_block(lq, block_q)
+    bk = _pick_block(lk, block_k)
+    n_kblocks = lk // bk
+    scale = d**-0.5
+
+    return pl.pallas_call(
+        functools.partial(
+            _flash_kernel, scale=scale, causal=causal, n_kblocks=n_kblocks
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+        ),
+        grid=(bh, lq // bq, n_kblocks),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, kk: (b, kk, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, kk: (b, i, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _oracle_with_lse(q, k, v, causal):
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        scores = jnp.where(mask, scores, NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)  # (B, H, Lq)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out, lse.transpose(0, 2, 1)  # lse as (B, Lq, H)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas flash attention over (B, L, H, D) inputs.
+
+    Forward streams K/V blocks through VMEM (no (L, L) materialization);
+    backward differentiates the fp32 oracle. ``interpret=True`` runs the
+    kernel in interpreter mode for CPU tests. Output dtype matches q.
+    """
+    out, _ = flash_attention_with_lse(q, k, v, causal, interpret)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    interpret: bool = False,
+):
+    """Like flash_attention, additionally returning the per-row
+    log-sum-exp (B, L, H) — the quantity a cross-device (ring) merge needs
+    to combine per-shard attention results exactly. Differentiable: the
+    VJP recomputes the fp32 oracle and propagates both cotangents, so
+    downstream uses of the lse (e.g. the ring merge weights) get exact
+    gradients."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    o3, lse3 = _flash_fwd_impl(
+        q3, k3, v3, causal=causal, block_q=512, block_k=512,
+        interpret=interpret,
+    )
+    out = o3.reshape(b, h, lq, d).transpose(0, 2, 1, 3).astype(q.dtype)
+    lse = lse3.reshape(b, h, lq).transpose(0, 2, 1)
+    return out, lse
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    return flash_attention_with_lse(q, k, v, causal, interpret), (q, k, v)
+
+
+def _fa_bwd(causal, interpret, res, g):
+    q, k, v = res
+    f32 = jnp.float32
+    _, vjp = jax.vjp(
+        lambda q, k, v: _oracle_with_lse(q, k, v, causal),
+        q.astype(f32), k.astype(f32), v.astype(f32),
+    )
+    g_out, g_lse = g
+    gq, gk, gv = vjp((g_out.astype(f32), g_lse.astype(f32)))
+    return gq.astype(q.dtype), gk.astype(k.dtype), gv.astype(v.dtype)
+
+
+flash_attention_with_lse.defvjp(_fa_fwd, _fa_bwd)

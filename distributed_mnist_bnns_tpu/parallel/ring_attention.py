@@ -60,7 +60,8 @@ def _block_attn_update(q, k, v, m, l, acc, *, scale, mask=None):
 
 
 def make_ring_attention(
-    mesh: Mesh, *, axis: str = "seq", causal: bool = False
+    mesh: Mesh, *, axis: str = "seq", causal: bool = False,
+    local: str = "dense", interpret: bool = False,
 ):
     """Build a jitted ring-attention fn over ``mesh``'s ``axis``.
 
@@ -68,8 +69,24 @@ def make_ring_attention(
     (placement handled by in_shardings), computing exact attention.
     With causal=True, block masking uses the global positions implied by
     each shard's ring offset.
+
+    ``local`` picks the per-device block computation:
+      * "dense" — einsum online-softmax update (always available);
+      * "flash" — the Pallas flash kernel (ops/flash_attention.py): each
+        ring step computes its K/V shard's attention entirely in VMEM and
+        returns (out, lse); shards merge by log-sum-exp rescaling, which
+        is algebraically the same online softmax at shard granularity.
+        Non-causal only (per-shard causal offsets are ring-step-dependent).
     """
     n_shards = mesh.shape[axis]
+    if local == "flash":
+        if causal:
+            raise NotImplementedError(
+                "local='flash' supports causal=False only"
+            )
+        return _make_ring_flash(mesh, axis, n_shards, interpret)
+    if local != "dense":
+        raise ValueError(f"unknown local={local!r} (have: dense, flash)")
 
     def local_fn(q, k, v):
         # per-device shapes: (B, Lloc, H, D)
@@ -101,6 +118,59 @@ def make_ring_attention(
             0, n_shards, body, (m, l, acc, k, v)
         )
         return acc / jnp.moveaxis(l, 1, 2)
+
+    seq_sharded = P(None, axis, None, None)
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(seq_sharded,) * 3,
+        out_specs=seq_sharded,
+        check_vma=False,
+    )
+    sh = NamedSharding(mesh, seq_sharded)
+    return jax.jit(fn, in_shardings=(sh,) * 3, out_shardings=sh)
+
+
+def _make_ring_flash(mesh: Mesh, axis: str, n_shards: int, interpret: bool):
+    """Ring attention with the Pallas flash kernel as the local step.
+
+    Each ring step computes full attention of the resident Q shard against
+    the currently-held K/V shard on-chip (ops/flash_attention.py) and
+    yields (out_i, lse_i); shards merge via the online log-sum-exp
+    rescaling — exp weights are reassociated exactly as in flash itself,
+    so the result equals full attention."""
+    from ..ops.flash_attention import NEG_INF, flash_attention_with_lse
+
+    def local_fn(q, k, v):
+        b, lq, h, d = q.shape
+        m_run = jnp.full((b, lq, h), NEG_INF, jnp.float32)
+        den = jnp.zeros((b, lq, h), jnp.float32)
+        num = jnp.zeros((b, lq, h, d), jnp.float32)
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+        def body(step, carry):
+            m_run, den, num, k_cur, v_cur = carry
+            o_i, lse_i = flash_attention_with_lse(
+                q, k_cur, v_cur, False, interpret
+            )
+            m_new = jnp.maximum(m_run, lse_i)
+            w_old = jnp.where(
+                m_run > NEG_INF / 2, jnp.exp(m_run - m_new), 0.0
+            )
+            w_new = jnp.where(
+                lse_i > NEG_INF / 2, jnp.exp(lse_i - m_new), 0.0
+            )
+            den = den * w_old + w_new
+            num = num * w_old[..., None] + o_i * w_new[..., None]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return m_new, den, num, k_nxt, v_nxt
+
+        m_run, den, num, _, _ = jax.lax.fori_loop(
+            0, n_shards, body, (m_run, den, num, k, v)
+        )
+        out = num / jnp.where(den == 0.0, 1.0, den)[..., None]
+        return out.astype(q.dtype)
 
     seq_sharded = P(None, axis, None, None)
     fn = jax.shard_map(
