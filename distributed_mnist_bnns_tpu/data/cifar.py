@@ -79,23 +79,28 @@ def _load_bin_batches(d: str) -> Tuple[np.ndarray, ...] | None:
         return None
 
     def load(name):
-        raw = np.fromfile(os.path.join(d, name), np.uint8)
-        if raw.size == 0 or raw.size % 3073:
+        path = os.path.join(d, name)
+        size = os.path.getsize(path)
+        if size == 0 or size % 3073:
             return None  # truncated/corrupt — treat the layout as absent
-        rec = raw.reshape(-1, 3073)
-        return rec[:, 1:], rec[:, 0].astype(np.int32)
+        n = size // 3073
+        try:  # native decoder fuses the CHW->HWC transpose into the read
+            from .. import native
+
+            decoded = native.cifar_bin_decode_native(path, n)
+            if decoded is not None:
+                return decoded
+        except Exception:  # pragma: no cover - fall through to numpy
+            pass
+        rec = np.fromfile(path, np.uint8).reshape(-1, 3073)
+        return _rows_to_nhwc(rec[:, 1:]), rec[:, 0].astype(np.int32)
 
     loaded = [load(n) for n in names + ["test_batch.bin"]]
     if any(b is None for b in loaded):
         return None
     xs, ys = zip(*loaded[:-1])
     te_x, te_y = loaded[-1]
-    return (
-        _rows_to_nhwc(np.concatenate(xs)),
-        np.concatenate(ys),
-        _rows_to_nhwc(te_x),
-        te_y,
-    )
+    return np.concatenate(xs), np.concatenate(ys), te_x, te_y
 
 
 def _synthetic(n_train: int, n_test: int, seed: int) -> Tuple[np.ndarray, ...]:

@@ -29,20 +29,34 @@ def _load() -> Optional[ctypes.CDLL]:
     _tried = True
     if os.environ.get("DMB_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_SO):
-        try:
-            subprocess.run(
-                ["make", "-s"], cwd=_DIR, check=True, capture_output=True,
-                timeout=120,
-            )
-        except Exception as e:  # pragma: no cover - toolchain always present
-            log.debug("native build failed (%s); using python fallback", e)
+    # Always invoke make: the Makefile's dependency tracking makes this a
+    # no-op when the .so is current, and it rebuilds a stale .so from an
+    # older source revision (whose missing symbols would otherwise break
+    # the bindings below).
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_DIR, check=True, capture_output=True,
+            timeout=120,
+        )
+    except Exception as e:  # pragma: no cover - toolchain always present
+        log.debug("native build failed (%s); using python fallback", e)
+        if not os.path.exists(_SO):
             return None
     try:
         lib = ctypes.CDLL(_SO)
     except OSError as e:  # pragma: no cover
         log.debug("native load failed (%s)", e)
         return None
+    try:
+        _bind(lib)
+    except AttributeError as e:  # pragma: no cover - stale .so, no rebuild
+        log.debug("native symbols missing (%s); using python fallback", e)
+        return None
+    _lib = lib
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.idx_header.restype = ctypes.c_int
     lib.idx_header.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
     lib.idx_read_u8.restype = ctypes.c_int
@@ -59,8 +73,11 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
     ]
-    _lib = lib
-    return _lib
+    lib.cifar_bin_decode.restype = ctypes.c_int
+    lib.cifar_bin_decode.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ]
 
 
 def available() -> bool:
@@ -120,3 +137,23 @@ def pack_bits_native(x: np.ndarray) -> Optional[np.ndarray]:
         rows, k, kw,
     )
     return out
+
+
+def cifar_bin_decode_native(path: str, n_records: int):
+    """Decode a CIFAR-10 binary batch to (images_nhwc_u8, labels_i32);
+    None if the library is unavailable. The CHW->HWC transpose is fused
+    into the single file-read pass."""
+    lib = _load()
+    if lib is None:
+        return None
+    images = np.empty((n_records, 32, 32, 3), dtype=np.uint8)
+    labels_u8 = np.empty((n_records,), dtype=np.uint8)
+    rc = lib.cifar_bin_decode(
+        path.encode(),
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        labels_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_records,
+    )
+    if rc != 0:
+        raise ValueError(f"{path}: cifar bin decode failed (code {rc})")
+    return images, labels_u8.astype(np.int32)

@@ -75,4 +75,31 @@ int pack_bits_pm1(const float* in, int32_t* out, int64_t rows, int64_t k,
     return 0;
 }
 
+// Decode one CIFAR-10 binary batch file: n records of [label u8 |
+// 3072 u8 pixels in CHW (plane-major) order]. Writes labels[0..n) and
+// images in NHWC order (n*32*32*3) — the transpose the python loader does
+// with numpy, fused into the single read pass here.
+int cifar_bin_decode(const char* path, uint8_t* images_nhwc,
+                     uint8_t* labels, int64_t n_records) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    const int64_t HW = 32 * 32, REC = 1 + 3 * HW;
+    unsigned char rec[1 + 3 * 32 * 32];
+    for (int64_t r = 0; r < n_records; ++r) {
+        if (std::fread(rec, 1, (size_t)REC, f) != (size_t)REC) {
+            std::fclose(f);
+            return -4;
+        }
+        labels[r] = rec[0];
+        uint8_t* dst = images_nhwc + r * 3 * HW;
+        for (int64_t px = 0; px < HW; ++px) {
+            dst[px * 3 + 0] = rec[1 + 0 * HW + px];
+            dst[px * 3 + 1] = rec[1 + 1 * HW + px];
+            dst[px * 3 + 2] = rec[1 + 2 * HW + px];
+        }
+    }
+    std::fclose(f);
+    return 0;
+}
+
 }  // extern "C"

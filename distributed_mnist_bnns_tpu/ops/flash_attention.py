@@ -38,7 +38,7 @@ def _pick_block(n: int, cap: int = 512) -> int:
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, scale: float, causal: bool, n_kblocks: int
+    *, scale: float, causal: bool, n_kblocks: int, causal_offset: int
 ):
     """One (batch*head, q-block, k-block) grid step.
 
@@ -66,11 +66,13 @@ def _flash_kernel(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # (bq, bk)
     if causal:
+        # Bottom-right alignment for Lq != Lk (matching jnp.tril with
+        # k = Lk - Lq): query row i attends keys [0, i + Lk - Lq].
         q_pos = pl.program_id(1) * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, bk), 0
         )
         k_pos = kk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        s = jnp.where(k_pos <= q_pos + causal_offset, s, NEG_INF)
 
     m_prev = m_ref[...]
     l_prev = l_ref[...]
@@ -120,7 +122,8 @@ def _flash_fwd_impl(
 
     return pl.pallas_call(
         functools.partial(
-            _flash_kernel, scale=scale, causal=causal, n_kblocks=n_kblocks
+            _flash_kernel, scale=scale, causal=causal, n_kblocks=n_kblocks,
+            causal_offset=lk - lq,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
@@ -172,7 +175,7 @@ def flash_attention(
     kernel in interpreter mode for CPU tests. Output dtype matches q.
     """
     out, _ = flash_attention_with_lse(q, k, v, causal, interpret)
-    return out
+    return out.astype(q.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -185,10 +188,12 @@ def flash_attention_with_lse(
 ):
     """Like flash_attention, additionally returning the per-row
     log-sum-exp (B, L, H) — the quantity a cross-device (ring) merge needs
-    to combine per-shard attention results exactly. Differentiable: the
-    VJP recomputes the fp32 oracle and propagates both cotangents, so
-    downstream uses of the lse (e.g. the ring merge weights) get exact
-    gradients."""
+    to combine per-shard attention results exactly. Both outputs stay
+    fp32 so cross-shard accumulation keeps full precision (the ring merge
+    casts once at the end; flash_attention casts to q.dtype itself).
+    Differentiable: the VJP recomputes the fp32 oracle and propagates both
+    cotangents, so downstream uses of the lse (e.g. the ring merge
+    weights) get exact gradients."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
@@ -198,7 +203,7 @@ def flash_attention_with_lse(
         q3, k3, v3, causal=causal, block_q=512, block_k=512,
         interpret=interpret,
     )
-    out = o3.reshape(b, h, lq, d).transpose(0, 2, 1, 3).astype(q.dtype)
+    out = o3.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
     lse = lse3.reshape(b, h, lq).transpose(0, 2, 1)
     return out, lse
 

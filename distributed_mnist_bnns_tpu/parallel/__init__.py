@@ -1,4 +1,4 @@
-from .mesh import make_mesh
+from .mesh import make_hybrid_mesh, make_mesh
 from .distributed import initialize_multihost
 from .data_parallel import (
     make_dp_train_step,
@@ -17,6 +17,7 @@ from .expert_parallel import (
 
 __all__ = [
     "make_mesh",
+    "make_hybrid_mesh",
     "initialize_multihost",
     "make_dp_train_step",
     "make_shardmap_dp_train_step",

@@ -38,3 +38,53 @@ def make_mesh(
         raise ValueError(f"need {need} devices, have {len(devs)}")
     grid = np.asarray(devs[:need]).reshape(data, model)
     return Mesh(grid, axis_names=tuple(axis_names))
+
+
+def make_hybrid_mesh(
+    ici_axes: dict[str, int],
+    *,
+    dcn_axis: str = "replica",
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build an (n_slices, *ici_shape) mesh whose leading axis crosses the
+    DCN boundary and whose trailing axes stay within a slice's ICI.
+
+    Multi-slice layout rule (the scaling-book recipe): put the
+    bandwidth-hungry shardings (tp/sp/ep) on ICI axes and the
+    gradient-all-reduce (dp) on the slower DCN axis — gradients are summed
+    once per step, activations move constantly. Grouping devices by
+    ``slice_index`` makes XLA place each trailing-axis collective entirely
+    on ICI; only the leading axis's psum crosses DCN.
+
+    On hardware without slice topology (CPU simulation, single slice),
+    devices are grouped by process index instead (equivalent for the
+    one-process-per-host layout), falling back to equal chunks.
+
+    ``ici_axes`` maps axis name -> size, e.g. {"data": 2, "model": 2};
+    n_slices is inferred as device_count / prod(ici_sizes).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    ici = 1
+    for v in ici_axes.values():
+        ici *= v
+    if len(devs) % ici:
+        raise ValueError(
+            f"{len(devs)} devices not divisible by ICI shape {ici_axes}"
+        )
+    n_slices = len(devs) // ici
+
+    def group_key(d):
+        idx = getattr(d, "slice_index", None)
+        if idx is not None:
+            return idx
+        return getattr(d, "process_index", 0)
+
+    keys = sorted({group_key(d) for d in devs})
+    if len(keys) == n_slices and all(
+        sum(1 for d in devs if group_key(d) == k) == ici for k in keys
+    ):
+        ordered = [d for k in keys for d in devs if group_key(d) == k]
+    else:  # no usable topology info — contiguous equal chunks
+        ordered = devs
+    grid = np.asarray(ordered).reshape(n_slices, *ici_axes.values())
+    return Mesh(grid, axis_names=(dcn_axis, *ici_axes.keys()))

@@ -61,3 +61,21 @@ def test_flash_gradients_match_oracle():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
         )
+
+
+def test_flash_causal_cross_length_bottom_right_aligned():
+    """Causal with Lq != Lk uses bottom-right alignment (tril k=Lk-Lq),
+    matching the oracle; forward and grads must agree."""
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 8, 1, 4, lk=16)
+    out = flash_attention(q, k, v, True, True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    gf = jax.grad(lambda q: (flash_attention(q, k, v, True, True) ** 2).sum())(q)
+    gr = jax.grad(
+        lambda q: (attention_reference(q, k, v, causal=True) ** 2).sum()
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(gf), np.asarray(gr), atol=1e-4, rtol=1e-4
+    )

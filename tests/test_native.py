@@ -50,3 +50,20 @@ def test_native_pack_bits_matches_python():
     x = np.sign(rng.randn(13, 131)).astype(np.float32)
     x[x == 0] = 1
     np.testing.assert_array_equal(native.pack_bits_native(x), pack_bits_np(x))
+
+
+def test_native_cifar_bin_matches_numpy(tmp_path):
+    rng = np.random.RandomState(7)
+    rec = np.concatenate(
+        [
+            rng.randint(0, 10, (6, 1)).astype(np.uint8),
+            rng.randint(0, 256, (6, 3072)).astype(np.uint8),
+        ],
+        axis=1,
+    )
+    p = tmp_path / "data_batch_1.bin"
+    rec.tofile(p)
+    imgs_c, labels_c = native.cifar_bin_decode_native(str(p), 6)
+    imgs_py = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    np.testing.assert_array_equal(imgs_c, imgs_py)
+    np.testing.assert_array_equal(labels_c, rec[:, 0].astype(np.int32))
