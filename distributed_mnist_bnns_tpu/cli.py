@@ -74,6 +74,9 @@ def build_parser() -> argparse.ArgumentParser:
         # parallelism
         sp.add_argument("--dp", default="1",
                         help="'auto' = all devices, or an integer")
+        sp.add_argument("--dp-mode", default="gspmd",
+                        choices=["gspmd", "fsdp"],
+                        help="fsdp = ZeRO-style sharded params/opt state")
         sp.add_argument("--log-file", default="log.txt")
         # multi-host rendezvous (replaces MASTER_ADDR/MASTER_PORT env://)
         sp.add_argument("--nodes", type=int, default=1)
@@ -115,6 +118,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         save_all_epochs=args.save_all,
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
+        dp_mode=args.dp_mode,
         profile_dir=args.profile_dir,
         remat=args.remat,
     )

@@ -29,15 +29,23 @@ def _load() -> Optional[ctypes.CDLL]:
     _tried = True
     if os.environ.get("DMB_TPU_NO_NATIVE"):
         return None
-    # Always invoke make: the Makefile's dependency tracking makes this a
-    # no-op when the .so is current, and it rebuilds a stale .so from an
-    # older source revision (whose missing symbols would otherwise break
-    # the bindings below).
+    # Rebuild when the .so is absent or older than its source (a stale
+    # .so from an older revision would miss symbols). Build to a per-pid
+    # temp and os.replace it in — atomic, so concurrent processes (multi-
+    # host training, pytest-xdist) never dlopen a half-written file; at
+    # worst they compile redundantly.
+    src = os.path.join(_DIR, "idx_loader.cpp")
     try:
-        subprocess.run(
-            ["make", "-s"], cwd=_DIR, check=True, capture_output=True,
-            timeout=120,
-        )
+        need = (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(src))
+        if need:
+            tmp = f"{_SO}.tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", src,
+                 "-o", tmp],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, _SO)
     except Exception as e:  # pragma: no cover - toolchain always present
         log.debug("native build failed (%s); using python fallback", e)
         if not os.path.exists(_SO):

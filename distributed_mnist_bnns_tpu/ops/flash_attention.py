@@ -196,6 +196,14 @@ def flash_attention_with_lse(
     weights) get exact gradients."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    if causal and lq > lk:
+        # Bottom-right alignment leaves query rows < Lq-Lk attending to
+        # zero keys — an ill-defined softmax (the kernel would emit zeros,
+        # the oracle uniform attention); refuse rather than silently
+        # diverge.
+        raise ValueError(
+            f"causal attention requires Lq <= Lk, got Lq={lq} Lk={lk}"
+        )
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)

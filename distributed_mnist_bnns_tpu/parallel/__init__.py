@@ -1,3 +1,8 @@
+from .fsdp import (
+    fsdp_shardings,
+    make_fsdp_train_step,
+    shard_state_fsdp,
+)
 from .mesh import make_hybrid_mesh, make_mesh
 from .distributed import initialize_multihost
 from .data_parallel import (
@@ -18,6 +23,9 @@ from .expert_parallel import (
 __all__ = [
     "make_mesh",
     "make_hybrid_mesh",
+    "fsdp_shardings",
+    "make_fsdp_train_step",
+    "shard_state_fsdp",
     "initialize_multihost",
     "make_dp_train_step",
     "make_shardmap_dp_train_step",

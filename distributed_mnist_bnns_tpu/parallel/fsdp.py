@@ -1,0 +1,115 @@
+"""FSDP / ZeRO-style fully sharded data parallelism.
+
+Beyond-parity capability (the reference's only memory strategy is "fit on
+one GPU"): parameters, gradients, and optimizer state are *sharded* over
+the 'data' mesh axis instead of replicated, so per-device memory for
+state scales as 1/N while the training math stays identical to plain DP.
+
+TPU-native formulation: no hand-written gather/scatter — each param leaf
+gets a PartitionSpec sharding its largest divisible axis over 'data', the
+jitted step runs with those shardings pinned on inputs and outputs, and
+GSPMD materializes the ZeRO-3 schedule itself (all-gather params for
+fwd/bwd, reduce-scatter grads, sharded optimizer update) on ICI. This is
+the standard JAX FSDP recipe: sharding annotations in, collective
+schedule out.
+
+Exactness: tested equal to the single-device step (tests/test_fsdp.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..train.trainer import TrainState
+
+
+def fsdp_spec(leaf: Any, n_shards: int, axis: str = "data") -> P:
+    """PartitionSpec sharding the leaf's largest n_shards-divisible axis;
+    replicated if no axis divides (small biases, scalars)."""
+    shape = getattr(leaf, "shape", ())
+    if not shape:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % n_shards == 0 and shape[i] >= n_shards:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return P(*spec)
+    return P()
+
+
+def fsdp_shardings(tree: Any, mesh: Mesh, axis: str = "data") -> Any:
+    """NamedSharding tree: every array leaf sharded per fsdp_spec."""
+    n = mesh.shape[axis]
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, fsdp_spec(leaf, n, axis)), tree
+    )
+
+
+def shard_state_fsdp(state: TrainState, mesh: Mesh, axis: str = "data"
+                     ) -> TrainState:
+    """Place params/opt_state/batch_stats on their FSDP shardings (step
+    counter replicated)."""
+    put = lambda tree: jax.tree.map(
+        lambda leaf, sh: jax.device_put(leaf, sh),
+        tree, fsdp_shardings(tree, mesh, axis),
+    )
+    return state.replace(
+        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        params=put(state.params),
+        batch_stats=put(state.batch_stats),
+        opt_state=put(state.opt_state),
+    )
+
+
+def make_fsdp_train_step(
+    base_step: Callable,
+    mesh: Mesh,
+    state: TrainState,
+    *,
+    axis: str = "data",
+) -> Callable:
+    """Wrap a (state, images, labels, rng) train step with FSDP shardings.
+
+    ``base_step`` is the unjitted-or-jitted single-device step (e.g.
+    make_train_step(..., donate=False)); the returned step expects a state
+    already placed via shard_state_fsdp and batch inputs sharded on
+    ``axis``. Output state shardings are pinned to the input shardings so
+    the optimizer update itself runs sharded (ZeRO's key property) rather
+    than being all-gathered back.
+    """
+    state_sh = TrainState(
+        step=NamedSharding(mesh, P()),
+        params=fsdp_shardings(state.params, mesh, axis),
+        batch_stats=fsdp_shardings(state.batch_stats, mesh, axis),
+        opt_state=fsdp_shardings(state.opt_state, mesh, axis),
+        apply_fn=state.apply_fn,
+        tx=state.tx,
+    )
+    data_sh = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    metrics_sh = repl
+
+    return jax.jit(
+        base_step,
+        in_shardings=(state_sh, data_sh, data_sh, repl),
+        out_shardings=(state_sh, metrics_sh),
+        donate_argnums=(0,),
+    )
+
+
+def fsdp_memory_fraction(params: Any, mesh: Mesh, axis: str = "data"
+                         ) -> float:
+    """Fraction of replicated-param bytes each device holds under FSDP
+    (1/N in the limit; > 1/N when small leaves stay replicated)."""
+    n = mesh.shape[axis]
+    total, local = 0, 0
+    for leaf in jax.tree.leaves(params):
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += size
+        local += size // n if fsdp_spec(leaf, n, axis) != P() else size
+    return local / max(total, 1)

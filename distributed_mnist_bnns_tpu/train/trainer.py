@@ -179,6 +179,8 @@ class TrainConfig:
     save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
     resume: bool = False           # restore latest checkpoint before fit
     data_parallel: Optional[object] = None  # None | "auto" | int devices
+    dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
+                                   # (ZeRO-style sharded params/opt state)
     remat: bool = False            # jax.checkpoint the forward (HBM saver)
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     profile_steps: int = 5
@@ -269,8 +271,19 @@ class Trainer:
         )
 
         dp = self.config.data_parallel
+        if self.config.dp_mode not in ("gspmd", "fsdp"):
+            raise ValueError(
+                f"unknown dp_mode {self.config.dp_mode!r} "
+                "(have: gspmd, fsdp)"
+            )
         n = jax.device_count() if dp == "auto" else int(dp)
         if n <= 1:
+            if self.config.dp_mode != "gspmd":
+                log.warning(
+                    "dp_mode=%r has no effect with data_parallel<=1 "
+                    "(pass --dp auto or an integer > 1)",
+                    self.config.dp_mode,
+                )
             return
         if self.config.batch_size % n:
             raise ValueError(
@@ -278,9 +291,14 @@ class Trainer:
                 f"data_parallel={n}"
             )
         self.mesh = make_mesh(data=n)
-        self._set_dp_step(loss_fn)
-        self.state = replicate(self.state, self.mesh)
-        log.info("data-parallel over %d devices", n)
+        if self.config.dp_mode == "fsdp":
+            self._set_fsdp_step(loss_fn)
+        else:
+            self._set_dp_step(loss_fn)
+            self.state = replicate(self.state, self.mesh)
+        log.info(
+            "data-parallel (%s) over %d devices", self.config.dp_mode, n
+        )
 
     def _set_dp_step(self, loss_fn) -> None:
         from ..parallel import make_dp_train_step, shard_batch
@@ -294,6 +312,32 @@ class Trainer:
         def step(state, images, labels, rng):
             return dp_step(
                 state, shard_batch(images, mesh), shard_batch(labels, mesh), rng
+            )
+
+        self.train_step = step
+
+    def _set_fsdp_step(self, loss_fn) -> None:
+        """ZeRO-style DP: params/grads/opt state sharded over 'data'."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import shard_batch
+        from ..parallel.fsdp import make_fsdp_train_step, shard_state_fsdp
+
+        base = make_train_step(
+            self.clamp_mask, loss_fn=loss_fn, donate=False,
+            remat=self.config.remat,
+        )
+        fsdp_step = make_fsdp_train_step(base, self.mesh, self.state)
+        self.state = shard_state_fsdp(self.state, self.mesh)
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+
+        def step(state, images, labels, rng):
+            return fsdp_step(
+                state,
+                shard_batch(images, mesh),
+                shard_batch(labels, mesh),
+                jax.device_put(rng, repl),
             )
 
         self.train_step = step
@@ -330,7 +374,10 @@ class Trainer:
             # wrapper if training data-parallel (a bare rebuild would
             # silently drop the mesh sharding).
             if self.mesh is not None:
-                self._set_dp_step(self._loss_fn)
+                if self.config.dp_mode == "fsdp":
+                    self._set_fsdp_step(self._loss_fn)
+                else:
+                    self._set_dp_step(self._loss_fn)
             else:
                 self.train_step = make_train_step(
                     self.clamp_mask, loss_fn=self._loss_fn,

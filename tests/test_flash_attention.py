@@ -79,3 +79,9 @@ def test_flash_causal_cross_length_bottom_right_aligned():
     np.testing.assert_allclose(
         np.asarray(gf), np.asarray(gr), atol=1e-4, rtol=1e-4
     )
+
+
+def test_flash_causal_lq_gt_lk_rejected():
+    q, k, v = _qkv(jax.random.PRNGKey(4), 1, 16, 1, 4, lk=8)
+    with pytest.raises(ValueError, match="Lq <= Lk"):
+        flash_attention(q, k, v, True, True)
