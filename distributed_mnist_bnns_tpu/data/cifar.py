@@ -120,12 +120,17 @@ def load_cifar10(
     for root in roots:
         if root is None or not os.path.isdir(root):
             continue
-        # accept either the parent data dir or the batches dir itself
+        # Accept either the parent data dir or the batches dir itself.
+        # The binary layout is preferred when both are present: parsing it
+        # is pure numpy, whereas the pickle layout goes through
+        # pickle.load, which executes arbitrary code from a hostile file —
+        # only point data_dir at pickle batches you obtained from the
+        # official CIFAR distribution.
         for sub, loader in (
-            ("cifar-10-batches-py", _load_py_batches),
             ("cifar-10-batches-bin", _load_bin_batches),
-            ("", _load_py_batches),
+            ("cifar-10-batches-py", _load_py_batches),
             ("", _load_bin_batches),
+            ("", _load_py_batches),
         ):
             d = os.path.join(root, sub) if sub else root
             if not os.path.isdir(d):

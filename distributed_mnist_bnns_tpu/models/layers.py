@@ -59,12 +59,15 @@ def _latent_init(scale: float = 1.0) -> Callable:
 
 
 def _layer_backend(mdl: nn.Module) -> Backend:
-    """Resolve this layer's GEMM backend. The int8 MXU path is only exact
-    on ±1 operands, so first layers fed raw (non-binarized) activations
-    fall back to bf16 — matching the reference's fp32 first layer."""
+    """Resolve this layer's GEMM backend. The int8/xnor/pallas_xnor paths
+    assume ±1 operands (int8 casts truncate, the bitplane paths re-sign the
+    activations), so first layers fed raw (non-binarized) activations fall
+    back to the fp32 xla path — matching the reference's fp32 first layer
+    (models/binarized_modules.py:75). bf16 is left as-is: choosing it for
+    raw inputs is a deliberate AMP-style precision trade, exact on ±1."""
     backend = mdl.backend or get_default_backend()
-    if backend == "int8" and not mdl.binarize_input:
-        return "bf16"
+    if not mdl.binarize_input and backend in ("int8", "xnor", "pallas_xnor"):
+        return "xla"
     return backend
 
 

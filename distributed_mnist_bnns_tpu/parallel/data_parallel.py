@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -37,17 +38,46 @@ from ..ops.losses import cross_entropy_loss
 from ..train.trainer import TrainState, clamp_latent
 
 
+def _assemble_global(tree: Any, sharding: NamedSharding) -> Any:
+    """Build global jax.Arrays from per-process local data. Each process
+    contributes the rows its own data pipeline produced (batch_iterator's
+    host_id-strided shard); jax stitches them into one global array laid
+    out per ``sharding`` without any cross-host copy of the data itself."""
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        tree,
+    )
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
-    """Place every leaf replicated over the mesh."""
+    """Place every leaf replicated over the mesh.
+
+    Multi-process: every host must hold identical values (true for state
+    built from the same seed, the reference's implicit DDP contract —
+    mnist-dist2.py:85-93); device_put cannot address remote devices, so the
+    global array is assembled from the per-process copies instead."""
     sharding = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        return _assemble_global(tree, sharding)
     return jax.device_put(tree, sharding)
 
 
 def shard_batch(tree: Any, mesh: Mesh, axis: str = "data") -> Any:
     """Shard leading (batch) dim of every leaf over the given mesh axis —
     the per-rank slicing DistributedSampler does host-side, expressed as a
-    device placement."""
+    device placement.
+
+    Single-process: a plain device_put with a sharded layout. Multi-process:
+    each host's array is only its *local* shard of the global batch
+    (batch_iterator feeds per-host shards, mirroring DistributedSampler,
+    mnist-dist2.py:100-102), so the global array must be assembled with
+    make_array_from_process_local_data — a device_put onto the global
+    sharding would mis-assemble (or fail on non-addressable devices)."""
     sharding = NamedSharding(mesh, P(axis))
+    if jax.process_count() > 1:
+        return _assemble_global(tree, sharding)
     return jax.device_put(tree, sharding)
 
 

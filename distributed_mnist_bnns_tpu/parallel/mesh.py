@@ -9,11 +9,14 @@ XLA from sharding annotations rather than hand-written NCCL/Gloo calls
 
 from __future__ import annotations
 
+import logging
 from typing import Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+log = logging.getLogger(__name__)
 
 
 def make_mesh(
@@ -85,6 +88,14 @@ def make_hybrid_mesh(
     ):
         ordered = [d for k in keys for d in devs if group_key(d) == k]
     else:  # no usable topology info — contiguous equal chunks
+        if n_slices > 1:
+            log.warning(
+                "make_hybrid_mesh: device slice/process grouping does not "
+                "match %d slices of %d devices; falling back to contiguous "
+                "chunks. On real multi-slice hardware this can place ICI "
+                "axes across the DCN boundary — verify the mesh layout.",
+                n_slices, ici,
+            )
         ordered = devs
     grid = np.asarray(ordered).reshape(n_slices, *ici_axes.values())
     return Mesh(grid, axis_names=(dcn_axis, *ici_axes.keys()))

@@ -1,13 +1,27 @@
-from .optim import OPTIMIZER_REGISTRY, make_optimizer, RegimeSchedule
-from .trainer import TrainConfig, Trainer, TrainState, make_train_step, make_eval_step
+from .optim import (
+    OPTIMIZER_REGISTRY,
+    RegimeSchedule,
+    make_optimizer,
+    regime_hp_kwargs,
+)
+from .trainer import (
+    TrainConfig,
+    Trainer,
+    TrainState,
+    make_eval_step,
+    make_masked_eval_step,
+    make_train_step,
+)
 
 __all__ = [
     "OPTIMIZER_REGISTRY",
     "make_optimizer",
+    "regime_hp_kwargs",
     "RegimeSchedule",
     "TrainConfig",
     "Trainer",
     "TrainState",
     "make_train_step",
     "make_eval_step",
+    "make_masked_eval_step",
 ]

@@ -19,6 +19,7 @@ optimizer's ``ax`` buffer) while stepping as plain SGD.
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable, Dict, NamedTuple
 
 import jax
@@ -86,7 +87,32 @@ def make_optimizer(
         raise ValueError(
             f"unknown optimizer {name!r}; available: {sorted(OPTIMIZER_REGISTRY)}"
         ) from None
+    # Materialize numeric values for HP keys the ctor accepts with a
+    # non-numeric default (e.g. sgd's momentum=None): inject_hyperparams
+    # only exposes numeric args, and a regime must be able to retune any
+    # param-group key in place (adjust_optimizer, utils.py:116-139).
+    # momentum=0.0 is mathematically identical to momentum=None.
+    sig = inspect.signature(ctor)
+    for k in _HP_KEYS:
+        if k == "learning_rate":  # passed explicitly below (adadelta's
+            continue              # default is None — don't duplicate it)
+        p = sig.parameters.get(k)
+        if p is not None and p.default is None and k not in kwargs:
+            kwargs[k] = 0.0
     return optax.inject_hyperparams(ctor)(learning_rate=learning_rate, **kwargs)
+
+
+def regime_hp_kwargs(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """The HP entries of a regime config that optimizer ``name``'s ctor
+    accepts (others are ignored — the same tolerance torch shows for
+    unknown param-group keys)."""
+    ctor = OPTIMIZER_REGISTRY[name.lower()]
+    sig = inspect.signature(ctor)
+    return {
+        k: cfg[k]
+        for k in _HP_KEYS
+        if k != "learning_rate" and k in cfg and k in sig.parameters
+    }
 
 
 class RegimeSchedule:

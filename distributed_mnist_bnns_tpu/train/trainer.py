@@ -49,7 +49,7 @@ from ..utils.checkpoint import (
 )
 from ..utils.meters import AverageMeter
 from ..utils.results import ResultsLog
-from .optim import RegimeSchedule, make_optimizer
+from .optim import RegimeSchedule, make_optimizer, regime_hp_kwargs
 
 log = logging.getLogger(__name__)
 
@@ -154,6 +154,41 @@ def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
     return jax.jit(eval_step)
 
 
+def make_masked_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
+    """Eval step for mesh-sharded evaluation: a ``valid`` mask excludes the
+    zero-padding of the final batch, so every batch has the same static
+    shape (one compile, shardable over the data axis) while the aggregated
+    sums stay exact. Per-example losses come from vmapping the registry
+    loss over singleton batches — exact for all mean-of-per-sample losses
+    (ce, hinge, sqrt_hinge)."""
+
+    def eval_step(
+        state: TrainState,
+        images: jnp.ndarray,
+        labels: jnp.ndarray,
+        valid: jnp.ndarray,
+    ) -> Dict[str, jnp.ndarray]:
+        outs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        per_example = jax.vmap(lambda o, l: loss_fn(o[None], l[None]))(
+            outs, labels
+        )
+        top5 = jnp.argsort(outs, axis=-1)[:, ::-1][:, :5]
+        correct1 = ((top5[:, 0] == labels) & valid).sum()
+        correct5 = ((top5 == labels[:, None]).any(-1) & valid).sum()
+        return {
+            "loss_sum": (per_example * valid.astype(per_example.dtype)).sum(),
+            "correct1": correct1,
+            "correct5": correct5,
+            "count": valid.sum(),
+        }
+
+    return jax.jit(eval_step)
+
+
 @dataclass
 class TrainConfig:
     """One config covering what the reference scatters across argparse flags
@@ -241,6 +276,7 @@ class Trainer:
         self.results = ResultsLog(config.results_path or "results.csv")
         self.batch_meter = AverageMeter()
         self._profiled = False  # trace the first epoch this trainer runs
+        self._masked_eval_step = None  # built lazily for mesh-native eval
 
     @staticmethod
     def _build_model(name: str, mk: Dict[str, Any]):
@@ -301,15 +337,18 @@ class Trainer:
         )
 
     def _set_dp_step(self, loss_fn) -> None:
-        from ..parallel import make_dp_train_step, shard_batch
+        from ..parallel import make_dp_train_step, replicate, shard_batch
 
         dp_step = make_dp_train_step(
             self.clamp_mask, self.mesh, loss_fn=loss_fn,
             remat=self.config.remat,
         )
         mesh = self.mesh
+        multiproc = jax.process_count() > 1
 
         def step(state, images, labels, rng):
+            if multiproc:
+                rng = replicate(rng, mesh)
             return dp_step(
                 state, shard_batch(images, mesh), shard_batch(labels, mesh), rng
             )
@@ -318,9 +357,7 @@ class Trainer:
 
     def _set_fsdp_step(self, loss_fn) -> None:
         """ZeRO-style DP: params/grads/opt state sharded over 'data'."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from ..parallel import shard_batch
+        from ..parallel import replicate, shard_batch
         from ..parallel.fsdp import make_fsdp_train_step, shard_state_fsdp
 
         base = make_train_step(
@@ -330,24 +367,59 @@ class Trainer:
         fsdp_step = make_fsdp_train_step(base, self.mesh, self.state)
         self.state = shard_state_fsdp(self.state, self.mesh)
         mesh = self.mesh
-        repl = NamedSharding(mesh, P())
 
         def step(state, images, labels, rng):
             return fsdp_step(
                 state,
                 shard_batch(images, mesh),
                 shard_batch(labels, mesh),
-                jax.device_put(rng, repl),
+                replicate(rng, mesh),
             )
 
         self.train_step = step
 
-    def _eval_state(self):
-        """Single-device copy of the state for (variable-batch) eval when
-        training data-parallel."""
-        if self.mesh is None:
-            return self.state
-        return jax.device_put(jax.device_get(self.state), jax.devices()[0])
+    def _eval_on_mesh(self, data, bs: int) -> Dict[str, float]:
+        """Mesh-native eval: the state stays sharded/replicated on the DP
+        mesh (no device_get round-trip); batches are padded to a
+        mesh-divisible static shape with the padding masked out of the
+        aggregation.
+
+        Multi-host: each process evaluates a disjoint strided shard of the
+        test set (every example exactly once globally — unlike
+        DistributedSampler's wraparound duplicates), padded with a -1
+        sentinel so every host runs the same number of collective steps."""
+        from ..parallel import shard_batch
+
+        n_dev = int(self.mesh.devices.size)
+        pad_to = -(-bs // n_dev) * n_dev
+        if self._masked_eval_step is None:
+            self._masked_eval_step = make_masked_eval_step(self._loss_fn)
+
+        n_total = len(data.test_labels)
+        num_hosts = jax.process_count()
+        per_host = -(-n_total // num_hosts)
+        padded_idx = np.full(per_host * num_hosts, -1, np.int64)
+        padded_idx[:n_total] = np.arange(n_total)
+        my_idx = padded_idx[jax.process_index()::num_hosts]
+
+        totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
+        for start in range(0, len(my_idx), bs):
+            chunk = my_idx[start : start + bs]
+            if len(chunk) < pad_to:
+                chunk = np.concatenate(
+                    [chunk, np.full(pad_to - len(chunk), -1, np.int64)]
+                )
+            valid = chunk >= 0
+            sel = np.where(valid, chunk, 0)
+            out = self._masked_eval_step(
+                self.state,
+                shard_batch(data.test_images[sel], self.mesh),
+                shard_batch(data.test_labels[sel], self.mesh),
+                shard_batch(valid, self.mesh),
+            )
+            for k in totals:
+                totals[k] += float(out[k])
+        return totals
 
     # -- epoch-level hyperparameter control ---------------------------------
 
@@ -365,7 +437,9 @@ class Trainer:
             # (adjust_optimizer reconstructs the torch class the same way,
             # utils.py:120-126).
             tx = make_optimizer(
-                cfg["optimizer"], cfg.get("learning_rate", self.config.learning_rate)
+                cfg["optimizer"],
+                cfg.get("learning_rate", self.config.learning_rate),
+                **regime_hp_kwargs(cfg["optimizer"], cfg),
             )
             self.state = self.state.replace(
                 tx=tx, opt_state=tx.init(self.state.params)
@@ -383,6 +457,12 @@ class Trainer:
                     self.clamp_mask, loss_fn=self._loss_fn,
                     remat=self.config.remat,
                 )
+        # In-place retune of the regime's non-lr HPs (momentum/b1/b2/eps/
+        # weight_decay) — the reference's "any param-group key" semantics
+        # (adjust_optimizer, utils.py:116-139), with no moment reset.
+        self.regime.apply_hyperparams(self.state.opt_state, epoch)
+        # learning_rate is written last: it combines the regime's base lr
+        # with the x0.1-every-N-epochs decay schedule.
         hp = getattr(self.state.opt_state, "hyperparams", None)
         if hp is not None and "learning_rate" in hp:
             hp["learning_rate"] = jnp.asarray(
@@ -421,9 +501,13 @@ class Trainer:
         try:
             for i, (images, labels) in enumerate(it):
                 t0 = time.perf_counter()
+                if self.mesh is None:
+                    # (prefetched) single-device upload; the mesh paths
+                    # feed numpy straight to shard_batch — one transfer,
+                    # no host round-trip through the default device.
+                    images, labels = jnp.asarray(images), jnp.asarray(labels)
                 self.state, metrics = self.train_step(
-                    self.state, jnp.asarray(images), jnp.asarray(labels),
-                    self.rng,
+                    self.state, images, labels, self.rng,
                 )
                 if i == 0 or (i + 1) % cfg.log_interval == 0:
                     # sync only at log boundaries to keep the pipeline full
@@ -461,17 +545,21 @@ class Trainer:
 
     def evaluate(self, data, batch_size: Optional[int] = None) -> Dict[str, float]:
         bs = batch_size or self.config.batch_size
-        totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
-        eval_state = self._eval_state()
-        for images, labels in batch_iterator(
-            data.test_images, data.test_labels, bs,
-            shuffle=False, drop_last=False,
-        ):
-            out = self.eval_step(
-                eval_state, jnp.asarray(images), jnp.asarray(labels)
-            )
-            for k in totals:
-                totals[k] += float(out[k])
+        if self.mesh is not None:
+            totals = self._eval_on_mesh(data, bs)
+        else:
+            totals = {
+                "loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0,
+            }
+            for images, labels in batch_iterator(
+                data.test_images, data.test_labels, bs,
+                shuffle=False, drop_last=False,
+            ):
+                out = self.eval_step(
+                    self.state, jnp.asarray(images), jnp.asarray(labels)
+                )
+                for k in totals:
+                    totals[k] += float(out[k])
         n = max(totals["count"], 1.0)
         return {
             "test_loss": totals["loss_sum"] / n,
