@@ -29,16 +29,26 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # finite stand-in for -inf (keeps exp() NaN-free)
 
 
-def _pick_block(n: int, cap: int = 512) -> int:
-    for cand in (512, 256, 128, 64, 32, 16, 8):
-        if cand <= cap and n % cand == 0:
-            return cand
-    return n
+def _pick_block(n: int, cap: int, align: int) -> tuple[int, int]:
+    """Choose a Mosaic-aligned block size for a length-n axis.
+
+    Returns (block, padded_n): ``block`` is a multiple of ``align`` (the
+    Mosaic tile granularity for that axis — 8 sublanes for the q axis, 128
+    lanes for the k axis) and ``padded_n`` is the multiple of ``block`` the
+    axis must be padded to. Never emits an unaligned block for awkward
+    lengths (e.g. L=7 -> block 8 with one padded row, not block 7)."""
+    if n % align == 0:
+        for cand in (512, 256, 128, 64, 32, 16, 8):
+            if cand <= cap and cand % align == 0 and n % cand == 0:
+                return cand, n
+    block = max(align, min(cap, -(-n // align) * align))
+    return block, -(-n // block) * block
 
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, scale: float, causal: bool, n_kblocks: int, causal_offset: int
+    *, scale: float, causal: bool, n_kblocks: int, causal_offset: int,
+    real_lk: int, mask_pad_k: bool
 ):
     """One (batch*head, q-block, k-block) grid step.
 
@@ -62,16 +72,25 @@ def _flash_kernel(
     v = v_ref[0]  # (bk, d)
     bq, bk = q.shape[0], k.shape[0]
 
+    # HIGHEST precision: on TPU the default fp32 matmul is a single bf16
+    # MXU pass (~1e-3 relative error); HIGHEST keeps fp32 operands exact
+    # and costs nothing for bf16 operands.
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     ) * scale  # (bq, bk)
+    if causal or mask_pad_k:
+        k_pos = kk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if mask_pad_k:
+        # Zero-padded key rows (alignment padding) must not attend.
+        s = jnp.where(k_pos < real_lk, s, NEG_INF)
     if causal:
         # Bottom-right alignment for Lq != Lk (matching jnp.tril with
         # k = Lk - Lq): query row i attends keys [0, i + Lk - Lq].
+        # Positions use the *real* lengths (padding sits at the end).
         q_pos = pl.program_id(1) * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, bk), 0
         )
-        k_pos = kk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(k_pos <= q_pos + causal_offset, s, NEG_INF)
 
     m_prev = m_ref[...]
@@ -87,7 +106,8 @@ def _flash_kernel(
     m_ref[...] = m_new
     l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
 
     @pl.when(kk == n_kblocks - 1)
@@ -115,49 +135,65 @@ def _flash_fwd_impl(
 
     bh, lq, d = q3.shape
     lk = k3.shape[1]
-    bq = _pick_block(lq, block_q)
-    bk = _pick_block(lk, block_k)
-    n_kblocks = lk // bk
-    scale = d**-0.5
+    scale = d**-0.5  # real head dim — padding must not change the scale
 
-    return pl.pallas_call(
+    # Mosaic-aligned blocks: q rows tile at 8 sublanes; k rows become the
+    # lane axis of the (bq, bk) score tile, so they tile at 128 lanes; the
+    # head dim is a lane axis of q/k/v tiles — pad it to 128. Padded keys
+    # are masked to NEG_INF in-kernel; padded q rows/d columns are sliced
+    # off after the call.
+    bq, lq_p = _pick_block(lq, block_q, 8)
+    bk, lk_p = _pick_block(lk, block_k, 128)
+    d_p = -(-d // 128) * 128
+    if (lq_p, lk_p, d_p) != (lq, lk, d):
+        q3 = jnp.pad(q3, ((0, 0), (0, lq_p - lq), (0, d_p - d)))
+        k3 = jnp.pad(k3, ((0, 0), (0, lk_p - lk), (0, d_p - d)))
+        v3 = jnp.pad(v3, ((0, 0), (0, lk_p - lk), (0, d_p - d)))
+    n_kblocks = lk_p // bk
+
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, causal=causal, n_kblocks=n_kblocks,
-            causal_offset=lk - lq,
+            causal_offset=lk - lq, real_lk=lk, mask_pad_k=lk_p != lk,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq_p, 1), jnp.float32),
         ),
-        grid=(bh, lq // bq, n_kblocks),
+        grid=(bh, lq_p // bq, n_kblocks),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, kk: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, kk: (b, kk, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, bq, d_p), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda b, i, kk: (b, kk, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, bq, d), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bq, d_p), lambda b, i, kk: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, kk: (b, i, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, d_p), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
+    if (lq_p, d_p) != (lq, d):
+        out = out[:, :lq, :d]
+        lse = lse[:, :lq, :]
+    return out, lse
 
 
 def _oracle_with_lse(q, k, v, causal):
     scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    hi = jax.lax.Precision.HIGHEST  # match the kernel (exact fp32 on TPU)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=hi) * scale
     if causal:
         lq, lk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
         scores = jnp.where(mask, scores, NEG_INF)
     lse = jax.scipy.special.logsumexp(scores, axis=-1)  # (B, H, Lq)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=hi)
     return out, lse.transpose(0, 2, 1)  # lse as (B, Lq, H)
 
 

@@ -130,28 +130,18 @@ def make_train_step(
 
 def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
     """Jitted eval step returning summed loss and top-1/top-5 correct counts
-    (so results can be exactly aggregated across batches/hosts)."""
+    (so results can be exactly aggregated across batches/hosts). The
+    all-valid special case of ``make_masked_eval_step``."""
+    masked = make_masked_eval_step(loss_fn)
 
     def eval_step(
         state: TrainState, images: jnp.ndarray, labels: jnp.ndarray
     ) -> Dict[str, jnp.ndarray]:
-        outs = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images,
-            train=False,
+        return masked(
+            state, images, labels, jnp.ones(labels.shape[0], bool)
         )
-        n = labels.shape[0]
-        top5 = jnp.argsort(outs, axis=-1)[:, ::-1][:, :5]
-        correct1 = (top5[:, 0] == labels).sum()
-        correct5 = (top5 == labels[:, None]).any(-1).sum()
-        return {
-            "loss_sum": loss_fn(outs, labels) * n,
-            "correct1": correct1,
-            "correct5": correct5,
-            "count": jnp.asarray(n),
-        }
 
-    return jax.jit(eval_step)
+    return eval_step
 
 
 def make_masked_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
@@ -219,6 +209,28 @@ class TrainConfig:
     remat: bool = False            # jax.checkpoint the forward (HBM saver)
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     profile_steps: int = 5
+
+
+def _make_rng_replicator(mesh) -> Callable:
+    """Replicate an rng key over the mesh, caching by key identity: the
+    Trainer passes the same base key every step (fold_in happens inside the
+    jitted step), so the multi-process global-array assembly — a host
+    round-trip — runs once instead of per batch. Single-process, the jit's
+    in_shardings already place the key; pass it through untouched."""
+    if jax.process_count() <= 1:
+        return lambda rng: rng
+
+    from ..parallel import replicate
+
+    holder: list = []  # [key_obj, replicated] — strong ref keeps identity valid
+
+    def rng_global(rng):
+        if holder and holder[0] is rng:
+            return holder[1]
+        holder[:] = [rng, replicate(rng, mesh)]
+        return holder[1]
+
+    return rng_global
 
 
 class Trainer:
@@ -337,27 +349,28 @@ class Trainer:
         )
 
     def _set_dp_step(self, loss_fn) -> None:
-        from ..parallel import make_dp_train_step, replicate, shard_batch
+        from ..parallel import make_dp_train_step, shard_batch
 
         dp_step = make_dp_train_step(
             self.clamp_mask, self.mesh, loss_fn=loss_fn,
             remat=self.config.remat,
         )
         mesh = self.mesh
-        multiproc = jax.process_count() > 1
+        rng_global = _make_rng_replicator(mesh)
 
         def step(state, images, labels, rng):
-            if multiproc:
-                rng = replicate(rng, mesh)
             return dp_step(
-                state, shard_batch(images, mesh), shard_batch(labels, mesh), rng
+                state,
+                shard_batch(images, mesh),
+                shard_batch(labels, mesh),
+                rng_global(rng),
             )
 
         self.train_step = step
 
     def _set_fsdp_step(self, loss_fn) -> None:
         """ZeRO-style DP: params/grads/opt state sharded over 'data'."""
-        from ..parallel import replicate, shard_batch
+        from ..parallel import shard_batch
         from ..parallel.fsdp import make_fsdp_train_step, shard_state_fsdp
 
         base = make_train_step(
@@ -367,13 +380,14 @@ class Trainer:
         fsdp_step = make_fsdp_train_step(base, self.mesh, self.state)
         self.state = shard_state_fsdp(self.state, self.mesh)
         mesh = self.mesh
+        rng_global = _make_rng_replicator(mesh)
 
         def step(state, images, labels, rng):
             return fsdp_step(
                 state,
                 shard_batch(images, mesh),
                 shard_batch(labels, mesh),
-                replicate(rng, mesh),
+                rng_global(rng),
             )
 
         self.train_step = step

@@ -153,3 +153,55 @@ def test_binary_conv2d_int8_exact():
     )
     out = binary_conv2d(x, w, (1, 1), "SAME", jnp.int8)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(oracle))
+
+
+@pytest.mark.parametrize("padding", ["SAME", "VALID", ((2, 1), (0, 2))])
+def test_bitplane_conv_zero_padding_exact(padding):
+    """The im2col bitplane conv must treat zero-padded border taps as 0 —
+    pack_bits maps them to -1, so without the padding correction every
+    border pixel is wrong by sum(w over padded taps). Regression for a bug
+    that shipped through round 2 (caught by the on-chip suite)."""
+    import jax
+    from distributed_mnist_bnns_tpu.models import BinarizedConv
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 9, 9, 7))
+    ref = BinarizedConv(5, (3, 3), padding=padding, backend="xla")
+    variables = ref.init({"params": jax.random.PRNGKey(1)}, x)
+    want = np.asarray(ref.apply(variables, x))
+    got = np.asarray(
+        BinarizedConv(5, (3, 3), padding=padding, backend="xnor").apply(
+            variables, x
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bitplane_conv_zero_padding_gradients_match():
+    """Gradients through the padded bitplane conv must match the xla path
+    (the correction term is stop_gradient'ed; binary_matmul's VJP already
+    differentiates the exact {-1,0,+1} patches)."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_mnist_bnns_tpu.models import BinarizedConv
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 4))
+    ref = BinarizedConv(3, (3, 3), padding="SAME", backend="xla")
+    variables = ref.init({"params": jax.random.PRNGKey(1)}, x)
+
+    def loss(backend, params, xx):
+        layer = BinarizedConv(3, (3, 3), padding="SAME", backend=backend)
+        return jnp.sum(layer.apply({"params": params}, xx) ** 2)
+
+    gw_ref, gx_ref = jax.grad(
+        lambda p, xx: loss("xla", p, xx), argnums=(0, 1)
+    )(variables["params"], x)
+    gw, gx = jax.grad(
+        lambda p, xx: loss("xnor", p, xx), argnums=(0, 1)
+    )(variables["params"], x)
+    np.testing.assert_allclose(
+        np.asarray(gx), np.asarray(gx_ref), atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(gw["kernel"]), np.asarray(gw_ref["kernel"]),
+        atol=1e-4, rtol=1e-4,
+    )

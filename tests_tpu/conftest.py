@@ -1,0 +1,16 @@
+"""Hardware-gated tests: unlike tests/ (pinned to a virtual CPU mesh),
+this suite runs on the real TPU chip and is skipped entirely elsewhere.
+
+Run with plain ``python -m pytest tests_tpu -q`` — no env pinning — so the
+platform resolution matches what bench.py sees.
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() != "tpu":
+        skip = pytest.mark.skip(reason="requires a real TPU chip")
+        for item in items:
+            item.add_marker(skip)
