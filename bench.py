@@ -7,6 +7,9 @@ Baseline (BASELINE.md): the reference's committed run does ~7,270 images/s
 ``vs_baseline`` is our images/s divided by that number.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+The extras include the SURVEY §7 crossover analysis: GEMM-level timings of
+every binary backend (binary-TOPS) at a compute-bound training shape and a
+bandwidth-bound inference shape with pre-packed bitplane weights.
 
 Flags let the driver/judge vary the setup (--batch-size, --backend,
 --steps); defaults are chosen for a single TPU chip.
@@ -20,11 +23,154 @@ import sys
 import time
 
 
+def _median_marginal(fn, fetch, n_short: int, n_long: int, reps: int) -> float:
+    """Median-of-reps marginal step time.
+
+    On remote-tunneled TPU backends, jax.block_until_ready can return
+    before device execution finishes, inflating throughput by >100x
+    (verified against a known-FLOPs matmul). The only trustworthy sync is
+    a host fetch of a value that depends on the timed work, and the fixed
+    tunnel round-trip must be cancelled out. So: time two runs of
+    different lengths, each ended by a host fetch, and report the
+    *marginal* per-step time between them — median over ``reps`` pairs,
+    because tunnel/host jitter makes any single pair unreliable."""
+
+    def run(n: int) -> float:
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = fn()
+        fetch(r)  # host fetch = true device sync
+        return time.perf_counter() - t0
+
+    estimates = []
+    for _ in range(reps):
+        t_short = run(n_short)
+        t_long = run(n_short + n_long)
+        estimates.append(max((t_long - t_short) / n_long, 1e-9))
+    estimates.sort()
+    return estimates[len(estimates) // 2]
+
+
+def _bench_train_step(trainer, images, labels, steps, warmup, reps=3):
+    state = {"metrics": None}
+
+    def one():
+        trainer.state, state["metrics"] = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+        return state["metrics"]
+
+    def fetch(metrics):
+        state["loss"] = float(metrics["loss"])
+
+    for _ in range(max(1, warmup)):
+        one()
+    fetch(state["metrics"])  # force compile + settle
+    dt = _median_marginal(one, fetch, max(5, warmup), max(1, steps), reps)
+    return dt, state["loss"]
+
+
+def _gemm_crossover(jax, jnp, deadline: float, reps: int = 3):
+    """GEMM-level crossover (SURVEY §7): binary-TOPS per backend at a
+    compute-bound training shape and a bandwidth-bound inference shape.
+    All operands are passed as arguments (no constant folding) except the
+    'prepacked' rows, which deliberately hoist the weight pack — the
+    inference deployment mode of a frozen BNN.
+
+    ``deadline`` (time.monotonic timestamp): remote compiles through the
+    tunnel can take minutes when the endpoint is degraded; rows past the
+    deadline are marked skipped so the driver's bench run always finishes
+    inside its budget (full numbers live in PERF.md)."""
+    from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+        prepack_weights,
+        xnor_matmul,
+        xnor_matmul_packed,
+    )
+
+    def pm1(key, shape):
+        return jnp.where(
+            jax.random.bernoulli(jax.random.PRNGKey(key), 0.5, shape),
+            1.0, -1.0,
+        )
+
+    bf16 = jax.jit(
+        lambda x, w: jnp.dot(
+            x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    )
+    int8 = jax.jit(
+        lambda x, w: jnp.dot(
+            x.astype(jnp.int8), w.astype(jnp.int8),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+    )
+    pallas = jax.jit(lambda x, w: xnor_matmul(x, w))
+
+    bf16_pre = jax.jit(
+        lambda x, wb: jnp.dot(
+            x.astype(jnp.bfloat16), wb, preferred_element_type=jnp.float32
+        )
+    )
+
+    out = {}
+    # (m, k, n, n_short, n_long): small workloads need long runs or the
+    # tunnel jitter swamps the marginal.
+    shapes = {
+        "train_2048x3072x1536": (2048, 3072, 1536, 20, 100),
+        "infer_8x8192x4096": (8, 8192, 4096, 50, 400),
+    }
+    for name, (m, k, n, n_short, n_long) in shapes.items():
+        x, w = pm1(1, (m, k)), pm1(2, (k, n))
+        wp, _, _ = prepack_weights(w)
+        wp = jax.device_put(wp)
+        wb = jax.device_put(w.astype(jnp.bfloat16))
+        packed = jax.jit(
+            lambda x, wp=wp, k=k, n=n: xnor_matmul_packed(x, wp, k, n)
+        )
+        tops = 2.0 * m * k * n
+        row = {}
+        for bname, fn in (
+            ("bf16_cast", lambda x: bf16(x, w)),
+            ("bf16_precast_w", lambda x: bf16_pre(x, wb)),
+            ("int8_cast", lambda x: int8(x, w)),
+            ("pallas_xnor", lambda x: pallas(x, w)),
+            ("pallas_xnor_prepacked_w", packed),
+        ):
+            if time.monotonic() > deadline:
+                row[bname] = "skipped (bench deadline; see PERF.md)"
+                continue
+            dt = _median_marginal(
+                lambda fn=fn, x=x: fn(x),
+                lambda r: float(jnp.sum(r)),
+                n_short, n_long, reps,
+            )
+            row[bname] = {
+                "ms": round(dt * 1e3, 4),
+                "binary_tops": round(tops / dt / 1e12, 2),
+            }
+        out[name] = row
+    out["weight_bytes_per_param"] = {
+        "bf16": 2.0, "int8": 1.0, "bitplane_packed": 1.0 / 32.0,
+    }
+    out["note"] = (
+        "On TPU the MXU (bf16/int8 on +-1 operands) is the binary engine at "
+        "compute-bound training shapes; the VPU XNOR-popcount kernel's "
+        "ceiling is bit-op bound. With weights pre-packed (frozen-model "
+        "inference), the bitplane kernel reads 32x less weight HBM and wins "
+        "the bandwidth-bound small-batch regime."
+    )
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=2048)
-    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--steps", type=int, default=100)
     p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--reps", type=int, default=3,
+                   help="marginal-timing repetitions (median taken)")
     from distributed_mnist_bnns_tpu.ops.xnor_gemm import BACKENDS
 
     p.add_argument("--backend", default="bf16", choices=list(BACKENDS))
@@ -33,8 +179,16 @@ def main() -> None:
                    metavar=("H", "W", "C"),
                    help="default: (28,28,1); xnor-resnet models get the "
                         "CIFAR shape (32,32,3)")
+    p.add_argument("--all-backends", action="store_true",
+                   help="also bench the train step on every backend")
+    p.add_argument("--no-crossover", action="store_true",
+                   help="skip the GEMM-level crossover extras")
+    p.add_argument("--budget-s", type=float, default=420.0,
+                   help="total wall-clock budget; crossover rows past it "
+                        "are skipped so the run always finishes")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
+    deadline = time.monotonic() + args.budget_s
 
     import jax
     import jax.numpy as jnp
@@ -48,53 +202,31 @@ def main() -> None:
     else:
         input_shape = (28, 28, 1)
 
-    config = TrainConfig(
-        model=args.model,
-        batch_size=args.batch_size,
-        optimizer="adam",
-        learning_rate=0.01,
-        backend=args.backend,
-        seed=0,
-    )
-    trainer = Trainer(config, input_shape=input_shape)
-
     key = jax.random.PRNGKey(0)
-    images = jax.random.normal(
+    images = jax.device_put(jax.random.normal(
         key, (args.batch_size, *input_shape), jnp.float32
+    ))
+    labels = jax.device_put(
+        jax.random.randint(key, (args.batch_size,), 0, 10)
     )
-    labels = jax.random.randint(key, (args.batch_size,), 0, 10)
-    images = jax.device_put(images)
-    labels = jax.device_put(labels)
 
-    # Timing note: on remote-tunneled TPU backends, jax.block_until_ready can
-    # return before device execution finishes, inflating throughput by >100x
-    # (verified against a known-FLOPs matmul). The only trustworthy sync is a
-    # host fetch of a value that depends on the timed work, and the fixed
-    # tunnel round-trip must be cancelled out. So: time two runs of different
-    # lengths, each ended by fetching the final loss, and report the
-    # *marginal* per-step time between them.
-    def timed_run(n_steps: int):
-        # The train step donates its state argument, so each run continues
-        # from (and replaces) trainer.state rather than reusing a donated
-        # buffer.
-        metrics = None
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            trainer.state, metrics = trainer.train_step(
-                trainer.state, images, labels, trainer.rng
-            )
-        loss = float(metrics["loss"])  # host fetch = true device sync
-        return time.perf_counter() - t0, loss
+    def bench_backend(backend: str):
+        trainer = Trainer(
+            TrainConfig(
+                model=args.model,
+                batch_size=args.batch_size,
+                optimizer="adam",
+                learning_rate=0.01,
+                backend=backend,
+                seed=0,
+            ),
+            input_shape=input_shape,
+        )
+        return _bench_train_step(
+            trainer, images, labels, args.steps, args.warmup, args.reps
+        )
 
-    steps = max(1, args.steps)
-    base = max(5, args.warmup)
-    timed_run(max(1, args.warmup))    # compile + warmup
-    t_short, _ = timed_run(base)
-    t_long, last_loss = timed_run(base + steps)
-    # Floor the marginal delta: with tiny --steps, host/tunnel jitter can
-    # make the two runs cross over; never emit a zero/negative step time.
-    step_time = max((t_long - t_short) / steps, 1e-9)
-    metrics = {"loss": last_loss}
+    step_time, last_loss = bench_backend(args.backend)
     ips = args.batch_size / step_time
     # The baseline only describes the flagship model (BASELINE.md covers
     # mnist-dist2.py's bnn-mlp-large); any other model has no reference
@@ -120,8 +252,30 @@ def main() -> None:
         ),
         "backend": args.backend,
         "device": str(jax.devices()[0]),
-        "loss_finite": bool(float(metrics["loss"]) == float(metrics["loss"])),
+        "loss_finite": bool(last_loss == last_loss),
     }
+    if args.all_backends:
+        per_backend = {}
+        for b in BACKENDS:
+            if b == args.backend:
+                per_backend[b] = {
+                    "images_per_sec": round(ips, 1),
+                    "step_time_ms": round(step_time * 1e3, 3),
+                }
+                continue
+            dt, _ = bench_backend(b)
+            per_backend[b] = {
+                "images_per_sec": round(args.batch_size / dt, 1),
+                "step_time_ms": round(dt * 1e3, 3),
+            }
+        result["train_step_per_backend"] = per_backend
+    if not args.no_crossover:
+        if time.monotonic() > deadline:
+            result["crossover"] = "skipped (bench deadline; see PERF.md)"
+        else:
+            result["crossover"] = _gemm_crossover(
+                jax, jnp, deadline, args.reps
+            )
     print(json.dumps(result))
 
 

@@ -1,0 +1,147 @@
+"""Produce the accuracy artifact (RESULTS.md): train the flagship BNN MLP
+for the reference's 5 epochs (mnist-dist2.py defaults: Adam lr=0.01,
+batch 64 — :34,88,90) on the available MNIST data, alongside its fp32
+twin, and record test accuracy + per-epoch wall times.
+
+The reference published only wall-time CSVs from its real run
+(MNIST_EPOCH_TIME(PersonalCom).csv) and never an accuracy; BASELINE.md's
+north star asks for "accuracy within 0.5%" of fp32 — this script measures
+that gap on identical architecture/data/optimizer.
+
+Run: python -m distributed_mnist_bnns_tpu.examples.accuracy_report \
+        [--out RESULTS.md] [--epochs 5] [--models bnn-mlp-large ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from datetime import datetime, timezone
+
+
+def run(models, epochs, batch_size, lr, seed, out_path):
+    import jax
+
+    from ..data import load_mnist
+    from ..train import TrainConfig, Trainer
+
+    data = load_mnist()
+    rows = []
+    for model in models:
+        trainer = Trainer(
+            TrainConfig(
+                model=model,
+                epochs=epochs,
+                batch_size=batch_size,
+                optimizer="adam",
+                learning_rate=lr,
+                seed=seed,
+                log_interval=1000,
+            )
+        )
+        history = trainer.fit(data)
+        rows.append(
+            {
+                "model": model,
+                "epochs": epochs,
+                "test_acc": history[-1]["test_acc"],
+                "test_acc_top5": history[-1]["test_acc_top5"],
+                "test_loss": history[-1]["test_loss"],
+                "epoch_times_s": [round(h["epoch_time_s"], 3) for h in history],
+                "per_epoch_acc": [round(h["test_acc"], 2) for h in history],
+            }
+        )
+
+    bnn = next((r for r in rows if r["model"] == "bnn-mlp-large"), None)
+    fp32 = next((r for r in rows if r["model"] == "fp32-mlp-large"), None)
+    gap = (
+        round(fp32["test_acc"] - bnn["test_acc"], 2)
+        if bnn and fp32
+        else None
+    )
+
+    device = str(jax.devices()[0])
+    lines = [
+        "# RESULTS — recorded training run",
+        "",
+        f"Produced by `python -m distributed_mnist_bnns_tpu.examples."
+        f"accuracy_report` on {datetime.now(timezone.utc).date()} "
+        f"(device: {device}).",
+        "",
+        f"Setup: Adam lr={lr}, batch {batch_size}, {epochs} epochs, "
+        f"seed {seed} — the reference flagship's configuration "
+        f"(mnist-dist2.py:34,88,90). Data: `{data.source}` "
+        f"({len(data.train_labels)} train / {len(data.test_labels)} test; "
+        "the full 60k MNIST train images are not shipped in this "
+        "workspace — see .MISSING_LARGE_BLOBS — so the deterministic "
+        "9k/1k t10k split stands in).",
+        "",
+        "| model | test acc (top-1) | top-5 | test loss | per-epoch acc | "
+        "epoch times (s) |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['model']} | {r['test_acc']:.2f}% | "
+            f"{r['test_acc_top5']:.2f}% | {r['test_loss']:.4f} | "
+            f"{', '.join(str(a) for a in r['per_epoch_acc'])} | "
+            f"{', '.join(str(t) for t in r['epoch_times_s'])} |"
+        )
+    if gap is not None:
+        lines += [
+            "",
+            f"**BNN vs fp32 accuracy gap (identical topology/data/optimizer):"
+            f" {gap:+.2f}%** — BASELINE.md's north star asks for the BNN to "
+            "be within 0.5%.",
+        ]
+    lines += [
+        "",
+        "Reference comparison: the reference published wall times only "
+        "(MNIST_EPOCH_TIME(PersonalCom).csv: ~8.25 s/epoch over 60k images "
+        "at batch 64) and no accuracy (mnist-dist2.py prints train loss "
+        "only, :144-146).",
+        "",
+        "```json",
+        json.dumps(rows, indent=1),
+        "```",
+        "",
+    ]
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {out_path}")
+    for r in rows:
+        print(f"{r['model']}: {r['test_acc']:.2f}%")
+    if gap is not None:
+        print(f"gap (fp32 - bnn): {gap:+.2f}%")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="RESULTS.md")
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument(
+        "--platform", default=None, choices=[None, "cpu", "tpu"],
+        help="pin the jax platform before backend init (use cpu when the "
+             "TPU endpoint is unavailable)",
+    )
+    p.add_argument(
+        "--models", nargs="+",
+        default=["bnn-mlp-large", "fp32-mlp-large", "bnn-mlp-small"],
+    )
+    args = p.parse_args()
+    if args.platform:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    run(args.models, args.epochs, args.batch_size, args.lr, args.seed,
+        args.out)
+
+
+if __name__ == "__main__":
+    main()

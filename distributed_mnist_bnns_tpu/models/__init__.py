@@ -1,5 +1,5 @@
 from .layers import BinarizedDense, BinarizedConv
-from .mlp import BnnMLP, bnn_mlp_large, bnn_mlp_small
+from .mlp import BnnMLP, bnn_mlp_large, bnn_mlp_small, fp32_mlp_large
 from .convnet import ConvNet
 from .cnn import DeepCNN
 from .bnn_cnn import BinarizedCNN
@@ -12,6 +12,7 @@ __all__ = [
     "BnnMLP",
     "bnn_mlp_large",
     "bnn_mlp_small",
+    "fp32_mlp_large",
     "ConvNet",
     "DeepCNN",
     "BinarizedCNN",

@@ -21,13 +21,15 @@ from flax import linen as nn
 from .bnn_cnn import BinarizedCNN
 from .cnn import DeepCNN
 from .convnet import ConvNet
-from .mlp import bnn_mlp_large, bnn_mlp_small
+from .mlp import bnn_mlp_large, bnn_mlp_small, fp32_mlp_large
 from .resnet import xnor_resnet18, xnor_resnet50
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     # flagship BNN MLPs (mnist-dist2.py:46-76 / mnist-dist3.py:40-70)
     "bnn-mlp-large": bnn_mlp_large,
     "bnn-mlp-small": bnn_mlp_small,
+    # fp32 twin of the flagship (accuracy yardstick, BASELINE.md north star)
+    "fp32-mlp-large": fp32_mlp_large,
     # fp32 baselines (mnist-dist.py:31-51, mnist-cnn server.py:7-52)
     "convnet": ConvNet,
     "deep-cnn": DeepCNN,

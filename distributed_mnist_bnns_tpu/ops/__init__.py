@@ -1,9 +1,11 @@
 from .binarize import binarize, binarize_ste, quantize
 from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss, make_loss
-from .bitpack import pack_bits, unpack_bits, packed_dim
+from .bitpack import pack_bits, pack_bits_mxu, unpack_bits, packed_dim
 from .flash_attention import flash_attention
 from .xnor_gemm import (
     xnor_matmul,
+    xnor_matmul_packed,
+    prepack_weights,
     binary_matmul,
     binary_conv2d,
     set_default_backend,
@@ -19,9 +21,12 @@ __all__ = [
     "cross_entropy_loss",
     "make_loss",
     "pack_bits",
+    "pack_bits_mxu",
     "unpack_bits",
     "packed_dim",
     "xnor_matmul",
+    "xnor_matmul_packed",
+    "prepack_weights",
     "binary_matmul",
     "binary_conv2d",
     "flash_attention",

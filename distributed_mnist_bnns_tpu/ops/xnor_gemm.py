@@ -36,7 +36,7 @@ from typing import Literal
 import jax
 import jax.numpy as jnp
 
-from .bitpack import WORD_BITS, pack_bits
+from .bitpack import WORD_BITS, pack_bits_mxu as pack_bits
 
 Backend = Literal["xla", "bf16", "int8", "xnor", "pallas_xnor"]
 
@@ -121,31 +121,51 @@ def _xnor_kernel(x_ref, wt_ref, o_ref, *, real_k: int):
     o_ref[...] -= (2 * mism).astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
-def xnor_matmul(
+def prepack_weights(
+    w_pm1: jnp.ndarray, block_n: int = 256
+) -> tuple[jnp.ndarray, int, int]:
+    """Pack a ±1 (K, N) weight matrix into the kernel's K-major bitplane
+    layout once, for reuse across many ``xnor_matmul_packed`` calls.
+
+    This is the inference fast path: packed weights occupy K/32 the HBM of
+    bf16 weights, so small-batch (bandwidth-bound) GEMMs skip both the
+    per-call weight pack and 32x of the weight traffic. The output is
+    padded to the kernel's block layout (128-word K chunks, ``block_n``
+    columns — pass the same block_n as the matmul call) so the hot path
+    never copies the weights. Returns (packed (KW_p, N_p) int32, k, n)."""
+    k, n = w_pm1.shape
+    wtp = pack_bits(w_pm1.T).T
+    kw = wtp.shape[0]
+    kw_p = kw if kw <= 128 else -(-kw // 128) * 128
+    bn = min(block_n, max(128, n))
+    np_ = -(-n // bn) * bn
+    if (kw_p, np_) != wtp.shape:
+        wtp = jnp.pad(wtp, ((0, kw_p - kw), (0, np_ - n)))
+    return wtp, k, n
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n", "block_m", "block_n", "interpret")
+)
+def xnor_matmul_packed(
     x_pm1: jnp.ndarray,
-    w_pm1: jnp.ndarray,
+    w_packed: jnp.ndarray,
+    k: int,
+    n: int,
     *,
     block_m: int = 256,
     block_n: int = 256,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """(M, K) @ (K, N) on ±1 values via the Pallas XNOR-popcount kernel.
-
-    Pads M and N up to block multiples (padding rows/cols are ±1 garbage and
-    sliced off), packs K into int32 words zero-padded so the popcount formula
-    stays exact (see bitpack.py docstring).
-    """
+    """(M, K) ±1 activations @ pre-packed weights (see prepack_weights)."""
     from jax.experimental import pallas as pl
 
-    m, k = x_pm1.shape
-    k2, n = w_pm1.shape
-    assert k == k2, (x_pm1.shape, w_pm1.shape)
+    m, k2 = x_pm1.shape
+    assert k == k2, (x_pm1.shape, k)
 
     bm = min(block_m, max(8, m))
     bn = min(block_n, max(128, n))
     mp = -(-m // bm) * bm
-    np_ = -(-n // bn) * bn
 
     # The packed-K axis becomes the innermost (sequential) grid dimension.
     # Mosaic requires the last block dim to be 128-divisible or equal to the
@@ -154,20 +174,23 @@ def xnor_matmul(
     # (equal bits -> zero extra mismatches -> the popcount formula stays
     # exact).
     xp = pack_bits(x_pm1)                     # (M, KW)
-    wtp = pack_bits(w_pm1.T).T                # (KW, N)  K-major for the kernel
+    wtp = w_packed                            # (KW_p, N_p)  K-major
     kw = xp.shape[-1]
-    if kw <= 128:
-        kc = kw
-    else:
-        kc = 128
-        kw_p = -(-kw // kc) * kc
+    kc = kw if kw <= 128 else 128
+    # Padded dims: at least the kernel layout, and at least whatever layout
+    # the weights were prepacked with (a larger block_n at prepack time is
+    # fine — the extra zero columns are sliced off below).
+    kw_p = -(-max(kw, wtp.shape[0]) // kc) * kc
+    np_ = -(-max(n, wtp.shape[1]) // bn) * bn
+    if kw_p != kw:
         xp = jnp.pad(xp, ((0, 0), (0, kw_p - kw)))
-        wtp = jnp.pad(wtp, ((0, kw_p - kw), (0, 0)))
-        kw = kw_p
     if mp != m:
         xp = jnp.pad(xp, ((0, mp - m), (0, 0)))
-    if np_ != n:
-        wtp = jnp.pad(wtp, ((0, 0), (0, np_ - n)))
+    if (kw_p, np_) != wtp.shape:  # unpadded/legacy layout: pad per call
+        wtp = jnp.pad(
+            wtp,
+            ((0, kw_p - wtp.shape[0]), (0, np_ - wtp.shape[1])),
+        )
 
     out = pl.pallas_call(
         functools.partial(_xnor_kernel, real_k=k),
@@ -181,6 +204,29 @@ def xnor_matmul(
         interpret=interpret,
     )(xp, wtp)
     return out[:m, :n]
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
+def xnor_matmul(
+    x_pm1: jnp.ndarray,
+    w_pm1: jnp.ndarray,
+    *,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(M, K) @ (K, N) on ±1 values via the Pallas XNOR-popcount kernel.
+
+    Pads M and N up to block multiples (padding rows/cols are ±1 garbage and
+    sliced off), packs K into int32 words zero-padded so the popcount formula
+    stays exact (see bitpack.py docstring). Packs both operands per call —
+    for fixed weights (inference) use prepack_weights + xnor_matmul_packed."""
+    k, n = w_pm1.shape
+    w_packed, _, _ = prepack_weights(w_pm1)
+    return xnor_matmul_packed(
+        x_pm1, w_packed, k, n,
+        block_m=block_m, block_n=block_n, interpret=interpret,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -50,3 +50,22 @@ def test_popcount_dot_identity():
         jnp.sum(jax.lax.population_count(jnp.bitwise_xor(pa, pb)))
     )
     assert k - 2 * mism == int(jnp.dot(a, b))
+
+
+def test_pack_bits_mxu_bit_identical():
+    """The MXU (int8-matmul) pack must produce bit-identical words to the
+    VPU shift-reduce pack for every K alignment, including K % 32 != 0
+    and the pad_words_to chunking used by the Pallas kernel."""
+    import jax
+    from distributed_mnist_bnns_tpu.ops.bitpack import pack_bits, pack_bits_mxu
+
+    for k in (32, 31, 64, 100, 784, 3072):
+        x = jax.random.normal(jax.random.PRNGKey(k), (5, k))
+        x = jnp.where(x >= 0, 1.0, -1.0)
+        np.testing.assert_array_equal(
+            np.asarray(pack_bits(x)), np.asarray(pack_bits_mxu(x))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pack_bits(x, pad_words_to=128)),
+            np.asarray(pack_bits_mxu(x, pad_words_to=128)),
+        )

@@ -184,3 +184,25 @@ def test_first_layer_raw_inputs_exact_conv_backends(backend):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
     )
+
+
+def test_fp32_mlp_twin_topology_and_no_latents():
+    """fp32-mlp-large is the flagship with binarized=False: same topology,
+    ordinary Dense layers, and crucially NO latent-clamp targets (nothing
+    should be clamped to [-1,1] in the fp32 twin)."""
+    from distributed_mnist_bnns_tpu.models import get_model
+
+    bnn = get_model("bnn-mlp-large")
+    fp32 = get_model("fp32-mlp-large")
+    assert fp32.hidden == bnn.hidden and not fp32.binarized
+    x = jnp.zeros((2, 28, 28, 1))
+    variables = fp32.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=True,
+    )
+    mask = latent_clamp_mask(variables["params"])
+    assert not any(jax.tree.leaves(mask))
+    # four Dense layers (3 hidden + head), no Binarized modules
+    names = set(variables["params"])
+    assert sum(n.startswith("Dense_") for n in names) == 4
+    assert not any(n.startswith("Binarized") for n in names)

@@ -205,3 +205,16 @@ def test_bitplane_conv_zero_padding_gradients_match():
         np.asarray(gw["kernel"]), np.asarray(gw_ref["kernel"]),
         atol=1e-4, rtol=1e-4,
     )
+
+
+def test_prepacked_weights_matmul_matches():
+    """prepack_weights + xnor_matmul_packed (the inference fast path) must
+    equal the pack-both-operands xnor_matmul and the fp32 oracle."""
+    from distributed_mnist_bnns_tpu.ops import prepack_weights
+    from distributed_mnist_bnns_tpu.ops.xnor_gemm import xnor_matmul_packed
+
+    x = _pm1(jax.random.PRNGKey(20), (16, 300))
+    w = _pm1(jax.random.PRNGKey(21), (300, 40))
+    wp, k, n = prepack_weights(w)
+    out = xnor_matmul_packed(x, wp, k, n, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.dot(x, w)))

@@ -102,3 +102,17 @@ def test_binarized_conv_im2col_pallas_backend_on_chip():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-4, rtol=0
     )
+
+
+def test_prepacked_xnor_matmul_on_chip():
+    """The inference fast path (pre-packed weights) un-interpreted on the
+    chip at a bandwidth-bound shape."""
+    from distributed_mnist_bnns_tpu.ops import prepack_weights
+    from distributed_mnist_bnns_tpu.ops.xnor_gemm import xnor_matmul_packed
+
+    x = _pm1(3, (8, 8192))
+    w = _pm1(4, (8192, 4096))
+    wp, k, n = prepack_weights(w)
+    got = np.asarray(xnor_matmul_packed(x, wp, k, n))
+    want = np.asarray(jnp.dot(x, w, preferred_element_type=jnp.float32))
+    np.testing.assert_array_equal(got, want)
