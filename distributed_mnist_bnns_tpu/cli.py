@@ -125,7 +125,28 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
     return Trainer(config, input_shape=input_shape)
 
 
+def _honor_platform_env() -> str | None:
+    """Re-assert JAX_PLATFORMS over any sitecustomize that flipped the jax
+    config at interpreter start (some images register experimental PJRT
+    plugins that way). Without this, ``JAX_PLATFORMS=cpu cli train ...``
+    can silently run — or hang dialing — a remote backend.
+
+    Must run before *anything* that can initialize a backend (parser
+    building and logging setup pull package imports that may). Returns the
+    platform that could NOT be pinned (for a deferred warning once logging
+    is configured), or None on success/no-op."""
+    import os
+
+    from .utils.platform import pin_platform
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and not pin_platform(plat):
+        return plat
+    return None
+
+
 def main(argv=None) -> int:
+    repin_failed = _honor_platform_env()
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.norm is not None and args.norm not in (
@@ -138,6 +159,11 @@ def main(argv=None) -> int:
     from .utils import setup_logging
 
     setup_logging(args.log_file)
+    if repin_failed:
+        log.warning(
+            "could not re-pin jax platform to %r (backend already "
+            "initialized)", repin_failed,
+        )
 
     if args.nodes > 1 or args.coordinator:
         from .parallel import initialize_multihost

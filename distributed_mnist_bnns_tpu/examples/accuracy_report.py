@@ -20,6 +20,8 @@ from datetime import datetime, timezone
 
 
 def run(models, epochs, batch_size, lr, seed, out_path):
+    if epochs < 1:
+        raise SystemExit("--epochs must be >= 1")
     import jax
 
     from ..data import load_mnist
@@ -133,12 +135,13 @@ def main():
     )
     args = p.parse_args()
     if args.platform:
-        import os
+        from ..utils.platform import pin_platform
 
-        os.environ["JAX_PLATFORMS"] = args.platform
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
+        if not pin_platform(args.platform):
+            raise RuntimeError(
+                f"cannot pin platform {args.platform!r}: a jax backend is "
+                "already initialized"
+            )
     run(args.models, args.epochs, args.batch_size, args.lr, args.seed,
         args.out)
 

@@ -1,0 +1,51 @@
+"""JAX platform pinning.
+
+Some images install experimental remote-accelerator PJRT plugins from a
+``sitecustomize`` at interpreter start, flipping ``jax_platforms`` in the
+jax config; the ``JAX_PLATFORMS`` environment variable alone then no
+longer decides platform selection, and CPU-only runs can hang dialing a
+remote endpoint. Backends initialize lazily, so re-asserting env + config
+*before any computation* restores the documented env-var contract.
+
+Users of this helper: the CLI (honors JAX_PLATFORMS), the accuracy-report
+example (--platform), and __graft_entry__'s multichip dryrun (virtual CPU
+mesh). tests/conftest.py deliberately keeps its own inline copy: it is the
+bootstrap that must run before this package is safe to import.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def backend_initialized() -> bool:
+    """Has any jax backend already been created (too late to re-pin)?"""
+    xb = sys.modules.get("jax._src.xla_bridge")
+    return bool(getattr(xb, "_backends", None))
+
+
+def pin_platform(
+    platform: str, virtual_device_count: int | None = None
+) -> bool:
+    """Pin jax to ``platform`` via env + config, before backend init.
+
+    ``virtual_device_count`` additionally requests N virtual host devices
+    (``--xla_force_host_platform_device_count``, CPU simulation) unless
+    XLA_FLAGS already carries a count. Returns False — without touching
+    anything — when a backend is already live."""
+    if backend_initialized():
+        return False
+    if virtual_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count="
+                f"{virtual_device_count}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    return True
