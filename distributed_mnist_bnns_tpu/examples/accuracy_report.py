@@ -21,7 +21,7 @@ from datetime import datetime, timezone
 
 def run(models, epochs, batch_size, lr, seed, out_path):
     if epochs < 1:
-        raise SystemExit("--epochs must be >= 1")
+        raise ValueError(f"epochs must be >= 1, got {epochs}")
     import jax
 
     from ..data import load_mnist
@@ -134,6 +134,8 @@ def main():
         default=["bnn-mlp-large", "fp32-mlp-large", "bnn-mlp-small"],
     )
     args = p.parse_args()
+    if args.epochs < 1:
+        p.error("--epochs must be >= 1")
     if args.platform:
         from ..utils.platform import pin_platform
 

@@ -76,15 +76,12 @@ def make_ring_attention(
         ring step computes its K/V shard's attention entirely in VMEM and
         returns (out, lse); shards merge by log-sum-exp rescaling, which
         is algebraically the same online softmax at shard granularity.
-        Non-causal only (per-shard causal offsets are ring-step-dependent).
+        Causal supported: the diagonal ring step runs the causal kernel,
+        earlier-position shards attend fully, later ones are skipped.
     """
     n_shards = mesh.shape[axis]
     if local == "flash":
-        if causal:
-            raise NotImplementedError(
-                "local='flash' supports causal=False only"
-            )
-        return _make_ring_flash(mesh, axis, n_shards, interpret)
+        return _make_ring_flash(mesh, axis, n_shards, causal, interpret)
     if local != "dense":
         raise ValueError(f"unknown local={local!r} (have: dense, flash)")
 
@@ -131,28 +128,36 @@ def make_ring_attention(
     return jax.jit(fn, in_shardings=(sh,) * 3, out_shardings=sh)
 
 
-def _make_ring_flash(mesh: Mesh, axis: str, n_shards: int, interpret: bool):
+def _make_ring_flash(
+    mesh: Mesh, axis: str, n_shards: int, causal: bool, interpret: bool
+):
     """Ring attention with the Pallas flash kernel as the local step.
 
     Each ring step computes full attention of the resident Q shard against
     the currently-held K/V shard on-chip (ops/flash_attention.py) and
     yields (out_i, lse_i); shards merge via the online log-sum-exp
     rescaling — exp weights are reassociated exactly as in flash itself,
-    so the result equals full attention."""
+    so the result equals full attention.
+
+    Causal decomposes by ring step (equal shards, K/V from
+    ``src = my_idx - step mod n``): step 0 is the diagonal block — causal
+    flash with Lq == Lk; a later step is *fully visible* when the held
+    shard came from a lower sequence position (``step <= my_idx``) and
+    *fully masked* otherwise — a runtime ``lax.cond`` between a
+    non-causal flash call and a no-op. The ring rotation itself stays
+    unconditional (every device must participate in every ppermute)."""
     from ..ops.flash_attention import NEG_INF, flash_attention_with_lse
 
     def local_fn(q, k, v):
         b, lq, h, d = q.shape
+        my_idx = jax.lax.axis_index(axis)
         m_run = jnp.full((b, lq, h), NEG_INF, jnp.float32)
         den = jnp.zeros((b, lq, h), jnp.float32)
         num = jnp.zeros((b, lq, h, d), jnp.float32)
         perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
-        def body(step, carry):
-            m_run, den, num, k_cur, v_cur = carry
-            o_i, lse_i = flash_attention_with_lse(
-                q, k_cur, v_cur, False, interpret
-            )
+        def merge(carry, o_i, lse_i):
+            m_run, den, num = carry
             m_new = jnp.maximum(m_run, lse_i)
             w_old = jnp.where(
                 m_run > NEG_INF / 2, jnp.exp(m_run - m_new), 0.0
@@ -160,15 +165,43 @@ def _make_ring_flash(mesh: Mesh, axis: str, n_shards: int, interpret: bool):
             w_new = jnp.where(
                 lse_i > NEG_INF / 2, jnp.exp(lse_i - m_new), 0.0
             )
-            den = den * w_old + w_new
-            num = num * w_old[..., None] + o_i * w_new[..., None]
-            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return m_new, den, num, k_nxt, v_nxt
+            return (
+                m_new,
+                den * w_old + w_new,
+                num * w_old[..., None] + o_i * w_new[..., None],
+            )
 
-        m_run, den, num, _, _ = jax.lax.fori_loop(
-            0, n_shards, body, (m_run, den, num, k, v)
-        )
+        carry = (m_run, den, num)
+        k_cur, v_cur = k, v
+        # Python loop: n_shards is static and small; `step` being static
+        # lets the diagonal pick the causal flash variant at trace time.
+        for step in range(n_shards):
+            if not causal:
+                o_i, lse_i = flash_attention_with_lse(
+                    q, k_cur, v_cur, False, interpret
+                )
+                carry = merge(carry, o_i, lse_i)
+            elif step == 0:
+                o_i, lse_i = flash_attention_with_lse(
+                    q, k_cur, v_cur, True, interpret
+                )
+                carry = merge(carry, o_i, lse_i)
+            else:
+
+                def attend(c, k_cur=k_cur, v_cur=v_cur):
+                    o_i, lse_i = flash_attention_with_lse(
+                        q, k_cur, v_cur, False, interpret
+                    )
+                    return merge(c, o_i, lse_i)
+
+                carry = jax.lax.cond(
+                    step <= my_idx, attend, lambda c: c, carry
+                )
+            if step + 1 < n_shards:
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+        _, den, num = carry
         out = num / jnp.where(den == 0.0, 1.0, den)[..., None]
         return out.astype(q.dtype)
 

@@ -32,10 +32,14 @@ def pin_platform(
 
     ``virtual_device_count`` additionally requests N virtual host devices
     (``--xla_force_host_platform_device_count``, CPU simulation) unless
-    XLA_FLAGS already carries a count. Returns False — without touching
-    anything — when a backend is already live."""
+    XLA_FLAGS already carries a count. When a backend is already live,
+    nothing is touched: returns True if it is already on the requested
+    platform (no-op success), False otherwise (too late to re-pin)."""
     if backend_initialized():
-        return False
+        import jax
+
+        wanted = platform.split(",")[0].strip().lower()
+        return jax.default_backend() == wanted
     if virtual_device_count is not None:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
