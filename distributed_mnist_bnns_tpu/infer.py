@@ -1,0 +1,130 @@
+"""Frozen-model inference for trained BNNs — the packed-bitplane serving
+path.
+
+No reference counterpart (the reference never deploys its BNNs; training
+scripts only). This is the capability binarization exists for: once
+training ends, the fp32 latent masters (models/binarized_modules.py:77-79)
+are dead weight — serving needs only the ±1 weights, which pack to 1 bit
+per parameter (``ops.prepack_weights``), 32x smaller than fp32 and 16x
+smaller than bf16, and the GEMMs run on the bitplane XNOR kernel that wins
+the bandwidth-bound small-batch regime (PERF.md).
+
+The classic XNOR-net folding applies between layers: at eval time
+``binarize(hardtanh(BN(y)))`` collapses to a per-channel integer threshold
+compare, because hardtanh preserves sign and ``binarize`` is the sign:
+
+    sign(BN(y)) = sign(g) * sign(y - theta),  theta = mu - b*sqrt(var+eps)/g
+
+so hidden layers never materialize BN/activation tensors at all: integer
+GEMM -> threshold -> ±1 bits -> next packed GEMM. Only the final block
+(whose hardtanh values feed the fp32 head) computes the real affine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .models.mlp import BnnMLP
+from .ops.binarize import binarize_ste
+from .ops.xnor_gemm import prepack_weights, xnor_matmul_packed
+
+_BN_EPS = 1e-5  # matches BnnMLP's BatchNorm epsilon
+
+
+def _bn_sign_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
+    """binarize(hardtanh(BN(y))) as a threshold compare returning ±1."""
+    g = bn_params["scale"]
+    b = bn_params["bias"]
+    mu = bn_stats["mean"]
+    s = jnp.sqrt(bn_stats["var"] + _BN_EPS)
+    theta = mu - b * s / jnp.where(g == 0.0, 1.0, g)
+
+    def fn(y: jnp.ndarray) -> jnp.ndarray:
+        pos = jnp.where(
+            g > 0.0,
+            y >= theta,
+            jnp.where(g < 0.0, y <= theta, b >= 0.0),
+        )
+        return jnp.where(pos, 1.0, -1.0).astype(jnp.float32)
+
+    return fn
+
+
+def _bn_affine_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
+    """Eval-time BN as a precomputed per-channel affine: a*y + c."""
+    g = bn_params["scale"]
+    b = bn_params["bias"]
+    mu = bn_stats["mean"]
+    s = jnp.sqrt(bn_stats["var"] + _BN_EPS)
+    a = g / s
+    c = b - g * mu / s
+    return lambda y: a * y + c
+
+
+def freeze_bnn_mlp(
+    model: BnnMLP, variables: Dict, *, interpret: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained binarized BnnMLP into a packed inference function.
+
+    Returns (apply_fn, info): ``apply_fn(images) -> log-probs`` computes
+    exactly what ``model.apply(variables, images, train=False)`` computes
+    (up to measure-zero threshold ties), with hidden weights stored as
+    packed bitplanes and BN/hardtanh/binarize folded into thresholds.
+    ``info`` reports the packed weight footprint vs the fp32 masters.
+    """
+    if not model.binarized:
+        raise ValueError("freeze_bnn_mlp requires a binarized BnnMLP")
+    if model.stochastic:
+        raise ValueError(
+            "stochastic activation binarization is a train-time feature; "
+            "freeze the deterministic eval path"
+        )
+    params = variables["params"]
+    stats = variables["batch_stats"]
+
+    # First layer: raw inputs (binarize_input=False), ±1 weights, fp32 dot.
+    w1 = binarize_ste(params["BinarizedDense_0"]["kernel"])
+    b1 = params["BinarizedDense_0"]["bias"]
+    sign1 = _bn_sign_fn(params["BatchNorm_0"], stats["BatchNorm_0"])
+
+    packed = []
+    for i, name in enumerate(("BinarizedDense_1", "BinarizedDense_2")):
+        wp, k, n = prepack_weights(binarize_ste(params[name]["kernel"]))
+        packed.append((wp, k, n, params[name]["bias"]))
+    sign2 = _bn_sign_fn(params["BatchNorm_1"], stats["BatchNorm_1"])
+    affine3 = _bn_affine_fn(params["BatchNorm_2"], stats["BatchNorm_2"])
+    wh = params["Dense_0"]["kernel"]
+    bh = params["Dense_0"]["bias"]
+
+    def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
+        x = images.reshape(images.shape[0], -1).astype(jnp.float32)
+        y = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
+        bits = sign1(y)
+        wp, k, n, b2 = packed[0]
+        y = xnor_matmul_packed(bits, wp, k, n, interpret=interpret) + b2
+        bits = sign2(y)
+        wp, k, n, b3 = packed[1]
+        y = xnor_matmul_packed(bits, wp, k, n, interpret=interpret) + b3
+        # dropout is identity at eval; final block feeds the fp32 head with
+        # real hardtanh values, so compute the actual affine here.
+        h = jnp.clip(affine3(y), -1.0, 1.0)
+        logits = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
+        return jax.nn.log_softmax(logits)
+
+    latent_bytes = sum(
+        int(params[n]["kernel"].size) * 4
+        for n in ("BinarizedDense_0", "BinarizedDense_1", "BinarizedDense_2")
+    )
+    packed_bytes = int(w1.size) * 4 + sum(
+        int(wp.size) * 4 for wp, _, _, _ in packed
+    )
+    info = {
+        "latent_fp32_weight_bytes": latent_bytes,
+        "frozen_weight_bytes": packed_bytes,
+        "compression": round(latent_bytes / packed_bytes, 2),
+        "packed_layers": ["BinarizedDense_1", "BinarizedDense_2"],
+    }
+    return jax.jit(apply_fn), info

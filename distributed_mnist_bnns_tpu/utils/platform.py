@@ -39,7 +39,12 @@ def pin_platform(
         import jax
 
         wanted = platform.split(",")[0].strip().lower()
-        return jax.default_backend() == wanted
+        if jax.default_backend() != wanted:
+            return False
+        return (
+            virtual_device_count is None
+            or jax.local_device_count() >= virtual_device_count
+        )
     if virtual_device_count is not None:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,73 @@
+"""Frozen packed-weight inference vs the live model (eval mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.infer import freeze_bnn_mlp
+from distributed_mnist_bnns_tpu.models import BnnMLP, bnn_mlp_small
+
+
+def _trained_ish_variables(model, key):
+    """Init + a few 'training' mutations so batch_stats are non-trivial."""
+    x = jax.random.normal(key, (32, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=True,
+    )
+    # run a couple of train-mode passes to move the BN running stats
+    for i in range(3):
+        _, mutated = model.apply(
+            variables, x + 0.1 * i, train=True,
+            rngs={"dropout": jax.random.PRNGKey(i)},
+            mutable=["batch_stats"],
+        )
+        variables = {**variables, "batch_stats": mutated["batch_stats"]}
+    return variables
+
+
+def test_frozen_mlp_matches_live_eval():
+    model = bnn_mlp_small(backend="xla")
+    variables = _trained_ish_variables(model, jax.random.PRNGKey(2))
+    frozen, info = freeze_bnn_mlp(model, variables, interpret=True)
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (16, 28, 28, 1))
+    live = model.apply(variables, x, train=False)
+    out = frozen(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(live), atol=1e-4, rtol=1e-4
+    )
+    # Hidden layers pack 32x; the raw-input first layer stays dense, so
+    # total compression depends on the width split (~1.5x for the small
+    # MLP whose fc1 dominates, ~4x for the flagship).
+    assert info["compression"] > 1.4
+    assert info["frozen_weight_bytes"] < info["latent_fp32_weight_bytes"]
+
+
+def test_frozen_mlp_rejects_unsupported_configs():
+    variables = {"params": {}, "batch_stats": {}}
+    with pytest.raises(ValueError):
+        freeze_bnn_mlp(BnnMLP(binarized=False), variables)
+    with pytest.raises(ValueError):
+        freeze_bnn_mlp(BnnMLP(stochastic=True), variables)
+
+
+def test_frozen_mlp_negative_bn_scale_channels():
+    """Channels with negative BN scale flip the threshold direction — force
+    some negative scales and re-check equivalence."""
+    model = bnn_mlp_small(backend="xla")
+    variables = _trained_ish_variables(model, jax.random.PRNGKey(4))
+    params = jax.tree_util.tree_map(lambda x: x, variables["params"])
+    for bn in ("BatchNorm_0", "BatchNorm_1"):
+        scale = params[bn]["scale"]
+        flip = jnp.where(jnp.arange(scale.shape[0]) % 3 == 0, -1.0, 1.0)
+        params[bn] = {**params[bn], "scale": scale * flip}
+    variables = {**variables, "params": params}
+
+    frozen, _ = freeze_bnn_mlp(model, variables, interpret=True)
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, 28, 28, 1))
+    live = model.apply(variables, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(frozen(x)), np.asarray(live), atol=1e-4, rtol=1e-4
+    )
