@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--precision", default="fp32",
                         choices=["fp32", "bf16"],
                         help="bf16 = mixed precision (AMP O2 parity)")
+        sp.add_argument("--scan-steps", type=int, default=1,
+                        help="fuse N train steps into one lax.scan dispatch "
+                             "(device-resident inner loop; single-device "
+                             "or --dp-mode gspmd)")
         sp.add_argument("--remat", action="store_true",
                         help="rematerialize activations in backward "
                              "(jax.checkpoint) to cut HBM use")
@@ -121,6 +125,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         dp_mode=args.dp_mode,
         profile_dir=args.profile_dir,
         remat=args.remat,
+        scan_steps=args.scan_steps,
     )
     return Trainer(config, input_shape=input_shape)
 

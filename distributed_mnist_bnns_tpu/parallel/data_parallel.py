@@ -35,7 +35,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.losses import cross_entropy_loss
-from ..train.trainer import TrainState, clamp_latent
+from ..train.trainer import TrainState, clamp_latent, make_step_body
 
 
 def _assemble_global(tree: Any, sharding: NamedSharding) -> Any:
@@ -64,10 +64,13 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
     return jax.device_put(tree, sharding)
 
 
-def shard_batch(tree: Any, mesh: Mesh, axis: str = "data") -> Any:
-    """Shard leading (batch) dim of every leaf over the given mesh axis —
+def shard_batch(
+    tree: Any, mesh: Mesh, axis: str = "data", *, batch_dim: int = 0
+) -> Any:
+    """Shard the batch dim of every leaf over the given mesh axis —
     the per-rank slicing DistributedSampler does host-side, expressed as a
-    device placement.
+    device placement. ``batch_dim=1`` places (S, B, ...) scan chunks
+    (steps replicated, per-step batch sharded — make_train_scan's layout).
 
     Single-process: a plain device_put with a sharded layout. Multi-process:
     each host's array is only its *local* shard of the global batch
@@ -75,7 +78,7 @@ def shard_batch(tree: Any, mesh: Mesh, axis: str = "data") -> Any:
     mnist-dist2.py:100-102), so the global array must be assembled with
     make_array_from_process_local_data — a device_put onto the global
     sharding would mis-assemble (or fail on non-addressable devices)."""
-    sharding = NamedSharding(mesh, P(axis))
+    sharding = NamedSharding(mesh, P(*([None] * batch_dim), axis))
     if jax.process_count() > 1:
         return _assemble_global(tree, sharding)
     return jax.device_put(tree, sharding)
@@ -89,42 +92,13 @@ def make_dp_train_step(
     donate: bool = True,
     remat: bool = False,
 ) -> Callable:
-    """GSPMD data-parallel train step (grad all-reduce inserted by XLA)."""
+    """GSPMD data-parallel train step (grad all-reduce inserted by XLA).
 
-    def train_step(state, images, labels, rng):
-        step_rng = jax.random.fold_in(rng, state.step)
-        dropout_rng, binarize_rng = jax.random.split(step_rng)
-
-        def compute_loss(params):
-            outs, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images,
-                train=True,
-                rngs={"dropout": dropout_rng, "binarize": binarize_rng},
-                mutable=["batch_stats"],
-            )
-            return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
-
-        if remat:
-            compute_loss = jax.checkpoint(compute_loss)
-
-        (loss, (outs, new_bs)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
-        updates, new_opt_state = state.tx.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
-        new_params = clamp_latent(new_params, clamp_mask)
-        new_state = state.replace(
-            step=state.step + 1,
-            params=new_params,
-            batch_stats=new_bs if new_bs else state.batch_stats,
-            opt_state=new_opt_state,
-        )
-        acc = (jnp.argmax(outs, -1) == labels).mean() * 100.0
-        return new_state, {"loss": loss, "accuracy": acc}
-
+    The body is the single-device step body (train/trainer.py
+    make_step_body); the DP semantics live entirely in the shardings below
+    — XLA turns the batch-sharded loss/grad reductions into ICI
+    all-reduces, the role of DDP's backward hooks."""
+    train_step = make_step_body(clamp_mask, loss_fn=loss_fn, remat=remat)
     repl = NamedSharding(mesh, P())
     data_sh = NamedSharding(mesh, P("data"))
     return jax.jit(

@@ -10,6 +10,8 @@ from .trainer import (
     TrainState,
     make_eval_step,
     make_masked_eval_step,
+    make_step_body,
+    make_train_scan,
     make_train_step,
 )
 
@@ -22,6 +24,8 @@ __all__ = [
     "Trainer",
     "TrainState",
     "make_train_step",
+    "make_train_scan",
+    "make_step_body",
     "make_eval_step",
     "make_masked_eval_step",
 ]

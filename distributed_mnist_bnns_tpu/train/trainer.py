@@ -71,14 +71,18 @@ def clamp_latent(params: Any, mask: Any) -> Any:
     )
 
 
-def make_train_step(
+def make_step_body(
     clamp_mask: Any,
     *,
     loss_fn: Callable = cross_entropy_loss,
-    donate: bool = True,
     remat: bool = False,
 ) -> Callable:
-    """Build the jitted train step: fwd -> loss -> bwd -> optax -> clamp.
+    """The un-jitted train-step body: fwd -> loss -> bwd -> optax -> clamp.
+
+    Shared by the jitted single-step path (``make_train_step``), the
+    multi-step scan path (``make_train_scan``) and the GSPMD DP step
+    (parallel/data_parallel.py) — one definition of the reference's STE
+    train semantics (mnist-dist2.py:118-137).
 
     ``remat=True`` wraps the forward in jax.checkpoint, discarding
     activations and recomputing them in backward — the HBM-for-FLOPs trade
@@ -125,7 +129,71 @@ def make_train_step(
         acc = (jnp.argmax(outs, -1) == labels).mean() * 100.0
         return new_state, {"loss": loss, "accuracy": acc}
 
-    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+    return train_step
+
+
+def make_train_step(
+    clamp_mask: Any,
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    remat: bool = False,
+) -> Callable:
+    """Jitted single-batch train step (see ``make_step_body``)."""
+    body = make_step_body(clamp_mask, loss_fn=loss_fn, remat=remat)
+    return jax.jit(body, donate_argnums=(0,) if donate else ())
+
+
+def make_train_scan(
+    clamp_mask: Any,
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    remat: bool = False,
+    mesh=None,
+) -> Callable:
+    """Multi-step train dispatch: ``lax.scan`` the step body over a stacked
+    chunk of minibatches — signature ``(state, images (S,B,...),
+    labels (S,B), rng) -> (state, metrics)``, with metrics averaged over
+    the S steps.
+
+    TPU-first rationale: the per-step path pays one host->device dispatch
+    per batch; on a remote/tunneled or busy host that dispatch latency
+    (~ms) exceeds the device step time and becomes the training bottleneck.
+    Scanning S steps inside one XLA program makes the loop device-resident
+    — one dispatch, zero host round-trips between steps — the same reason
+    production JAX training loops scan over microbatches. The reference has
+    no counterpart (its Python loop syncs with CUDA every batch,
+    mnist-dist2.py:118-146); per-step rng/step-count semantics are
+    preserved exactly (fold_in on ``state.step`` inside the body).
+
+    With ``mesh``, inputs are expected sharded P(None, 'data') (batch axis
+    sharded per step, steps replicated) and the state replicated — the
+    GSPMD DP layout of parallel/data_parallel.py."""
+    body = make_step_body(clamp_mask, loss_fn=loss_fn, remat=remat)
+
+    def train_scan(state, images, labels, rng):
+        def scan_body(st, xs):
+            im, lb = xs
+            st, metrics = body(st, im, lb, rng)
+            return st, metrics
+
+        state, ms = jax.lax.scan(scan_body, state, (images, labels))
+        return state, jax.tree.map(jnp.mean, ms)
+
+    donate_argnums = (0,) if donate else ()
+    if mesh is None:
+        return jax.jit(train_scan, donate_argnums=donate_argnums)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    chunk_sh = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(
+        train_scan,
+        in_shardings=(repl, chunk_sh, chunk_sh, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=donate_argnums,
+    )
 
 
 def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
@@ -207,8 +275,26 @@ class TrainConfig:
     dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
                                    # (ZeRO-style sharded params/opt state)
     remat: bool = False            # jax.checkpoint the forward (HBM saver)
+    scan_steps: int = 1            # >1: lax.scan S steps per dispatch
+                                   # (device-resident inner loop; see
+                                   # make_train_scan)
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     profile_steps: int = 5
+
+
+def _prefetch_chunks(items, size: int = 2):
+    """prefetch_to_device for (images, labels, n_batches) scan-chunk items:
+    the arrays are device_put ahead of compute, the batch count passes
+    through as a plain int (it steers host-side control flow)."""
+    import collections
+
+    queue = collections.deque()
+    for images, labels, n in items:
+        queue.append((jax.device_put(images), jax.device_put(labels), n))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
 
 
 def _make_rng_replicator(mesh) -> Callable:
@@ -289,6 +375,7 @@ class Trainer:
         self.batch_meter = AverageMeter()
         self._profiled = False  # trace the first epoch this trainer runs
         self._masked_eval_step = None  # built lazily for mesh-native eval
+        self._train_scan = None        # built lazily when scan_steps > 1
 
     @staticmethod
     def _build_model(name: str, mk: Dict[str, Any]):
@@ -435,6 +522,46 @@ class Trainer:
                 totals[k] += float(out[k])
         return totals
 
+    # -- multi-step scan dispatch -------------------------------------------
+
+    def _effective_scan_steps(self) -> int:
+        """scan_steps, gated to the paths the scan composes with (single
+        device and GSPMD DP; FSDP/shard_map keep the per-step path)."""
+        s = max(int(self.config.scan_steps), 1)
+        if s > 1 and self.mesh is not None and self.config.dp_mode != "gspmd":
+            log.warning(
+                "scan_steps=%d is only supported single-device or with "
+                "dp_mode='gspmd'; falling back to per-step dispatch", s,
+            )
+            return 1
+        return s
+
+    def _get_train_scan(self) -> Callable:
+        if self._train_scan is not None:
+            return self._train_scan
+        scan = make_train_scan(
+            self.clamp_mask, loss_fn=self._loss_fn,
+            remat=self.config.remat, mesh=self.mesh,
+        )
+        if self.mesh is not None:
+            from ..parallel import shard_batch
+
+            mesh = self.mesh
+            rng_global = _make_rng_replicator(mesh)
+
+            def wrapped(state, images, labels, rng):
+                return scan(
+                    state,
+                    shard_batch(images, mesh, batch_dim=1),
+                    shard_batch(labels, mesh, batch_dim=1),
+                    rng_global(rng),
+                )
+
+            self._train_scan = wrapped
+        else:
+            self._train_scan = scan
+        return self._train_scan
+
     # -- epoch-level hyperparameter control ---------------------------------
 
     def _lr_for_epoch(self, epoch: int) -> float:
@@ -447,6 +574,7 @@ class Trainer:
     def _apply_epoch_regime(self, epoch: int) -> None:
         cfg = self.regime.config_at(epoch)
         if self.regime.optimizer_changed(epoch):
+            self._train_scan = None  # tx is a static arg; rebuild the scan
             # Optimizer class switch: rebuild transform, fresh moments
             # (adjust_optimizer reconstructs the torch class the same way,
             # utils.py:120-126).
@@ -485,9 +613,36 @@ class Trainer:
 
     # -- loops --------------------------------------------------------------
 
+    @staticmethod
+    def _scan_chunks(it, scan_steps: int):
+        """Group a batch iterator into (images, labels, n_batches) items:
+        full S-batch stacks for the scan dispatch, then any leftover
+        (< S at epoch end) batches individually (n=1) so no data is
+        dropped beyond the iterator's own drop_last."""
+        buf: list = []
+        for batch in it:
+            buf.append(batch)
+            if len(buf) == scan_steps:
+                yield (
+                    np.stack([b[0] for b in buf]),
+                    np.stack([b[1] for b in buf]),
+                    scan_steps,
+                )
+                buf.clear()
+        for images, labels in buf:
+            yield images, labels, 1
+
     def train_epoch(self, data, epoch: int) -> Dict[str, float]:
+        """One epoch. With ``scan_steps > 1`` batches are grouped into
+        (S, B, ...) chunks and each chunk runs as ONE device program
+        (``make_train_scan``); recorded per-batch times are then the chunk
+        time amortized over its S steps (the host cannot observe
+        individual steps of a device-resident loop), and metric logging /
+        profiling happen at chunk granularity."""
         cfg = self.config
         self._apply_epoch_regime(epoch)
+        S = self._effective_scan_steps()
+        scan_step = self._get_train_scan() if S > 1 else None
         losses, accs = AverageMeter(), AverageMeter()
         self.batch_meter.reset()
         batch_times = []
@@ -500,10 +655,20 @@ class Trainer:
             host_id=jax.process_index(),
             num_hosts=jax.process_count(),
         )
-        if self.mesh is None:
+        if S > 1:
+            items = self._scan_chunks(it, S)
+            if self.mesh is None:
+                # Same overlap as the per-step path, at chunk granularity:
+                # device_put is async, so the host stacks/uploads chunk
+                # k+1 while the device runs chunk k's scan (the mesh path
+                # shards its own inputs inside shard_batch).
+                items = _prefetch_chunks(items)
+        elif self.mesh is None:
             # Run H2D copies ahead of compute (the DP step shards its own
             # inputs, so prefetch only applies to the single-mesh path).
-            it = prefetch_to_device(it)
+            items = ((im, lb, 1) for im, lb in prefetch_to_device(it))
+        else:
+            items = ((im, lb, 1) for im, lb in it)
         # Profile the first epoch actually run (resume may skip epoch 0);
         # stop_trace in a finally so a failing step can't leave the global
         # profiler started (which would crash any later start_trace).
@@ -512,34 +677,41 @@ class Trainer:
             self._profiled = True
             jax.profiler.start_trace(cfg.profile_dir)
         epoch_start = time.perf_counter()
+        seen = 0  # batches (= optimizer steps) run so far this epoch
         try:
-            for i, (images, labels) in enumerate(it):
+            for images, labels, n in items:
                 t0 = time.perf_counter()
                 if self.mesh is None:
                     # (prefetched) single-device upload; the mesh paths
                     # feed numpy straight to shard_batch — one transfer,
                     # no host round-trip through the default device.
                     images, labels = jnp.asarray(images), jnp.asarray(labels)
-                self.state, metrics = self.train_step(
+                step_fn = scan_step if n > 1 else self.train_step
+                self.state, metrics = step_fn(
                     self.state, images, labels, self.rng,
                 )
-                if i == 0 or (i + 1) % cfg.log_interval == 0:
+                first = seen == 0
+                seen += n
+                if first or seen % max(cfg.log_interval, 1) < n:
                     # sync only at log boundaries to keep the pipeline full
                     metrics = jax.tree.map(lambda x: float(x), metrics)
-                    losses.update(metrics["loss"], len(labels))
-                    accs.update(metrics["accuracy"], len(labels))
+                    losses.update(metrics["loss"], n * cfg.batch_size)
+                    accs.update(metrics["accuracy"], n * cfg.batch_size)
                     if jax.process_index() == 0:
                         log.info(
-                            "epoch %d step %d loss %.4f acc %.2f%% (%.2f ms/batch)",
-                            epoch, i + 1, metrics["loss"], metrics["accuracy"],
+                            "epoch %d step %d loss %.4f acc %.2f%% "
+                            "(%.2f ms/batch%s)",
+                            epoch, seen, metrics["loss"],
+                            metrics["accuracy"],
                             self.batch_meter.avg * 1e3,
+                            f", {n}-step scan" if n > 1 else "",
                         )
                 dt = time.perf_counter() - t0
-                self.batch_meter.update(dt)
-                batch_times.append(dt)
+                self.batch_meter.update(dt / n, n)
+                batch_times.extend([dt / n] * n)
                 # Stop the trace outside the timed region so the sync +
                 # trace-dump I/O doesn't pollute the recorded batch time.
-                if profiling and i + 1 == cfg.profile_steps:
+                if profiling and seen >= cfg.profile_steps:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
                     profiling = False
