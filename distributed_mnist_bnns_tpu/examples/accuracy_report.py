@@ -19,7 +19,7 @@ import json
 from datetime import datetime, timezone
 
 
-def run(models, epochs, batch_size, lr, seed, out_path):
+def run(models, epochs, batch_size, lr, seeds, out_path, scan_steps=1):
     if epochs < 1:
         raise ValueError(f"epochs must be >= 1, got {epochs}")
     import jax
@@ -30,25 +30,39 @@ def run(models, epochs, batch_size, lr, seed, out_path):
     data = load_mnist()
     rows = []
     for model in models:
-        trainer = Trainer(
-            TrainConfig(
-                model=model,
-                epochs=epochs,
-                batch_size=batch_size,
-                optimizer="adam",
-                learning_rate=lr,
-                seed=seed,
-                log_interval=1000,
+        per_seed = []
+        for seed in seeds:
+            trainer = Trainer(
+                TrainConfig(
+                    model=model,
+                    epochs=epochs,
+                    batch_size=batch_size,
+                    optimizer="adam",
+                    learning_rate=lr,
+                    seed=seed,
+                    log_interval=1000,
+                    scan_steps=scan_steps,
+                )
             )
-        )
-        history = trainer.fit(data)
+            per_seed.append(trainer.fit(data))
+        # Accuracy on the available 1000-example test split moves ~0.1%
+        # per example; a single seed is inside that noise, so the
+        # headline figure is the mean over seeds (per-seed values kept).
+        history = per_seed[0]
+        n = float(len(per_seed))
         rows.append(
             {
                 "model": model,
                 "epochs": epochs,
-                "test_acc": history[-1]["test_acc"],
-                "test_acc_top5": history[-1]["test_acc_top5"],
-                "test_loss": history[-1]["test_loss"],
+                "seeds": list(seeds),
+                "test_acc": sum(h[-1]["test_acc"] for h in per_seed) / n,
+                "test_acc_per_seed": [
+                    round(h[-1]["test_acc"], 2) for h in per_seed
+                ],
+                "test_acc_top5": sum(
+                    h[-1]["test_acc_top5"] for h in per_seed
+                ) / n,
+                "test_loss": sum(h[-1]["test_loss"] for h in per_seed) / n,
                 "epoch_times_s": [round(h["epoch_time_s"], 3) for h in history],
                 "per_epoch_acc": [round(h["test_acc"], 2) for h in history],
             }
@@ -71,20 +85,24 @@ def run(models, epochs, batch_size, lr, seed, out_path):
         f"(device: {device}).",
         "",
         f"Setup: Adam lr={lr}, batch {batch_size}, {epochs} epochs, "
-        f"seed {seed} — the reference flagship's configuration "
+        f"accuracies averaged over seeds {list(seeds)} (the 1000-example "
+        "test split moves ~0.1% per example, so single-seed accuracy is "
+        "noise-dominated) — otherwise the reference flagship's "
+        "configuration "
         f"(mnist-dist2.py:34,88,90). Data: `{data.source}` "
         f"({len(data.train_labels)} train / {len(data.test_labels)} test; "
         "the full 60k MNIST train images are not shipped in this "
         "workspace — see .MISSING_LARGE_BLOBS — so the deterministic "
         "9k/1k t10k split stands in).",
         "",
-        "| model | test acc (top-1) | top-5 | test loss | per-epoch acc | "
-        "epoch times (s) |",
-        "|---|---|---|---|---|---|",
+        "| model | test acc (top-1, mean) | per-seed | top-5 | test loss | "
+        "per-epoch acc (seed 0) | epoch times (s) |",
+        "|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
             f"| {r['model']} | {r['test_acc']:.2f}% | "
+            f"{', '.join(str(a) for a in r['test_acc_per_seed'])} | "
             f"{r['test_acc_top5']:.2f}% | {r['test_loss']:.4f} | "
             f"{', '.join(str(a) for a in r['per_epoch_acc'])} | "
             f"{', '.join(str(t) for t in r['epoch_times_s'])} |"
@@ -123,7 +141,11 @@ def main():
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=0.01)
-    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--seeds", type=int, nargs="+", default=[42, 43, 44])
+    p.add_argument("--scan-steps", type=int, default=1,
+                   help="fuse N train steps per dispatch (TrainConfig."
+                        "scan_steps); identical trajectory, removes "
+                        "per-step host dispatch latency")
     p.add_argument(
         "--platform", default=None, choices=[None, "cpu", "tpu"],
         help="pin the jax platform before backend init (use cpu when the "
@@ -144,8 +166,8 @@ def main():
                 f"cannot pin platform {args.platform!r}: a jax backend is "
                 "already initialized"
             )
-    run(args.models, args.epochs, args.batch_size, args.lr, args.seed,
-        args.out)
+    run(args.models, args.epochs, args.batch_size, args.lr, args.seeds,
+        args.out, scan_steps=args.scan_steps)
 
 
 if __name__ == "__main__":
