@@ -71,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fallback synthetic dataset sizes")
         sp.add_argument("--checkpoint-dir", default=None)
         sp.add_argument("--save-all", action="store_true")
+        sp.add_argument("--async-checkpoint", action="store_true",
+                        help="overlap checkpoint serialization/IO with "
+                             "training (background writer thread)")
         sp.add_argument("--resume", action="store_true")
         sp.add_argument("--results", default=None)
         sp.add_argument("--timing-csv", default=None,
@@ -120,6 +123,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         timing_csv_prefix=args.timing_csv,
         checkpoint_dir=args.checkpoint_dir,
         save_all_epochs=args.save_all,
+        async_checkpoint=args.async_checkpoint,
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
         dp_mode=args.dp_mode,

@@ -42,6 +42,7 @@ from ..data import batch_iterator, prefetch_to_device
 from ..models import get_model, latent_clamp_mask
 from ..ops.losses import cross_entropy_loss
 from ..utils.checkpoint import (
+    AsyncCheckpointer,
     latest_exists,
     load_checkpoint,
     read_meta,
@@ -270,6 +271,7 @@ class TrainConfig:
     timing_csv_prefix: Optional[str] = None  # write per-batch/epoch CSVs
     checkpoint_dir: Optional[str] = None
     save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
+    async_checkpoint: bool = False  # overlap checkpoint IO with training
     resume: bool = False           # restore latest checkpoint before fit
     data_parallel: Optional[object] = None  # None | "auto" | int devices
     dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
@@ -376,6 +378,9 @@ class Trainer:
         self._profiled = False  # trace the first epoch this trainer runs
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
+        self._checkpointer = (
+            AsyncCheckpointer() if config.async_checkpoint else None
+        )
 
     @staticmethod
     def _build_model(name: str, mk: Dict[str, Any]):
@@ -755,6 +760,8 @@ class Trainer:
 
     def try_resume(self) -> int:
         """Restore the latest checkpoint if present; returns start epoch."""
+        if self._checkpointer is not None:
+            self._checkpointer.wait()  # make any in-flight save visible
         ckpt = self.config.checkpoint_dir
         if not (ckpt and latest_exists(ckpt)):
             return 0
@@ -780,7 +787,12 @@ class Trainer:
                 acc = row.get("test_acc", 0.0)
                 is_best = acc > self.best_acc
                 self.best_acc = max(self.best_acc, acc)
-                save_checkpoint(
+                save = (
+                    self._checkpointer.save
+                    if self._checkpointer is not None
+                    else save_checkpoint
+                )
+                save(
                     self.state,
                     self.config.checkpoint_dir,
                     is_best=is_best,
@@ -798,6 +810,11 @@ class Trainer:
                 self.results.add(**row)
                 if self.config.results_path:
                     self.results.save()
+        if self._checkpointer is not None:
+            # Join the last async write (and re-raise any IO error) before
+            # reporting the run finished — fit's contract is "checkpoints
+            # on disk", async or not.
+            self._checkpointer.wait()
         return history
 
     def _dump_timing_csvs(self, epoch, batch_times, epoch_time) -> None:

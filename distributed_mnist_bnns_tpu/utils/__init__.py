@@ -2,7 +2,13 @@ from .logging_utils import setup_logging, is_primary_host
 from .meters import AverageMeter
 from .results import ResultsLog
 from .metrics import accuracy
-from .checkpoint import save_checkpoint, load_checkpoint, read_meta, latest_exists
+from .checkpoint import (
+    AsyncCheckpointer,
+    latest_exists,
+    load_checkpoint,
+    read_meta,
+    save_checkpoint,
+)
 from .profiling import StepTimer, trace, annotate
 from .recovery import run_with_recovery, TrainingFailure
 
@@ -13,6 +19,7 @@ __all__ = [
     "ResultsLog",
     "accuracy",
     "save_checkpoint",
+    "AsyncCheckpointer",
     "load_checkpoint",
     "read_meta",
     "latest_exists",

@@ -51,6 +51,43 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
+def _write_checkpoint(
+    host_state: Any,
+    path: str,
+    is_best: bool,
+    epoch: Optional[int],
+    save_all: bool,
+    extra_meta: Optional[dict],
+) -> str:
+    """Serialize an already-host-resident state pytree and write it
+    atomically (process 0 only). Pure host work — safe to run on a
+    background thread (AsyncCheckpointer) or inline (save_checkpoint)."""
+    os.makedirs(path, exist_ok=True)
+    target = os.path.join(path, LATEST)
+    if jax.process_index() == 0:
+        data = serialization.to_bytes(host_state)
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, target)  # atomic
+        meta = {
+            "epoch": epoch,
+            "step": int(np.asarray(host_state.step))
+            if hasattr(host_state, "step") else None,
+        }
+        meta.update(extra_meta or {})
+        with open(os.path.join(path, META), "w") as f:
+            json.dump(meta, f)
+        if is_best:
+            shutil.copyfile(target, os.path.join(path, BEST))
+        if save_all and epoch is not None:
+            shutil.copyfile(
+                target, os.path.join(path, f"checkpoint_epoch_{epoch}.msgpack")
+            )
+        log.info("saved checkpoint to %s (epoch=%s best=%s)", target, epoch, is_best)
+    return target
+
+
 def save_checkpoint(
     state: Any,
     path: str,
@@ -64,28 +101,75 @@ def save_checkpoint(
 
     Only process 0 writes; every process passes the trailing barrier so no
     one races ahead to read a half-written file."""
-    os.makedirs(path, exist_ok=True)
-    target = os.path.join(path, LATEST)
-    if jax.process_index() == 0:
-        data = serialization.to_bytes(_to_host(state))
-        tmp = target + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, target)  # atomic
-        meta = {"epoch": epoch, "step": int(np.asarray(jax.device_get(state.step)))
-                if hasattr(state, "step") else None}
-        meta.update(extra_meta or {})
-        with open(os.path.join(path, META), "w") as f:
-            json.dump(meta, f)
-        if is_best:
-            shutil.copyfile(target, os.path.join(path, BEST))
-        if save_all and epoch is not None:
-            shutil.copyfile(
-                target, os.path.join(path, f"checkpoint_epoch_{epoch}.msgpack")
-            )
-        log.info("saved checkpoint to %s (epoch=%s best=%s)", target, epoch, is_best)
+    target = _write_checkpoint(
+        _to_host(state), path, is_best, epoch, save_all, extra_meta
+    )
     _barrier("checkpoint_save")
     return target
+
+
+class AsyncCheckpointer:
+    """Checkpointing that overlaps serialization + disk IO with training.
+
+    ``save`` snapshots the state to host arrays synchronously (the only
+    part that must happen before the training loop mutates/donates the
+    device buffers) and hands msgpack serialization, the atomic write and
+    the best/per-epoch copies to a single background thread — training
+    resumes immediately instead of stalling for the write (the role
+    Orbax's async checkpointing plays in production JAX training; the
+    reference always blocks, utils.py:76-83).
+
+    Ordering/visibility contract:
+      * one write in flight at a time — a new ``save`` first joins the
+        previous one, so on-disk "latest" order always matches call order;
+      * ``wait()`` joins the in-flight write, re-raises any background
+        exception, and runs the cross-host barrier (moved out of ``save``
+        — multi-process callers that need the file visible call
+        ``wait()``; Trainer does this at end of fit and before resume);
+      * usable as a context manager (``close`` on exit).
+    """
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer"
+        )
+        self._inflight = None
+
+    def save(
+        self,
+        state: Any,
+        path: str,
+        *,
+        is_best: bool = False,
+        epoch: Optional[int] = None,
+        save_all: bool = False,
+        extra_meta: Optional[dict] = None,
+    ) -> str:
+        self.wait()  # single writer: preserve on-disk ordering
+        host_state = _to_host(state)  # sync snapshot; copies off device
+        self._inflight = self._executor.submit(
+            _write_checkpoint, host_state, path, is_best, epoch, save_all,
+            extra_meta,
+        )
+        return os.path.join(path, LATEST)
+
+    def wait(self) -> None:
+        if self._inflight is not None:
+            inflight, self._inflight = self._inflight, None
+            inflight.result()  # re-raises background write errors
+            _barrier("checkpoint_save")
+
+    def close(self) -> None:
+        self.wait()
+        self._executor.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def load_checkpoint(state_template: Any, path: str, *, best: bool = False) -> Any:
