@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--async-checkpoint", action="store_true",
                         help="overlap checkpoint serialization/IO with "
                              "training (background writer thread)")
+        sp.add_argument("--native-loader", action="store_true",
+                        help="gather batches on C++ worker threads "
+                             "(native BatchPool; python fallback if the "
+                             "toolchain is unavailable)")
         sp.add_argument("--resume", action="store_true")
         sp.add_argument("--results", default=None)
         sp.add_argument("--timing-csv", default=None,
@@ -124,6 +128,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         checkpoint_dir=args.checkpoint_dir,
         save_all_epochs=args.save_all,
         async_checkpoint=args.async_checkpoint,
+        native_loader=args.native_loader,
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
         dp_mode=args.dp_mode,

@@ -6,6 +6,7 @@ from .mnist import (
     load_mnist,
     shard_indices,
     batch_iterator,
+    native_batch_iterator,
     MNIST_MEAN,
     MNIST_STD,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "load_dataset",
     "shard_indices",
     "batch_iterator",
+    "native_batch_iterator",
     "MNIST_MEAN",
     "MNIST_STD",
     "CIFAR10_MEAN",

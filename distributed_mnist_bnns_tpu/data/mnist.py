@@ -190,3 +190,48 @@ def batch_iterator(
     if not drop_last and len(idx) % batch_size:
         sel = idx[n_full * batch_size :]
         yield images[sel], labels[sel]
+
+
+def native_batch_iterator(
+    images: np.ndarray,
+    labels: np.ndarray,
+    batch_size: int,
+    *,
+    epoch: int = 0,
+    seed: int = 0,
+    host_id: int = 0,
+    num_hosts: int = 1,
+    shuffle: bool = True,
+    n_threads: int = 2,
+    n_slots: int = 4,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """``batch_iterator`` served by the native threaded BatchPool
+    (native/batch_pool.cpp): the per-batch random-access row gathers run
+    on C++ worker threads ahead of the consumer — torch DataLoader's
+    num_workers capability for this pipeline. Identical sharding/order
+    semantics (same shard_indices, drop_last); transparently falls back
+    to the python iterator when the native library is unavailable or the
+    data is not float32-images/int-labels shaped."""
+    from .. import native
+
+    idx = shard_indices(
+        len(images), epoch=epoch, seed=seed, host_id=host_id,
+        num_hosts=num_hosts, shuffle=shuffle,
+    )
+    pool = None
+    if images.dtype == np.float32:
+        try:
+            pool = native.BatchPool.create(
+                images, labels, idx, batch_size,
+                n_threads=n_threads, n_slots=n_slots,
+            )
+        except Exception as e:  # never fail the train loop over the pool
+            log.warning("native BatchPool unavailable (%s); python path", e)
+    if pool is None:
+        n_full = len(idx) // batch_size
+        for b in range(n_full):
+            sel = idx[b * batch_size : (b + 1) * batch_size]
+            yield images[sel], labels[sel]
+        return
+    with pool:
+        yield from pool

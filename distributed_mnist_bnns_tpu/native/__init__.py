@@ -34,15 +34,20 @@ def _load() -> Optional[ctypes.CDLL]:
     # temp and os.replace it in — atomic, so concurrent processes (multi-
     # host training, pytest-xdist) never dlopen a half-written file; at
     # worst they compile redundantly.
-    src = os.path.join(_DIR, "idx_loader.cpp")
+    srcs = [
+        os.path.join(_DIR, "idx_loader.cpp"),
+        os.path.join(_DIR, "batch_pool.cpp"),
+    ]
     try:
         need = (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(src))
+                or os.path.getmtime(_SO) < max(
+                    os.path.getmtime(s) for s in srcs
+                ))
         if need:
             tmp = f"{_SO}.tmp.{os.getpid()}"
             subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", src,
-                 "-o", tmp],
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+                 *srcs, "-o", tmp],
                 check=True, capture_output=True, timeout=120,
             )
             os.replace(tmp, _SO)
@@ -86,6 +91,19 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8),
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
     ]
+    lib.bp_create.restype = ctypes.c_void_p
+    lib.bp_create.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.bp_next.restype = ctypes.c_int64
+    lib.bp_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.bp_destroy.restype = None
+    lib.bp_destroy.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -145,6 +163,85 @@ def pack_bits_native(x: np.ndarray) -> Optional[np.ndarray]:
         rows, k, kw,
     )
     return out
+
+
+class BatchPool:
+    """Threaded native batch loader: worker threads gather shuffled
+    batches (the random-access images[idx] row gathers) into a ring of
+    slots ahead of the consumer — torch DataLoader's num_workers
+    capability, for this framework's host pipeline. Delivery is strictly
+    in index order (deterministic regardless of thread scheduling).
+
+    Iterate to receive (images (batch, *item_shape) float32,
+    labels (batch,) int32) — caller-owned arrays, no lifetime coupling to
+    the pool. Use as a context manager or rely on __del__ to join the
+    workers. Falls back at construction: ``BatchPool.create`` returns
+    None when the native library is unavailable.
+    """
+
+    def __init__(self, lib, images: np.ndarray, labels: np.ndarray,
+                 idx: np.ndarray, batch: int, n_threads: int,
+                 n_slots: int):
+        self._lib = lib
+        # Keep references: the pool reads these buffers from C++.
+        self._images = np.ascontiguousarray(images, dtype=np.float32)
+        self._labels = np.ascontiguousarray(labels, dtype=np.int32)
+        self._item_shape = self._images.shape[1:]
+        feat = int(np.prod(self._item_shape)) if self._item_shape else 1
+        self._feat = feat
+        self._batch = int(batch)
+        idx = np.ascontiguousarray(idx, dtype=np.int64)
+        self._n_batches = len(idx) // self._batch
+        idx = idx[: self._n_batches * self._batch]
+        if idx.size and (idx.min() < 0 or idx.max() >= len(self._labels)):
+            raise IndexError("batch indices out of range")
+        self._handle = lib.bp_create(
+            self._images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            feat,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._n_batches, self._batch, n_threads, n_slots,
+        )
+        if not self._handle:
+            raise RuntimeError("bp_create failed")
+
+    @classmethod
+    def create(cls, images, labels, idx, batch, *, n_threads: int = 2,
+               n_slots: int = 4) -> Optional["BatchPool"]:
+        lib = _load()
+        if lib is None:
+            return None
+        return cls(lib, images, labels, idx, batch, n_threads, n_slots)
+
+    def __iter__(self):
+        while True:
+            images = np.empty((self._batch, self._feat), np.float32)
+            labels = np.empty((self._batch,), np.int32)
+            b = self._lib.bp_next(
+                self._handle,
+                images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            if b < 0:
+                return
+            yield images.reshape((self._batch, *self._item_shape)), labels
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.bp_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def cifar_bin_decode_native(path: str, n_records: int):

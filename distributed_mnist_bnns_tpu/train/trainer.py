@@ -38,7 +38,7 @@ import numpy as np
 import optax
 from flax import struct
 
-from ..data import batch_iterator, prefetch_to_device
+from ..data import batch_iterator, native_batch_iterator, prefetch_to_device
 from ..models import get_model, latent_clamp_mask
 from ..ops.losses import cross_entropy_loss
 from ..utils.checkpoint import (
@@ -272,6 +272,7 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
     async_checkpoint: bool = False  # overlap checkpoint IO with training
+    native_loader: bool = False    # C++ threaded batch gather (BatchPool)
     resume: bool = False           # restore latest checkpoint before fit
     data_parallel: Optional[object] = None  # None | "auto" | int devices
     dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
@@ -651,7 +652,8 @@ class Trainer:
         losses, accs = AverageMeter(), AverageMeter()
         self.batch_meter.reset()
         batch_times = []
-        it = batch_iterator(
+        it_fn = native_batch_iterator if cfg.native_loader else batch_iterator
+        it = it_fn(
             data.train_images,
             data.train_labels,
             cfg.batch_size,

@@ -67,3 +67,128 @@ def test_native_cifar_bin_matches_numpy(tmp_path):
     imgs_py = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
     np.testing.assert_array_equal(imgs_c, imgs_py)
     np.testing.assert_array_equal(labels_c, rec[:, 0].astype(np.int32))
+
+
+class TestBatchPool:
+    """Native threaded batch gather (native/batch_pool.cpp)."""
+
+    def _data(self, n=500, shape=(5, 5, 1), seed=0):
+        rng = np.random.RandomState(seed)
+        return (
+            rng.rand(n, *shape).astype(np.float32),
+            rng.randint(0, 10, n).astype(np.int32),
+        )
+
+    def test_pool_exact_and_ordered(self):
+        from distributed_mnist_bnns_tpu import native
+
+        images, labels = self._data()
+        idx = np.random.RandomState(1).permutation(500).astype(np.int64)
+        pool = native.BatchPool.create(
+            images, labels, idx, batch=64, n_threads=3, n_slots=2
+        )
+        if pool is None:
+            pytest.skip("native library unavailable")
+        with pool:
+            batches = list(pool)
+        assert len(batches) == 500 // 64
+        for b, (im, lb) in enumerate(batches):
+            sel = idx[b * 64 : (b + 1) * 64]
+            np.testing.assert_array_equal(im, images[sel])
+            np.testing.assert_array_equal(lb, labels[sel])
+
+    def test_pool_early_close_joins_workers(self):
+        from distributed_mnist_bnns_tpu import native
+
+        images, labels = self._data()
+        idx = np.arange(500, dtype=np.int64)
+        pool = native.BatchPool.create(
+            images, labels, idx, batch=32, n_threads=2, n_slots=2
+        )
+        if pool is None:
+            pytest.skip("native library unavailable")
+        it = iter(pool)
+        next(it)  # consume one batch, then abandon mid-stream
+        pool.close()  # must not hang or crash
+
+    def test_pool_rejects_bad_indices(self):
+        from distributed_mnist_bnns_tpu import native
+
+        images, labels = self._data(n=10)
+        if not native.available():
+            pytest.skip("native library unavailable")
+        with pytest.raises(IndexError):
+            native.BatchPool.create(
+                images, labels, np.array([0, 99], dtype=np.int64), batch=2
+            )
+
+    def test_native_iterator_matches_python(self):
+        from distributed_mnist_bnns_tpu.data import (
+            batch_iterator,
+            native_batch_iterator,
+        )
+
+        images, labels = self._data(n=300)
+        kw = dict(epoch=2, seed=5, host_id=1, num_hosts=2)
+        py = list(batch_iterator(images, labels, 32, **kw))
+        nat = list(native_batch_iterator(images, labels, 32, **kw))
+        assert len(py) == len(nat)
+        for (pi, pl), (ni, nl) in zip(py, nat):
+            np.testing.assert_array_equal(pi, ni)
+            np.testing.assert_array_equal(pl, nl)
+
+    def test_native_iterator_falls_back(self, monkeypatch):
+        from distributed_mnist_bnns_tpu import native
+        from distributed_mnist_bnns_tpu.data import (
+            batch_iterator,
+            native_batch_iterator,
+        )
+
+        monkeypatch.setattr(
+            native.BatchPool, "create", classmethod(lambda *a, **k: None)
+        )
+        images, labels = self._data(n=100)
+        py = list(batch_iterator(images, labels, 16, epoch=0, seed=3))
+        nat = list(native_batch_iterator(images, labels, 16, epoch=0, seed=3))
+        for (pi, pl), (ni, nl) in zip(py, nat):
+            np.testing.assert_array_equal(pi, ni)
+            np.testing.assert_array_equal(pl, nl)
+
+    def test_trainer_native_loader_matches(self):
+        """native_loader=True must reproduce the python loader's exact
+        training trajectory (same shard_indices -> same batches)."""
+        import jax
+
+        from distributed_mnist_bnns_tpu.data.common import ImageClassData
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        rng = np.random.RandomState(0)
+        data = ImageClassData(
+            train_images=rng.rand(128, 28, 28, 1).astype(np.float32),
+            train_labels=rng.randint(0, 10, 128).astype(np.int32),
+            test_images=rng.rand(32, 28, 28, 1).astype(np.float32),
+            test_labels=rng.randint(0, 10, 32).astype(np.int32),
+        )
+
+        def make(native_loader):
+            return Trainer(
+                TrainConfig(
+                    model="bnn-mlp-small",
+                    model_kwargs={"infl_ratio": 1},
+                    batch_size=16,
+                    epochs=1,
+                    seed=4,
+                    backend="xla",
+                    native_loader=native_loader,
+                )
+            )
+
+        t_py, t_nat = make(False), make(True)
+        t_py.train_epoch(data, 0)
+        t_nat.train_epoch(data, 0)
+        assert int(t_py.state.step) == int(t_nat.state.step) == 8
+        for a, b in zip(
+            jax.tree.leaves(t_py.state.params),
+            jax.tree.leaves(t_nat.state.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
