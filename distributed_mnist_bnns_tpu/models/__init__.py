@@ -4,6 +4,12 @@ from .convnet import ConvNet
 from .cnn import DeepCNN
 from .bnn_cnn import BinarizedCNN
 from .resnet import XnorResNet, xnor_resnet18, xnor_resnet50
+from .transformer import (
+    BinarizedSelfAttention,
+    BinarizedTransformer,
+    bnn_vit_small,
+    bnn_vit_tiny,
+)
 from .registry import get_model, MODEL_REGISTRY, latent_clamp_mask
 
 __all__ = [
@@ -19,6 +25,10 @@ __all__ = [
     "XnorResNet",
     "xnor_resnet18",
     "xnor_resnet50",
+    "BinarizedSelfAttention",
+    "BinarizedTransformer",
+    "bnn_vit_tiny",
+    "bnn_vit_small",
     "get_model",
     "MODEL_REGISTRY",
     "latent_clamp_mask",

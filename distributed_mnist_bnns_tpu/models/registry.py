@@ -23,6 +23,7 @@ from .cnn import DeepCNN
 from .convnet import ConvNet
 from .mlp import bnn_mlp_large, bnn_mlp_small, fp32_mlp_large
 from .resnet import xnor_resnet18, xnor_resnet50
+from .transformer import bnn_vit_small, bnn_vit_tiny
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     # flagship BNN MLPs (mnist-dist2.py:46-76 / mnist-dist3.py:40-70)
@@ -38,6 +39,10 @@ MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     # stretch configs (BASELINE.json): binarized ResNets
     "xnor-resnet18": xnor_resnet18,
     "xnor-resnet50": xnor_resnet50,
+    # binarized transformers (no reference counterpart: the attention
+    # stack — flash/ring attention — as a trainable model family)
+    "bnn-vit-tiny": bnn_vit_tiny,
+    "bnn-vit-small": bnn_vit_small,
 }
 
 

@@ -1,0 +1,202 @@
+"""Binarized vision transformer — the attention-model family.
+
+No reference counterpart (the reference stops at MLPs/CNNs — SURVEY §2.2);
+this family exists so the framework's attention stack (ops/flash_attention,
+parallel/ring_attention) is exercised by an actual trainable model rather
+than op-level tests only, following the BNN-transformer recipe
+(BinaryViT/BiT-style): **weights of every projection are binarized with
+fp32 latent masters, activations entering binarized GEMMs are sign()-
+binarized, while the attention core (softmax over scores) and the
+normalization/residual stream stay full precision** — binarizing the
+softmax input distribution collapses it, so no published binary
+transformer does.
+
+Reference-semantics carried over from the MLP family:
+  * patch embedding consumes raw pixels -> ``binarize_input=False``
+    (the reference's fp32 first layer, models/binarized_modules.py:75);
+  * the classifier head is a plain fp32 Dense (the reference's fp32 last
+    layer, mnist-dist2.py:70);
+  * all Binarized* latents are clamped to [-1, 1] by the trainer's
+    projection (latent_clamp_mask matches them by module-path prefix);
+    pos-embed / LayerNorm / head params are ordinary fp32 and unclamped.
+
+TPU-first: attention="flash" runs the Pallas flash kernel (L and D should
+be tile-aligned; MNIST 16 tokens / CIFAR 64 tokens at head_dim 32/64 are);
+attention="xla" is the exact einsum oracle (default — XLA fuses it well at
+these tiny sequence lengths and it runs everywhere, incl. CPU tests).
+Sequence parallelism for long sequences uses the same flash local step via
+parallel/ring_attention at the op level.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.binarize import STEMode
+from ..ops.flash_attention import flash_attention
+from ..ops.xnor_gemm import Backend
+from .layers import BinarizedDense
+
+
+def _attend_xla(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Exact (B, T, H, D) softmax attention — the oracle path."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class BinarizedSelfAttention(nn.Module):
+    """Multi-head self-attention with binarized q/k/v/out projections."""
+
+    embed_dim: int
+    num_heads: int
+    attention: str = "xla"  # "xla" | "flash" | "flash_interpret"
+    ste: STEMode = "identity"
+    stochastic: bool = False
+    backend: Optional[Backend] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, _ = x.shape
+        if self.embed_dim % self.num_heads:
+            raise ValueError(
+                f"embed_dim {self.embed_dim} not divisible by "
+                f"num_heads {self.num_heads}"
+            )
+        head_dim = self.embed_dim // self.num_heads
+
+        # NOTE: binarized submodules keep their auto-generated
+        # BinarizedDense_N names — latent_clamp_mask selects latents by
+        # the "Binarized" module-path prefix (models/registry.py).
+        def dense():
+            return BinarizedDense(
+                self.embed_dim,
+                binarize_input=True,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                backend=self.backend,
+            )
+
+        q = dense()(x).reshape(b, t, self.num_heads, head_dim)
+        k = dense()(x).reshape(b, t, self.num_heads, head_dim)
+        v = dense()(x).reshape(b, t, self.num_heads, head_dim)
+        if self.attention == "xla":
+            out = _attend_xla(q, k, v)
+        elif self.attention in ("flash", "flash_interpret"):
+            out = flash_attention(
+                q, k, v, causal=False,
+                interpret=self.attention == "flash_interpret",
+            )
+        else:
+            raise ValueError(
+                f"unknown attention {self.attention!r} "
+                "(have: xla, flash, flash_interpret)"
+            )
+        return dense()(out.reshape(b, t, self.embed_dim))
+
+
+class BinarizedTransformer(nn.Module):
+    """Patch-embedding binarized transformer classifier.
+
+    Pre-norm blocks: x += attn(LN(x)); x += mlp(LN(x)) with the MLP as
+    BinarizedDense -> Hardtanh -> BinarizedDense (the framework's BNN
+    activation idiom, mnist-dist2.py:51-74's Hardtanh role). Mean-pooled
+    tokens feed the fp32 head.
+    """
+
+    num_classes: int = 10
+    patch_size: int = 7
+    embed_dim: int = 128
+    depth: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 2
+    dropout: float = 0.0
+    attention: str = "xla"
+    ste: STEMode = "identity"
+    stochastic: bool = False
+    backend: Optional[Backend] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        p = self.patch_size
+        if h % p or w % p:
+            raise ValueError(
+                f"input {h}x{w} not divisible by patch_size {p}"
+            )
+        nh, nw = h // p, w // p
+        # (B, H, W, C) -> (B, T, p*p*C) without any host-side reshaping.
+        x = x.reshape(b, nh, p, nw, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, p * p * c)
+        # Patch embedding on raw pixels: binarized weights, fp32 input
+        # (first-layer passthrough semantics).
+        x = BinarizedDense(  # patch embedding (auto-named: clamp mask)
+            self.embed_dim,
+            binarize_input=False,
+            ste=self.ste,
+            backend=self.backend,
+        )(x)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, nh * nw, self.embed_dim),
+        )
+        x = x + pos
+        for i in range(self.depth):
+            y = nn.LayerNorm(name=f"ln_attn_{i}")(x)
+            y = BinarizedSelfAttention(
+                self.embed_dim,
+                self.num_heads,
+                attention=self.attention,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                backend=self.backend,
+            )(y)
+            if self.dropout:
+                y = nn.Dropout(self.dropout, deterministic=not train)(y)
+            x = x + y
+            y = nn.LayerNorm(name=f"ln_mlp_{i}")(x)
+            y = BinarizedDense(
+                self.embed_dim * self.mlp_ratio,
+                binarize_input=True,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                backend=self.backend,
+            )(y)
+            y = nn.hard_tanh(y)
+            y = BinarizedDense(
+                self.embed_dim,
+                binarize_input=True,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                backend=self.backend,
+            )(y)
+            if self.dropout:
+                y = nn.Dropout(self.dropout, deterministic=not train)(y)
+            x = x + y
+        x = nn.LayerNorm(name="ln_head")(x).mean(axis=1)
+        x = nn.Dense(self.num_classes, name="head")(x)
+        return nn.log_softmax(x)
+
+
+def bnn_vit_tiny(**kw) -> BinarizedTransformer:
+    """MNIST-sized: 7x7 patches -> 16 tokens, 128-dim, 2 blocks."""
+    kw.setdefault("patch_size", 7)
+    kw.setdefault("embed_dim", 128)
+    kw.setdefault("depth", 2)
+    kw.setdefault("num_heads", 4)
+    return BinarizedTransformer(**kw)
+
+
+def bnn_vit_small(**kw) -> BinarizedTransformer:
+    """CIFAR-sized: 4x4 patches -> 64 tokens, 256-dim, 4 blocks."""
+    kw.setdefault("patch_size", 4)
+    kw.setdefault("embed_dim", 256)
+    kw.setdefault("depth", 4)
+    kw.setdefault("num_heads", 8)
+    return BinarizedTransformer(**kw)

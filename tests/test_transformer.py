@@ -1,0 +1,148 @@
+"""Binarized transformer family (models/transformer.py).
+
+No reference counterpart — this family exists so the attention stack is
+exercised by a trainable model. Tests: shapes, clamp-mask coverage,
+flash-vs-xla attention path equivalence on identical params, STE gradient
+flow, and end-to-end convergence through the Trainer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.models import (
+    BinarizedTransformer,
+    bnn_vit_tiny,
+    get_model,
+    latent_clamp_mask,
+)
+
+
+def _init(model, shape=(2, 28, 28, 1), train=False):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)},
+        x,
+        train=train,
+    )
+    return variables, x
+
+
+def test_forward_shape_and_logprobs():
+    model = bnn_vit_tiny(backend="xla")
+    variables, x = _init(model)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    # log_softmax output: rows exponentiate-sum to 1
+    np.testing.assert_allclose(
+        np.exp(np.asarray(out, np.float64)).sum(-1), 1.0, rtol=1e-5
+    )
+
+
+def test_registry_and_cifar_shape():
+    model = get_model("bnn-vit-small", backend="xla")
+    variables, x = _init(model, shape=(2, 32, 32, 3))
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+
+
+def test_rejects_indivisible_patches():
+    model = BinarizedTransformer(patch_size=5)
+    with pytest.raises(ValueError, match="not divisible"):
+        _init(model)
+
+
+def test_clamp_mask_covers_binarized_only():
+    model = bnn_vit_tiny(backend="xla")
+    variables, _ = _init(model)
+    mask = latent_clamp_mask(variables["params"])
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+    covered = {
+        "/".join(getattr(p, "key", "?") for p in path): val
+        for path, val in flat
+    }
+    # every binarized projection is clamped...
+    binarized = [k for k, v in covered.items() if v]
+    assert any("BinarizedSelfAttention" in k for k in binarized)
+    assert any(k.startswith("BinarizedDense") for k in binarized)
+    # ...and the fp32 stream (pos embed, LayerNorms, head) is not
+    for k, v in covered.items():
+        if "pos_embed" in k or "ln_" in k or k.startswith("head"):
+            assert not v, k
+
+
+def test_flash_attention_path_matches_xla():
+    """Same params, attention='flash_interpret' vs 'xla': identical model
+    function (the flash kernel is an exact attention, not an approx)."""
+    xla = BinarizedTransformer(
+        depth=1, embed_dim=64, num_heads=2, attention="xla", backend="xla"
+    )
+    flash = BinarizedTransformer(
+        depth=1, embed_dim=64, num_heads=2, attention="flash_interpret",
+        backend="xla",
+    )
+    variables, x = _init(xla)
+    np.testing.assert_allclose(
+        np.asarray(xla.apply(variables, x, train=False)),
+        np.asarray(flash.apply(variables, x, train=False)),
+        atol=5e-5, rtol=5e-5,
+    )
+
+
+def test_gradients_flow_to_all_latents():
+    model = bnn_vit_tiny(backend="xla")
+    variables, x = _init(model)
+    labels = jnp.array([3, 7])
+
+    def loss_fn(params):
+        out = model.apply({"params": params}, x, train=False)
+        return -out[jnp.arange(2), labels].mean()
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    mask = latent_clamp_mask(variables["params"])
+    for (path, g), (_, m) in zip(
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+        jax.tree_util.tree_flatten_with_path(mask)[0],
+    ):
+        if m and "kernel" in str(path[-1]):
+            assert float(jnp.abs(g).max()) > 0.0, path
+
+
+def test_trains_through_trainer():
+    from distributed_mnist_bnns_tpu.data.common import ImageClassData
+    from distributed_mnist_bnns_tpu.data.common import synthetic_blobs
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    tr_x, tr_y, te_x, te_y = synthetic_blobs((28, 28, 1), 256, 64, seed=0)
+    data = ImageClassData(
+        train_images=tr_x.astype(np.float32) / 255.0,
+        train_labels=tr_y,
+        test_images=te_x.astype(np.float32) / 255.0,
+        test_labels=te_y,
+    )
+    trainer = Trainer(
+        TrainConfig(
+            model="bnn-vit-tiny",
+            model_kwargs={"embed_dim": 64, "depth": 1, "num_heads": 2},
+            epochs=4,
+            batch_size=32,
+            learning_rate=0.01,
+            backend="xla",
+            seed=0,
+            scan_steps=4,
+        )
+    )
+    history = trainer.fit(data)
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    # BNN transformers converge slowly from scratch; the functional bar is
+    # "learns well above the 10% chance floor in 4 epochs" (measured:
+    # ~30% and climbing; accuracy-parity runs live in RESULTS.md land).
+    assert history[-1]["test_acc"] >= 20.0
+    # latent clamp actually applied: all binarized latents within [-1, 1]
+    mask = latent_clamp_mask(trainer.state.params)
+    for g, m in zip(
+        jax.tree.leaves(trainer.state.params), jax.tree.leaves(mask)
+    ):
+        if m:
+            assert float(jnp.abs(g).max()) <= 1.0 + 1e-6
