@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fuse N train steps into one lax.scan dispatch "
                              "(device-resident inner loop; single-device "
                              "or --dp-mode gspmd)")
+        sp.add_argument("--grad-accum", type=int, default=1,
+                        help="microbatches per optimizer step (activation-"
+                             "memory saver; batch-size must divide evenly)")
         sp.add_argument("--remat", action="store_true",
                         help="rematerialize activations in backward "
                              "(jax.checkpoint) to cut HBM use")
@@ -134,6 +137,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         dp_mode=args.dp_mode,
         profile_dir=args.profile_dir,
         remat=args.remat,
+        grad_accum=args.grad_accum,
         scan_steps=args.scan_steps,
     )
     return Trainer(config, input_shape=input_shape)

@@ -91,6 +91,7 @@ def make_dp_train_step(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     remat: bool = False,
+    grad_accum: int = 1,
 ) -> Callable:
     """GSPMD data-parallel train step (grad all-reduce inserted by XLA).
 
@@ -98,7 +99,9 @@ def make_dp_train_step(
     make_step_body); the DP semantics live entirely in the shardings below
     — XLA turns the batch-sharded loss/grad reductions into ICI
     all-reduces, the role of DDP's backward hooks."""
-    train_step = make_step_body(clamp_mask, loss_fn=loss_fn, remat=remat)
+    train_step = make_step_body(
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+    )
     repl = NamedSharding(mesh, P())
     data_sh = NamedSharding(mesh, P("data"))
     return jax.jit(

@@ -77,6 +77,7 @@ def make_step_body(
     *,
     loss_fn: Callable = cross_entropy_loss,
     remat: bool = False,
+    grad_accum: int = 1,
 ) -> Callable:
     """The un-jitted train-step body: fwd -> loss -> bwd -> optax -> clamp.
 
@@ -89,7 +90,57 @@ def make_step_body(
     activations and recomputing them in backward — the HBM-for-FLOPs trade
     that lets batch sizes (or models) that would not otherwise fit run on a
     chip. No reference counterpart (SURVEY §5: no memory management at all);
-    this is a TPU-first addition."""
+    this is a TPU-first addition.
+
+    ``grad_accum=N`` splits the batch into N microbatches scanned
+    sequentially inside the step, averaging the gradients before ONE
+    optimizer update — peak activation memory drops ~N-fold while the
+    update matches the full-batch step exactly for per-sample losses and
+    stateless-normalization models (LayerNorm; BatchNorm models normalize
+    per microbatch and update running stats N times per step, same as a
+    torch grad-accumulation loop). Composes with remat (each microbatch's
+    forward is rematerialized) and with both scan and DP dispatch, since
+    all of them wrap this body."""
+
+    def grads_and_metrics(state, params, images, labels, rngs):
+        def compute_loss(params, batch_stats, images, labels):
+            outs, mutated = state.apply_fn(
+                {"params": params, "batch_stats": batch_stats},
+                images,
+                train=True,
+                rngs=rngs,
+                mutable=["batch_stats"],
+            )
+            return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
+
+        if remat:
+            compute_loss = jax.checkpoint(compute_loss)
+
+        if grad_accum <= 1:
+            (loss, (outs, new_bs)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(params, state.batch_stats, images, labels)
+            acc = (jnp.argmax(outs, -1) == labels).mean() * 100.0
+            return grads, new_bs, loss, acc
+
+        micro = images.shape[0] // grad_accum
+        m_images = images.reshape(grad_accum, micro, *images.shape[1:])
+        m_labels = labels.reshape(grad_accum, micro)
+
+        def micro_step(carry, xs):
+            bs = carry
+            im, lb = xs
+            (loss, (outs, new_bs)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(params, bs, im, lb)
+            acc = (jnp.argmax(outs, -1) == lb).mean() * 100.0
+            return (new_bs if new_bs else bs), (grads, loss, acc)
+
+        new_bs, (g_stack, losses, accs) = jax.lax.scan(
+            micro_step, state.batch_stats, (m_images, m_labels)
+        )
+        grads = jax.tree.map(lambda g: g.mean(0), g_stack)
+        return grads, new_bs, losses.mean(), accs.mean()
 
     def train_step(
         state: TrainState,
@@ -99,23 +150,11 @@ def make_step_body(
     ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         step_rng = jax.random.fold_in(rng, state.step)
         dropout_rng, binarize_rng = jax.random.split(step_rng)
+        rngs = {"dropout": dropout_rng, "binarize": binarize_rng}
 
-        def compute_loss(params):
-            outs, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images,
-                train=True,
-                rngs={"dropout": dropout_rng, "binarize": binarize_rng},
-                mutable=["batch_stats"],
-            )
-            return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
-
-        if remat:
-            compute_loss = jax.checkpoint(compute_loss)
-
-        (loss, (outs, new_bs)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
+        grads, new_bs, loss, acc = grads_and_metrics(
+            state, state.params, images, labels, rngs
+        )
         updates, new_opt_state = state.tx.update(
             grads, state.opt_state, state.params
         )
@@ -127,7 +166,6 @@ def make_step_body(
             batch_stats=new_bs if new_bs else state.batch_stats,
             opt_state=new_opt_state,
         )
-        acc = (jnp.argmax(outs, -1) == labels).mean() * 100.0
         return new_state, {"loss": loss, "accuracy": acc}
 
     return train_step
@@ -139,9 +177,12 @@ def make_train_step(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     remat: bool = False,
+    grad_accum: int = 1,
 ) -> Callable:
     """Jitted single-batch train step (see ``make_step_body``)."""
-    body = make_step_body(clamp_mask, loss_fn=loss_fn, remat=remat)
+    body = make_step_body(
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+    )
     return jax.jit(body, donate_argnums=(0,) if donate else ())
 
 
@@ -151,6 +192,7 @@ def make_train_scan(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     remat: bool = False,
+    grad_accum: int = 1,
     mesh=None,
 ) -> Callable:
     """Multi-step train dispatch: ``lax.scan`` the step body over a stacked
@@ -171,7 +213,9 @@ def make_train_scan(
     With ``mesh``, inputs are expected sharded P(None, 'data') (batch axis
     sharded per step, steps replicated) and the state replicated — the
     GSPMD DP layout of parallel/data_parallel.py."""
-    body = make_step_body(clamp_mask, loss_fn=loss_fn, remat=remat)
+    body = make_step_body(
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+    )
 
     def train_scan(state, images, labels, rng):
         def scan_body(st, xs):
@@ -278,6 +322,9 @@ class TrainConfig:
     dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
                                    # (ZeRO-style sharded params/opt state)
     remat: bool = False            # jax.checkpoint the forward (HBM saver)
+    grad_accum: int = 1            # >1: N sequential microbatches per
+                                   # optimizer step (~N-fold activation-
+                                   # memory saving; see make_step_body)
     scan_steps: int = 1            # >1: lax.scan S steps per dispatch
                                    # (device-resident inner loop; see
                                    # make_train_scan)
@@ -367,8 +414,14 @@ class Trainer:
 
         loss_fn = make_loss(config.loss)
         self._loss_fn = loss_fn
+        if config.grad_accum > 1 and config.batch_size % config.grad_accum:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"grad_accum={config.grad_accum}"
+            )
         self.train_step = make_train_step(
-            self.clamp_mask, loss_fn=loss_fn, remat=config.remat
+            self.clamp_mask, loss_fn=loss_fn, remat=config.remat,
+            grad_accum=config.grad_accum,
         )
         self.eval_step = make_eval_step(loss_fn=loss_fn)
         self.mesh = None
@@ -446,7 +499,7 @@ class Trainer:
 
         dp_step = make_dp_train_step(
             self.clamp_mask, self.mesh, loss_fn=loss_fn,
-            remat=self.config.remat,
+            remat=self.config.remat, grad_accum=self.config.grad_accum,
         )
         mesh = self.mesh
         rng_global = _make_rng_replicator(mesh)
@@ -468,7 +521,7 @@ class Trainer:
 
         base = make_train_step(
             self.clamp_mask, loss_fn=loss_fn, donate=False,
-            remat=self.config.remat,
+            remat=self.config.remat, grad_accum=self.config.grad_accum,
         )
         fsdp_step = make_fsdp_train_step(base, self.mesh, self.state)
         self.state = shard_state_fsdp(self.state, self.mesh)
@@ -547,7 +600,8 @@ class Trainer:
             return self._train_scan
         scan = make_train_scan(
             self.clamp_mask, loss_fn=self._loss_fn,
-            remat=self.config.remat, mesh=self.mesh,
+            remat=self.config.remat, grad_accum=self.config.grad_accum,
+            mesh=self.mesh,
         )
         if self.mesh is not None:
             from ..parallel import shard_batch
@@ -604,6 +658,7 @@ class Trainer:
                 self.train_step = make_train_step(
                     self.clamp_mask, loss_fn=self._loss_fn,
                     remat=self.config.remat,
+                    grad_accum=self.config.grad_accum,
                 )
         # In-place retune of the regime's non-lr HPs (momentum/b1/b2/eps/
         # weight_decay) — the reference's "any param-group key" semantics
