@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--batch-size", type=int, default=64)
         sp.add_argument("--optimizer", default="adam")
         sp.add_argument("--lr", type=float, default=0.01)
+        sp.add_argument("--lr-schedule", default="step",
+                        choices=["step", "cosine"],
+                        help="step = reference x0.1-every-40-epochs decay; "
+                             "cosine anneals to 0 over --epochs")
+        sp.add_argument("--warmup-epochs", type=int, default=0)
         sp.add_argument("--seed", type=int, default=42)
         sp.add_argument("--log-interval", type=int, default=100)
         from .ops.xnor_gemm import BACKENDS
@@ -121,6 +126,8 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         batch_size=args.batch_size,
         optimizer=args.optimizer,
         learning_rate=args.lr,
+        lr_schedule=args.lr_schedule,
+        warmup_epochs=args.warmup_epochs,
         seed=args.seed,
         log_interval=args.log_interval,
         loss=args.loss,

@@ -305,6 +305,8 @@ class TrainConfig:
     learning_rate: float = 0.01
     lr_decay_epochs: int = 40      # x0.1 every N epochs (mnist-dist2.py:126-127)
     lr_decay_factor: float = 0.1
+    lr_schedule: str = "step"      # "step" (reference decay) | "cosine"
+    warmup_epochs: int = 0         # linear warmup before either schedule
     regime: Optional[Dict[int, Dict[str, Any]]] = None
     seed: int = 42
     log_interval: int = 100
@@ -625,11 +627,27 @@ class Trainer:
     # -- epoch-level hyperparameter control ---------------------------------
 
     def _lr_for_epoch(self, epoch: int) -> float:
+        """Epoch learning rate: regime base -> optional linear warmup ->
+        "step" decay (the reference's x0.1-every-N, applied per *epoch*
+        rather than its per-batch bug, mnist-dist2.py:126-127) or cosine
+        annealing to 0 over the configured epochs."""
+        cfg = self.config
         base = self.regime.config_at(epoch).get(
-            "learning_rate", self.config.learning_rate
+            "learning_rate", cfg.learning_rate
         )
-        decays = epoch // max(self.config.lr_decay_epochs, 1)
-        return base * (self.config.lr_decay_factor**decays)
+        if epoch < cfg.warmup_epochs:
+            return base * (epoch + 1) / (cfg.warmup_epochs + 1)
+        if cfg.lr_schedule == "cosine":
+            span = max(cfg.epochs - cfg.warmup_epochs, 1)
+            t = min((epoch - cfg.warmup_epochs) / span, 1.0)
+            return base * 0.5 * (1.0 + float(np.cos(np.pi * t)))
+        if cfg.lr_schedule != "step":
+            raise ValueError(
+                f"unknown lr_schedule {cfg.lr_schedule!r} "
+                "(have: step, cosine)"
+            )
+        decays = epoch // max(cfg.lr_decay_epochs, 1)
+        return base * (cfg.lr_decay_factor**decays)
 
     def _apply_epoch_regime(self, epoch: int) -> None:
         cfg = self.regime.config_at(epoch)

@@ -314,3 +314,59 @@ def test_make_optimizer_all_registry_entries_construct():
         state = tx.init(params)
         updates, _ = tx.update({"w": jnp.ones(3)}, state, params)
         assert jnp.all(jnp.isfinite(updates["w"])), name
+
+
+class TestLrSchedules:
+    """--lr-schedule / --warmup-epochs (trainer _lr_for_epoch)."""
+
+    def _trainer(self, **kw):
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        return Trainer(
+            TrainConfig(
+                model="bnn-mlp-small",
+                model_kwargs={"infl_ratio": 1},
+                batch_size=16,
+                learning_rate=0.1,
+                backend="xla",
+                **kw,
+            )
+        )
+
+    def test_step_schedule_matches_reference_decay(self):
+        t = self._trainer(epochs=90, lr_decay_epochs=40)
+        assert t._lr_for_epoch(0) == pytest.approx(0.1)
+        assert t._lr_for_epoch(39) == pytest.approx(0.1)
+        assert t._lr_for_epoch(40) == pytest.approx(0.01)
+        assert t._lr_for_epoch(80) == pytest.approx(0.001)
+
+    def test_cosine_anneals_to_zero(self):
+        t = self._trainer(epochs=10, lr_schedule="cosine")
+        lrs = [t._lr_for_epoch(e) for e in range(10)]
+        assert lrs[0] == pytest.approx(0.1)
+        assert all(a >= b for a, b in zip(lrs, lrs[1:]))  # monotone down
+        assert lrs[-1] < 0.01
+
+    def test_warmup_ramps_then_schedules(self):
+        t = self._trainer(epochs=10, lr_schedule="cosine", warmup_epochs=3)
+        lrs = [t._lr_for_epoch(e) for e in range(10)]
+        assert lrs[0] == pytest.approx(0.1 * 1 / 4)
+        assert lrs[1] == pytest.approx(0.1 * 2 / 4)
+        assert lrs[2] == pytest.approx(0.1 * 3 / 4)
+        assert lrs[3] == pytest.approx(0.1)  # cosine start
+        assert lrs[-1] < lrs[3]
+
+    def test_unknown_schedule_raises(self):
+        t = self._trainer(epochs=2, lr_schedule="poly")
+        with pytest.raises(ValueError, match="unknown lr_schedule"):
+            t._lr_for_epoch(0)
+
+    def test_cosine_lr_reaches_optimizer(self):
+        import jax.numpy as jnp
+
+        t = self._trainer(epochs=4, lr_schedule="cosine")
+        t._apply_epoch_regime(2)
+        hp = t.state.opt_state.hyperparams
+        assert float(hp["learning_rate"]) == pytest.approx(
+            t._lr_for_epoch(2), rel=1e-6
+        )
