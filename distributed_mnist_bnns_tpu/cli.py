@@ -108,6 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
     e = sub.add_parser("eval", help="evaluate latest/best checkpoint")
     common(e)
     e.add_argument("--best", action="store_true")
+    x = sub.add_parser(
+        "export",
+        help="freeze a trained BNN MLP checkpoint into the packed 1-bit "
+             "serving artifact (infer.load_packed)",
+    )
+    common(x)
+    x.add_argument("--best", action="store_true")
+    x.add_argument("--out", default="model_packed.msgpack")
     return p
 
 
@@ -232,6 +240,28 @@ def main(argv=None) -> int:
         metrics = trainer.evaluate(data)
         log.info("eval: %s", metrics)
         print(metrics)
+        return 0
+
+    if args.cmd == "export":
+        if not args.checkpoint_dir:
+            log.error("export requires --checkpoint-dir")
+            return 2
+        from .infer import export_packed
+        from .utils.checkpoint import load_checkpoint
+
+        trainer.state = load_checkpoint(
+            trainer.state, args.checkpoint_dir, best=args.best
+        )
+        info = export_packed(
+            trainer.model,
+            {
+                "params": trainer.state.params,
+                "batch_stats": trainer.state.batch_stats,
+            },
+            args.out,
+        )
+        log.info("exported packed model to %s: %s", args.out, info)
+        print({"out": args.out, **info})
         return 0
     return 2
 

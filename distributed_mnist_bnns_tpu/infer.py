@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .models.mlp import BnnMLP
 from .ops.binarize import binarize_ste
@@ -64,17 +65,11 @@ def _bn_affine_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
     return lambda y: a * y + c
 
 
-def freeze_bnn_mlp(
-    model: BnnMLP, variables: Dict, *, interpret: bool = False
-) -> Tuple[Callable, Dict[str, Any]]:
-    """Freeze a trained binarized BnnMLP into a packed inference function.
-
-    Returns (apply_fn, info): ``apply_fn(images) -> log-probs`` computes
-    exactly what ``model.apply(variables, images, train=False)`` computes
-    (up to measure-zero threshold ties), with hidden weights stored as
-    packed bitplanes and BN/hardtanh/binarize folded into thresholds.
-    ``info`` reports the packed weight footprint vs the fp32 masters.
-    """
+def _freeze_tensors(model: BnnMLP, variables: Dict) -> Dict[str, Any]:
+    """Extract the serializable frozen artifact from trained variables:
+    ±1 first-layer weights, packed hidden bitplanes, raw BN params/stats
+    (thresholds are rebuilt at load — they are cheap and keeping the raw
+    moments makes the artifact inspectable), fp32 head."""
     if not model.binarized:
         raise ValueError("freeze_bnn_mlp requires a binarized BnnMLP")
     if model.stochastic:
@@ -84,20 +79,56 @@ def freeze_bnn_mlp(
         )
     params = variables["params"]
     stats = variables["batch_stats"]
-
-    # First layer: raw inputs (binarize_input=False), ±1 weights, fp32 dot.
-    w1 = binarize_ste(params["BinarizedDense_0"]["kernel"])
-    b1 = params["BinarizedDense_0"]["bias"]
-    sign1 = _bn_sign_fn(params["BatchNorm_0"], stats["BatchNorm_0"])
-
-    packed = []
-    for i, name in enumerate(("BinarizedDense_1", "BinarizedDense_2")):
+    layers = []
+    for name in ("BinarizedDense_1", "BinarizedDense_2"):
         wp, k, n = prepack_weights(binarize_ste(params[name]["kernel"]))
-        packed.append((wp, k, n, params[name]["bias"]))
-    sign2 = _bn_sign_fn(params["BatchNorm_1"], stats["BatchNorm_1"])
-    affine3 = _bn_affine_fn(params["BatchNorm_2"], stats["BatchNorm_2"])
-    wh = params["Dense_0"]["kernel"]
-    bh = params["Dense_0"]["bias"]
+        layers.append({
+            "wp": wp, "k": k, "n": n, "bias": params[name]["bias"],
+        })
+    frozen = {
+        "w1": binarize_ste(params["BinarizedDense_0"]["kernel"]),
+        "b1": params["BinarizedDense_0"]["bias"],
+        "bn0": {"params": dict(params["BatchNorm_0"]),
+                "stats": dict(stats["BatchNorm_0"])},
+        "layers": layers,
+        "bn1": {"params": dict(params["BatchNorm_1"]),
+                "stats": dict(stats["BatchNorm_1"])},
+        "bn2": {"params": dict(params["BatchNorm_2"]),
+                "stats": dict(stats["BatchNorm_2"])},
+        "head_w": params["Dense_0"]["kernel"],
+        "head_b": params["Dense_0"]["bias"],
+    }
+    latent_bytes = sum(
+        int(params[n]["kernel"].size) * 4
+        for n in ("BinarizedDense_0", "BinarizedDense_1", "BinarizedDense_2")
+    )
+    packed_bytes = int(frozen["w1"].size) * 4 + sum(
+        int(l["wp"].size) * 4 for l in layers
+    )
+    frozen["info"] = {
+        "latent_fp32_weight_bytes": latent_bytes,
+        "frozen_weight_bytes": packed_bytes,
+        "compression": round(latent_bytes / packed_bytes, 2),
+        "packed_layers": ["BinarizedDense_1", "BinarizedDense_2"],
+    }
+    return frozen
+
+
+def _build_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
+    """Packed inference function from a frozen artifact (in-memory or
+    restored from disk)."""
+    w1 = jnp.asarray(frozen["w1"], jnp.float32)  # disk artifact: int8 ±1
+    b1 = jnp.asarray(frozen["b1"])
+    sign1 = _bn_sign_fn(frozen["bn0"]["params"], frozen["bn0"]["stats"])
+    packed = [
+        (jnp.asarray(l["wp"]), int(l["k"]), int(l["n"]),
+         jnp.asarray(l["bias"]))
+        for l in frozen["layers"]
+    ]
+    sign2 = _bn_sign_fn(frozen["bn1"]["params"], frozen["bn1"]["stats"])
+    affine3 = _bn_affine_fn(frozen["bn2"]["params"], frozen["bn2"]["stats"])
+    wh = jnp.asarray(frozen["head_w"])
+    bh = jnp.asarray(frozen["head_b"])
 
     def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
         x = images.reshape(images.shape[0], -1).astype(jnp.float32)
@@ -114,17 +145,49 @@ def freeze_bnn_mlp(
         logits = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
         return jax.nn.log_softmax(logits)
 
-    latent_bytes = sum(
-        int(params[n]["kernel"].size) * 4
-        for n in ("BinarizedDense_0", "BinarizedDense_1", "BinarizedDense_2")
+    return jax.jit(apply_fn)
+
+
+def freeze_bnn_mlp(
+    model: BnnMLP, variables: Dict, *, interpret: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained binarized BnnMLP into a packed inference function.
+
+    Returns (apply_fn, info): ``apply_fn(images) -> log-probs`` computes
+    exactly what ``model.apply(variables, images, train=False)`` computes
+    (up to measure-zero threshold ties), with hidden weights stored as
+    packed bitplanes and BN/hardtanh/binarize folded into thresholds.
+    ``info`` reports the packed weight footprint vs the fp32 masters.
+    """
+    frozen = _freeze_tensors(model, variables)
+    return _build_apply(frozen, interpret), frozen["info"]
+
+
+def export_packed(model: BnnMLP, variables: Dict, path: str) -> Dict[str, Any]:
+    """Write the frozen packed artifact to ``path`` (msgpack). The file
+    holds the 1-bit hidden weights, ±1 first layer, raw BN moments and the
+    fp32 head — everything ``load_packed`` needs, nothing else (no latent
+    masters, no optimizer state). Returns the size-info dict."""
+    from flax import serialization
+
+    frozen = _freeze_tensors(model, variables)
+    frozen = jax.tree.map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, frozen
     )
-    packed_bytes = int(w1.size) * 4 + sum(
-        int(wp.size) * 4 for wp, _, _, _ in packed
-    )
-    info = {
-        "latent_fp32_weight_bytes": latent_bytes,
-        "frozen_weight_bytes": packed_bytes,
-        "compression": round(latent_bytes / packed_bytes, 2),
-        "packed_layers": ["BinarizedDense_1", "BinarizedDense_2"],
-    }
-    return jax.jit(apply_fn), info
+    # On disk the ±1 first layer goes as int8 (4x smaller artifact); the
+    # runtime still dots it in fp32 (load_packed casts back).
+    frozen["w1"] = frozen["w1"].astype(np.int8)
+    with open(path, "wb") as f:
+        f.write(serialization.msgpack_serialize(frozen))
+    return frozen["info"]
+
+
+def load_packed(
+    path: str, *, interpret: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Restore an ``export_packed`` artifact into a jitted predictor."""
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        frozen = serialization.msgpack_restore(f.read())
+    return _build_apply(frozen, interpret), dict(frozen["info"])
