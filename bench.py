@@ -19,8 +19,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# Re-assert JAX_PLATFORMS over any sitecustomize that flipped the jax
+# config at interpreter start (same dance as cli._honor_platform_env) —
+# must run before anything initializes a backend.
+if os.environ.get("JAX_PLATFORMS"):
+    from distributed_mnist_bnns_tpu.utils.platform import pin_platform
+
+    pin_platform(os.environ["JAX_PLATFORMS"])
 
 
 def _min_marginal(fn, fetch, n_short: int, n_long: int, reps: int) -> float:
@@ -242,6 +251,37 @@ def _gemm_crossover(jax, jnp, deadline: float, reps: int = 3):
     return out
 
 
+def _device_responsive(timeout_s: float) -> bool:
+    """Probe the default jax backend in a CHILD process with a hard
+    timeout. A degraded remote-TPU tunnel hangs dispatches indefinitely
+    and an in-process hung jax call cannot be interrupted — probing in a
+    subprocess is the only way bench.py can guarantee it emits its JSON
+    line (instead of eating the driver's whole time budget) when the
+    endpoint is down."""
+    import subprocess
+
+    # Honor JAX_PLATFORMS in the child the same way bench itself does —
+    # the image's sitecustomize can flip the platform at interpreter
+    # start, overriding the env (see utils/platform.py).
+    code = (
+        "import os;"
+        "from distributed_mnist_bnns_tpu.utils.platform import pin_platform;"
+        "p = os.environ.get('JAX_PLATFORMS');"
+        "_ = pin_platform(p) if p else None;"
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128));"
+        "print(float(jnp.sum(jnp.dot(x, x))))"
+    )
+    try:
+        subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, check=True, capture_output=True,
+        )
+        return True
+    except Exception:
+        return False
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=4096)
@@ -274,8 +314,22 @@ def main() -> None:
                         "(BinarizedConv + im2col bit-GEMM)")
     p.add_argument("--stretch-batch-size", type=int, default=256)
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--probe-timeout", type=float, default=150.0,
+                   help="seconds to wait for the device-responsiveness "
+                        "probe (first compile included) before reporting "
+                        "the endpoint down; 0 skips the probe")
     args = p.parse_args()
     deadline = time.monotonic() + args.budget_s
+
+    if args.probe_timeout > 0 and not _device_responsive(args.probe_timeout):
+        print(json.dumps({
+            "metric": "train_throughput_mnist_bnn_mlp_large",
+            "value": None, "unit": "images/sec", "vs_baseline": None,
+            "note": "device endpoint unresponsive (a 128x128 matmul did "
+                    f"not complete in {args.probe_timeout:.0f}s in a probe "
+                    "subprocess); no measurement possible",
+        }))
+        return
 
     import jax
     import jax.numpy as jnp
