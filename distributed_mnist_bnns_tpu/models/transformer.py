@@ -30,7 +30,7 @@ parallel/ring_attention at the op level.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,11 +51,18 @@ def _attend_xla(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
 
 
 class BinarizedSelfAttention(nn.Module):
-    """Multi-head self-attention with binarized q/k/v/out projections."""
+    """Multi-head self-attention with binarized q/k/v/out projections.
+
+    ``attention_fn`` overrides the core with any (q, k, v) -> out callable
+    over (B, T, H, D) — e.g. ``parallel.make_ring_attention(mesh)`` to run
+    the token axis sequence-parallel over a 'seq' mesh (the projections
+    and residual stream are per-token and need no communication, so the
+    ring handles all of SP's cross-device traffic)."""
 
     embed_dim: int
     num_heads: int
     attention: str = "xla"  # "xla" | "flash" | "flash_interpret"
+    attention_fn: Optional[Callable] = None
     ste: STEMode = "identity"
     stochastic: bool = False
     backend: Optional[Backend] = None
@@ -85,7 +92,9 @@ class BinarizedSelfAttention(nn.Module):
         q = dense()(x).reshape(b, t, self.num_heads, head_dim)
         k = dense()(x).reshape(b, t, self.num_heads, head_dim)
         v = dense()(x).reshape(b, t, self.num_heads, head_dim)
-        if self.attention == "xla":
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v)
+        elif self.attention == "xla":
             out = _attend_xla(q, k, v)
         elif self.attention in ("flash", "flash_interpret"):
             out = flash_attention(
@@ -97,6 +106,12 @@ class BinarizedSelfAttention(nn.Module):
                 f"unknown attention {self.attention!r} "
                 "(have: xla, flash, flash_interpret)"
             )
+        # Observability hook: the continuous attention-core output, before
+        # the out-projection sign()-binarizes it (apply with
+        # mutable/capture "intermediates" to read it — the right
+        # equivalence target when comparing attention implementations,
+        # since downstream sign bits legitimately flip on few-ulp diffs).
+        self.sow("intermediates", "attn_core", out)
         return dense()(out.reshape(b, t, self.embed_dim))
 
 
@@ -117,6 +132,7 @@ class BinarizedTransformer(nn.Module):
     mlp_ratio: int = 2
     dropout: float = 0.0
     attention: str = "xla"
+    attention_fn: Optional[Callable] = None  # e.g. a ring-attention fn
     ste: STEMode = "identity"
     stochastic: bool = False
     backend: Optional[Backend] = None
@@ -153,6 +169,7 @@ class BinarizedTransformer(nn.Module):
                 self.embed_dim,
                 self.num_heads,
                 attention=self.attention,
+                attention_fn=self.attention_fn,
                 ste=self.ste,
                 stochastic=self.stochastic,
                 backend=self.backend,

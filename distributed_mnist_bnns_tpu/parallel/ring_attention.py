@@ -116,6 +116,14 @@ def make_ring_attention(
         )
         return acc / jnp.moveaxis(l, 1, 2)
 
+    return _finalize_ring(local_fn, mesh, axis)
+
+
+def _finalize_ring(local_fn, mesh: Mesh, axis: str):
+    """shard_map + jit the per-device ring body, resharding inputs onto
+    the seq layout first — a no-op for already-sharded arrays, and the
+    reshard that lets callers holding single-device (committed) q/k/v —
+    e.g. a model calling this mid-forward — use the ring directly."""
     seq_sharded = P(None, axis, None, None)
     fn = jax.shard_map(
         local_fn,
@@ -125,7 +133,15 @@ def make_ring_attention(
         check_vma=False,
     )
     sh = NamedSharding(mesh, seq_sharded)
-    return jax.jit(fn, in_shardings=(sh,) * 3, out_shardings=sh)
+    jitted = jax.jit(fn, in_shardings=(sh,) * 3, out_shardings=sh)
+
+    def call(q, k, v):
+        return jitted(
+            jax.device_put(q, sh), jax.device_put(k, sh),
+            jax.device_put(v, sh),
+        )
+
+    return call
 
 
 def _make_ring_flash(
@@ -205,13 +221,4 @@ def _make_ring_flash(
         out = num / jnp.where(den == 0.0, 1.0, den)[..., None]
         return out.astype(q.dtype)
 
-    seq_sharded = P(None, axis, None, None)
-    fn = jax.shard_map(
-        local_fn,
-        mesh=mesh,
-        in_specs=(seq_sharded,) * 3,
-        out_specs=seq_sharded,
-        check_vma=False,
-    )
-    sh = NamedSharding(mesh, seq_sharded)
-    return jax.jit(fn, in_shardings=(sh,) * 3, out_shardings=sh)
+    return _finalize_ring(local_fn, mesh, axis)
