@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fuse N train steps into one lax.scan dispatch "
                              "(device-resident inner loop; single-device "
                              "or --dp-mode gspmd)")
+        sp.add_argument("--device-data", action="store_true",
+                        help="keep the whole dataset on device and run "
+                             "each epoch as ONE dispatch (dataset must "
+                             "fit HBM; single-process, gspmd)")
         sp.add_argument("--grad-accum", type=int, default=1,
                         help="microbatches per optimizer step (activation-"
                              "memory saver; batch-size must divide evenly)")
@@ -154,6 +158,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         remat=args.remat,
         grad_accum=args.grad_accum,
         scan_steps=args.scan_steps,
+        device_data=args.device_data,
     )
     return Trainer(config, input_shape=input_shape)
 

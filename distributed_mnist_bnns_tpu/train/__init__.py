@@ -11,6 +11,7 @@ from .trainer import (
     make_eval_step,
     make_masked_eval_step,
     make_step_body,
+    make_train_epoch_fn,
     make_train_scan,
     make_train_step,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "TrainState",
     "make_train_step",
     "make_train_scan",
+    "make_train_epoch_fn",
     "make_step_body",
     "make_eval_step",
     "make_masked_eval_step",

@@ -241,6 +241,59 @@ def make_train_scan(
     )
 
 
+def make_train_epoch_fn(
+    clamp_mask: Any,
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    remat: bool = False,
+    grad_accum: int = 1,
+    mesh=None,
+) -> Callable:
+    """Whole-epoch device-resident training: ONE dispatch per epoch.
+
+    ``f(state, images_all, labels_all, idx, rng) -> (state, metrics)``
+    scans the step body over ``idx`` rows ((n_batches, B) gather indices
+    into the device-resident dataset) — the logical endpoint of the scan
+    dispatch (``make_train_scan``): zero host round-trips AND zero H2D
+    data traffic inside the epoch. The dataset is uploaded once and
+    gathered on-device per step; only the per-epoch shuffled index matrix
+    (a few hundred KB) crosses the host boundary each epoch.
+
+    Under a DP ``mesh`` the dataset stays *replicated* (MNIST/CIFAR fit
+    HBM many times over) while each step's gathered batch is sharded over
+    'data' via the index layout P(None, 'data') — so the gather is local
+    (no collective); XLA inserts only the usual grad all-reduce.
+    Trainer wiring: TrainConfig.device_data."""
+    body = make_step_body(
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+    )
+
+    def epoch_fn(state, images_all, labels_all, idx, rng):
+        def scan_body(st, batch_idx):
+            st, metrics = body(
+                st, images_all[batch_idx], labels_all[batch_idx], rng
+            )
+            return st, metrics
+
+        state, ms = jax.lax.scan(scan_body, state, idx)
+        return state, jax.tree.map(jnp.mean, ms)
+
+    donate_argnums = (0,) if donate else ()
+    if mesh is None:
+        return jax.jit(epoch_fn, donate_argnums=donate_argnums)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    idx_sh = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(
+        epoch_fn,
+        in_shardings=(repl, repl, repl, idx_sh, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=donate_argnums,
+    )
+
+
 def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
     """Jitted eval step returning summed loss and top-1/top-5 correct counts
     (so results can be exactly aggregated across batches/hosts). The
@@ -330,6 +383,10 @@ class TrainConfig:
     scan_steps: int = 1            # >1: lax.scan S steps per dispatch
                                    # (device-resident inner loop; see
                                    # make_train_scan)
+    device_data: bool = False      # keep the whole dataset on device and
+                                   # run each epoch as ONE dispatch
+                                   # (make_train_epoch_fn); supersedes
+                                   # scan_steps when set
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     profile_steps: int = 5
 
@@ -434,6 +491,8 @@ class Trainer:
         self._profiled = False  # trace the first epoch this trainer runs
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
+        self._epoch_fn = None          # built lazily for device_data
+        self._device_dataset = None    # (id(data), images, labels) cache
         self._checkpointer = (
             AsyncCheckpointer() if config.async_checkpoint else None
         )
@@ -624,6 +683,99 @@ class Trainer:
             self._train_scan = scan
         return self._train_scan
 
+    def _device_data_active(self) -> bool:
+        """device_data is supported single-process, on the single-device
+        and GSPMD-DP paths (the dataset replicates over the mesh; FSDP and
+        multi-host keep their streaming paths)."""
+        if not self.config.device_data:
+            return False
+        if jax.process_count() > 1 or (
+            self.mesh is not None and self.config.dp_mode != "gspmd"
+        ):
+            log.warning(
+                "device_data is only supported single-process with "
+                "dp_mode='gspmd'; falling back to the streaming path"
+            )
+            return False
+        return True
+
+    def _get_epoch_fn(self) -> Callable:
+        if self._epoch_fn is None:
+            self._epoch_fn = make_train_epoch_fn(
+                self.clamp_mask, loss_fn=self._loss_fn,
+                remat=self.config.remat,
+                grad_accum=self.config.grad_accum, mesh=self.mesh,
+            )
+        return self._epoch_fn
+
+    def _get_device_dataset(self, data):
+        """Upload (and cache) the train arrays; replicated over the DP
+        mesh when present — gathers stay device-local."""
+        if (
+            self._device_dataset is not None
+            and self._device_dataset[0] == id(data)
+        ):
+            return self._device_dataset[1], self._device_dataset[2]
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            images = jax.device_put(
+                np.asarray(data.train_images, np.float32), repl
+            )
+            labels = jax.device_put(
+                np.asarray(data.train_labels, np.int32), repl
+            )
+        else:
+            images = jnp.asarray(data.train_images, jnp.float32)
+            labels = jnp.asarray(data.train_labels, jnp.int32)
+        self._device_dataset = (id(data), images, labels)
+        return images, labels
+
+    def _train_epoch_device(self, data, epoch: int) -> Dict[str, float]:
+        """One-dispatch epoch over the device-resident dataset. Per-batch
+        times are the epoch time amortized (the host cannot observe
+        steps of a device-resident loop); metrics are epoch means."""
+        from ..data.mnist import shard_indices
+
+        cfg = self.config
+        images_all, labels_all = self._get_device_dataset(data)
+        idx = shard_indices(
+            len(data.train_labels), epoch=epoch, seed=cfg.seed,
+            host_id=0, num_hosts=1,
+        )
+        n_batches = len(idx) // cfg.batch_size
+        idx = np.asarray(
+            idx[: n_batches * cfg.batch_size], np.int32
+        ).reshape(n_batches, cfg.batch_size)
+        epoch_fn = self._get_epoch_fn()
+        self.batch_meter.reset()
+        epoch_start = time.perf_counter()
+        self.state, metrics = epoch_fn(
+            self.state, images_all, labels_all, jnp.asarray(idx), self.rng
+        )
+        metrics = jax.tree.map(float, metrics)  # host fetch = device sync
+        epoch_time = time.perf_counter() - epoch_start
+        per_batch = epoch_time / max(n_batches, 1)
+        self.batch_meter.update(per_batch, n_batches)
+        if jax.process_index() == 0:
+            log.info(
+                "epoch %d done in ONE dispatch: %d steps, loss %.4f "
+                "acc %.2f%% (%.2f ms/batch amortized)",
+                epoch, n_batches, metrics["loss"], metrics["accuracy"],
+                per_batch * 1e3,
+            )
+        if cfg.timing_csv_prefix and jax.process_index() == 0:
+            self._dump_timing_csvs(
+                epoch, [per_batch] * n_batches, epoch_time
+            )
+        return {
+            "train_loss": metrics["loss"],
+            "train_acc": metrics["accuracy"],
+            "epoch_time_s": epoch_time,
+            "batch_time_s": per_batch,
+        }
+
     # -- epoch-level hyperparameter control ---------------------------------
 
     def _lr_for_epoch(self, epoch: int) -> float:
@@ -653,6 +805,7 @@ class Trainer:
         cfg = self.regime.config_at(epoch)
         if self.regime.optimizer_changed(epoch):
             self._train_scan = None  # tx is a static arg; rebuild the scan
+            self._epoch_fn = None
             # Optimizer class switch: rebuild transform, fresh moments
             # (adjust_optimizer reconstructs the torch class the same way,
             # utils.py:120-126).
@@ -720,6 +873,8 @@ class Trainer:
         profiling happen at chunk granularity."""
         cfg = self.config
         self._apply_epoch_regime(epoch)
+        if self._device_data_active():
+            return self._train_epoch_device(data, epoch)
         S = self._effective_scan_steps()
         scan_step = self._get_train_scan() if S > 1 else None
         losses, accs = AverageMeter(), AverageMeter()

@@ -144,3 +144,60 @@ def test_trainer_scan_fsdp_falls_back():
     assert t._effective_scan_steps() == 1
     t.train_epoch(data, epoch=0)
     assert int(t.state.step) == 6
+
+
+def test_device_data_epoch_matches_streaming():
+    """device_data=True (whole epoch in ONE dispatch over the resident
+    dataset) reproduces the streaming path's final params exactly — same
+    shard_indices order, same step semantics."""
+    data = _tiny_data()
+    t_stream = _trainer(scan_steps=1)
+    t_dev = _trainer(device_data=True)
+    r1 = t_stream.train_epoch(data, epoch=0)
+    r2 = t_dev.train_epoch(data, epoch=0)
+    assert int(t_stream.state.step) == int(t_dev.state.step) == 6
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6),
+        jax.device_get(t_stream.state.params),
+        jax.device_get(t_dev.state.params),
+    )
+    assert abs(r1["train_loss"]) > 0 and np.isfinite(r2["train_loss"])
+
+
+def test_device_data_multi_epoch_and_eval():
+    """Two device-data epochs reuse the cached resident dataset and the
+    trainer still evaluates normally."""
+    data = _tiny_data()
+    t = _trainer(device_data=True)
+    t.config.epochs = 2
+    h = t.fit(data)
+    assert len(h) == 2
+    assert int(t.state.step) == 12
+    assert np.isfinite(h[-1]["test_loss"])
+
+
+def test_device_data_dp_gspmd():
+    """device_data under GSPMD DP: dataset replicated over the mesh,
+    per-step gathered batches sharded; trajectory matches single-device
+    device_data."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    data = _tiny_data()
+    t_dp = _trainer(device_data=True, data_parallel=8)
+    t_ref = _trainer(device_data=True)
+    t_dp.train_epoch(data, epoch=0)
+    t_ref.train_epoch(data, epoch=0)
+    assert int(t_dp.state.step) == int(t_ref.state.step) == 6
+    ev_dp = t_dp.evaluate(data)
+    ev_ref = t_ref.evaluate(data)
+    assert abs(ev_dp["test_acc"] - ev_ref["test_acc"]) <= 13.0
+
+
+def test_device_data_fsdp_falls_back():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    data = _tiny_data()
+    t = _trainer(device_data=True, data_parallel=8, dp_mode="fsdp")
+    assert not t._device_data_active()
+    t.train_epoch(data, epoch=0)
+    assert int(t.state.step) == 6
