@@ -8,13 +8,22 @@ Subpackages
 ops       : binarization/quantization primitives (custom_vjp STE), losses,
             bitplane packing, XNOR-popcount GEMM (Pallas) and MXU paths.
 models    : Flax modules — BinarizedDense/BinarizedConv, the BNN MLP family,
-            fp32 ConvNet / deep CNN, and a fully-binarized CNN.
-parallel  : device meshes, data-parallel and model-parallel train steps
-            (jit/GSPMD and explicit shard_map+psum), multi-host init.
+            fp32 ConvNet / deep CNN, a fully-binarized CNN, XNOR-ResNets,
+            and binarized transformers (pluggable attention core).
+parallel  : device meshes, data/model/tensor/pipeline/expert parallelism,
+            FSDP, ring attention (jit/GSPMD and explicit shard_map+psum),
+            multi-host init.
 train     : functional trainer (STE + latent-weight clamp projection),
-            optimizer registry and epoch "regime" scheduling, eval loops.
-data      : MNIST idx pipeline with deterministic per-host sharding.
-utils     : logging, meters, results CSV/HTML, checkpointing, accuracy.
+            scan/device-resident dispatch, grad accumulation, optimizer
+            registry and epoch "regime" scheduling, eval loops.
+data      : MNIST idx / CIFAR-10 pipelines with deterministic per-host
+            sharding.
+utils     : logging, meters, results CSV/HTML, (async) checkpointing,
+            recovery, profiling, accuracy.
+native    : C++ data runtime (idx/CIFAR decode, bitpack, threaded
+            BatchPool) via ctypes.
+infer     : frozen packed-weight serving (XNOR-net BN-threshold folding,
+            export/load artifacts).
 
 The reference's semantics that this framework preserves (see SURVEY.md):
   * fp32 latent "master" weights binarized on every forward
