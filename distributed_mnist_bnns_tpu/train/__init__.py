@@ -9,6 +9,7 @@ from .trainer import (
     Trainer,
     TrainState,
     make_eval_step,
+    make_eval_epoch_fn,
     make_masked_eval_step,
     make_step_body,
     make_train_epoch_fn,
@@ -30,4 +31,5 @@ __all__ = [
     "make_step_body",
     "make_eval_step",
     "make_masked_eval_step",
+    "make_eval_epoch_fn",
 ]

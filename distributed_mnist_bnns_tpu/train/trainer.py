@@ -310,13 +310,9 @@ def make_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
     return eval_step
 
 
-def make_masked_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
-    """Eval step for mesh-sharded evaluation: a ``valid`` mask excludes the
-    zero-padding of the final batch, so every batch has the same static
-    shape (one compile, shardable over the data axis) while the aggregated
-    sums stay exact. Per-example losses come from vmapping the registry
-    loss over singleton batches — exact for all mean-of-per-sample losses
-    (ce, hinge, sqrt_hinge)."""
+def _masked_eval_body(loss_fn: Callable) -> Callable:
+    """Un-jitted masked eval body (shared by the per-batch jitted step and
+    the device-resident eval scan)."""
 
     def eval_step(
         state: TrainState,
@@ -342,7 +338,56 @@ def make_masked_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
             "count": valid.sum(),
         }
 
-    return jax.jit(eval_step)
+    return eval_step
+
+
+def make_masked_eval_step(loss_fn: Callable = cross_entropy_loss) -> Callable:
+    """Eval step for mesh-sharded evaluation: a ``valid`` mask excludes the
+    zero-padding of the final batch, so every batch has the same static
+    shape (one compile, shardable over the data axis) while the aggregated
+    sums stay exact. Per-example losses come from vmapping the registry
+    loss over singleton batches — exact for all mean-of-per-sample losses
+    (ce, hinge, sqrt_hinge)."""
+    return jax.jit(_masked_eval_body(loss_fn))
+
+
+def make_eval_epoch_fn(
+    loss_fn: Callable = cross_entropy_loss, mesh=None
+) -> Callable:
+    """Whole-test-set evaluation as ONE dispatch over the device-resident
+    test arrays (the eval half of ``make_train_epoch_fn``):
+    ``f(state, images_all, labels_all, idx, valid) -> totals`` scans the
+    masked eval body over (n_chunks, B) gather indices, summing the exact
+    masked aggregates on device."""
+    body = _masked_eval_body(loss_fn)
+
+    def eval_epoch(state, images_all, labels_all, idx, valid):
+        def scan_body(totals, xs):
+            bidx, v = xs
+            out = body(state, images_all[bidx], labels_all[bidx], v)
+            return (
+                {k: totals[k] + out[k].astype(jnp.float32) for k in totals},
+                None,
+            )
+
+        zeros = {
+            k: jnp.zeros((), jnp.float32)
+            for k in ("loss_sum", "correct1", "correct5", "count")
+        }
+        totals, _ = jax.lax.scan(scan_body, zeros, (idx, valid))
+        return totals
+
+    if mesh is None:
+        return jax.jit(eval_epoch)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    idx_sh = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(
+        eval_epoch,
+        in_shardings=(repl, repl, repl, idx_sh, idx_sh),
+        out_shardings=repl,
+    )
 
 
 @dataclass
@@ -492,7 +537,9 @@ class Trainer:
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
         self._epoch_fn = None          # built lazily for device_data
+        self._eval_epoch_fn = None
         self._device_dataset = None    # (id(data), images, labels) cache
+        self._device_testset = None
         self._checkpointer = (
             AsyncCheckpointer() if config.async_checkpoint else None
         )
@@ -964,9 +1011,51 @@ class Trainer:
             "batch_time_s": self.batch_meter.avg,
         }
 
+    def _eval_device(self, data, bs: int) -> Dict[str, float]:
+        """One-dispatch eval over the device-resident test set."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if (
+            self._device_testset is None
+            or self._device_testset[0] != id(data)
+        ):
+            imgs = np.asarray(data.test_images, np.float32)
+            lbls = np.asarray(data.test_labels, np.int32)
+            if self.mesh is not None:
+                repl = NamedSharding(self.mesh, P())
+                imgs, lbls = (
+                    jax.device_put(imgs, repl), jax.device_put(lbls, repl)
+                )
+            else:
+                imgs, lbls = jnp.asarray(imgs), jnp.asarray(lbls)
+            self._device_testset = (id(data), imgs, lbls)
+        _, images_all, labels_all = self._device_testset
+        n = len(data.test_labels)
+        if self.mesh is not None:
+            bs = -(-bs // int(self.mesh.devices.size)) * int(
+                self.mesh.devices.size
+            )
+        n_chunks = -(-n // bs)
+        flat = np.zeros(n_chunks * bs, np.int32)
+        flat[:n] = np.arange(n, dtype=np.int32)
+        valid = np.zeros(n_chunks * bs, bool)
+        valid[:n] = True
+        if self._eval_epoch_fn is None:
+            self._eval_epoch_fn = make_eval_epoch_fn(
+                self._loss_fn, mesh=self.mesh
+            )
+        totals = self._eval_epoch_fn(
+            self.state, images_all, labels_all,
+            jnp.asarray(flat.reshape(n_chunks, bs)),
+            jnp.asarray(valid.reshape(n_chunks, bs)),
+        )
+        return {k: float(v) for k, v in totals.items()}
+
     def evaluate(self, data, batch_size: Optional[int] = None) -> Dict[str, float]:
         bs = batch_size or self.config.batch_size
-        if self.mesh is not None:
+        if self._device_data_active():
+            totals = self._eval_device(data, bs)
+        elif self.mesh is not None:
             totals = self._eval_on_mesh(data, bs)
         else:
             totals = {

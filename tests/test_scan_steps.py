@@ -201,3 +201,21 @@ def test_device_data_fsdp_falls_back():
     assert not t._device_data_active()
     t.train_epoch(data, epoch=0)
     assert int(t.state.step) == 6
+
+
+def test_device_data_eval_matches_streaming():
+    """One-dispatch device eval returns the exact masked aggregates of the
+    streaming evaluate() — including a padded final chunk (32 test
+    examples, batch 16 -> exact; batch 24 -> one padded chunk)."""
+    data = _tiny_data()
+    t_dev = _trainer(device_data=True)
+    t_ref = _trainer()
+    t_dev.train_epoch(data, 0)
+    t_ref.train_epoch(data, 0)
+    for bs in (16, 24):
+        ev_dev = t_dev.evaluate(data, batch_size=bs)
+        ev_ref = t_ref.evaluate(data, batch_size=bs)
+        for k in ev_ref:
+            np.testing.assert_allclose(
+                ev_dev[k], ev_ref[k], rtol=1e-5, atol=1e-5
+            )
