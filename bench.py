@@ -282,6 +282,69 @@ def _device_responsive(timeout_s: float) -> bool:
         return False
 
 
+def _bench_device_epoch(args, deadline):
+    """Device-resident full-epoch benchmark: a reference-sized (60k-image)
+    epoch as ONE dispatched program over the resident dataset
+    (train/trainer.py make_train_epoch_fn) — the number to hold against
+    the reference's 8.25 s/epoch (BASELINE.md) with the entire host loop
+    removed."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_mnist_bnns_tpu.data.common import ImageClassData
+    from distributed_mnist_bnns_tpu.data.mnist import shard_indices
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    n = args.epoch_bench_images
+    rng = np.random.RandomState(0)
+    data = ImageClassData(
+        train_images=rng.rand(n, 28, 28, 1).astype(np.float32),
+        train_labels=rng.randint(0, 10, n).astype(np.int32),
+        test_images=np.zeros((16, 28, 28, 1), np.float32),
+        test_labels=np.zeros(16, np.int32),
+    )
+    trainer = Trainer(
+        TrainConfig(
+            model=args.model, batch_size=args.batch_size,
+            optimizer="adam", learning_rate=0.01, backend=args.backend,
+            seed=0, device_data=True,
+        )
+    )
+    images_all, labels_all = trainer._get_device_dataset(data)
+    idx = shard_indices(n, epoch=0, seed=0, host_id=0, num_hosts=1)
+    nb = len(idx) // args.batch_size
+    idx = jnp.asarray(
+        np.asarray(idx[: nb * args.batch_size], np.int32)
+        .reshape(nb, args.batch_size)
+    )
+    epoch_fn = trainer._get_epoch_fn()
+    holder = {}
+
+    def one():
+        trainer.state, holder["m"] = epoch_fn(
+            trainer.state, images_all, labels_all, idx, trainer.rng
+        )
+        return holder["m"]
+
+    def fetch(m):
+        holder["loss"] = float(m["loss"])
+
+    one()
+    fetch(holder["m"])  # compile + settle
+    dt, _ = _measure(one, fetch, 1, 4, args.reps, deadline)
+    if dt is None:
+        return "below measurement floor"
+    return {
+        "epoch_time_s": round(dt, 4),
+        "images_per_sec": round(nb * args.batch_size / dt, 1),
+        "n_images": nb * args.batch_size,
+        "batch_size": args.batch_size,
+        "dispatches_per_epoch": 1,
+        "loss_finite": bool(holder["loss"] == holder["loss"]),
+        "vs_reference_epoch_s": 8.25,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=4096)
@@ -313,6 +376,12 @@ def main() -> None:
                    help="also bench the xnor-resnet18 CIFAR stretch config "
                         "(BinarizedConv + im2col bit-GEMM)")
     p.add_argument("--stretch-batch-size", type=int, default=256)
+    p.add_argument("--epoch-bench", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="also time a reference-sized device-resident epoch "
+                        "(one dispatch) on the flagship model")
+    p.add_argument("--epoch-bench-images", type=int, default=60000,
+                   help="epoch size for --epoch-bench (reference: 60k)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--probe-timeout", type=float, default=150.0,
                    help="seconds to wait for the device-responsiveness "
@@ -488,6 +557,18 @@ def main() -> None:
                 }
         except Exception as e:  # never let the stretch kill the bench line
             result["stretch_xnor_resnet18_cifar"] = f"failed: {e!r:.300}"
+
+    if (
+        args.epoch_bench
+        and args.model == "bnn-mlp-large"
+        and time.monotonic() < deadline - 60
+    ):
+        try:
+            result["device_resident_epoch"] = _bench_device_epoch(
+                args, deadline
+            )
+        except Exception as e:  # never let the extra kill the bench line
+            result["device_resident_epoch"] = f"failed: {e!r:.300}"
 
     if args.all_backends:
         per_backend = {}

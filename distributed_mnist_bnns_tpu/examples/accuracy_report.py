@@ -19,7 +19,8 @@ import json
 from datetime import datetime, timezone
 
 
-def run(models, epochs, batch_size, lr, seeds, out_path, scan_steps=1):
+def run(models, epochs, batch_size, lr, seeds, out_path, scan_steps=1,
+        device_data=False):
     if epochs < 1:
         raise ValueError(f"epochs must be >= 1, got {epochs}")
     import jax
@@ -42,6 +43,7 @@ def run(models, epochs, batch_size, lr, seeds, out_path, scan_steps=1):
                     seed=seed,
                     log_interval=1000,
                     scan_steps=scan_steps,
+                    device_data=device_data,
                 )
             )
             per_seed.append(trainer.fit(data))
@@ -146,6 +148,8 @@ def main():
                    help="fuse N train steps per dispatch (TrainConfig."
                         "scan_steps); identical trajectory, removes "
                         "per-step host dispatch latency")
+    p.add_argument("--device-data", action="store_true",
+                   help="device-resident dataset, one dispatch per epoch")
     p.add_argument(
         "--platform", default=None, choices=[None, "cpu", "tpu"],
         help="pin the jax platform before backend init (use cpu when the "
@@ -167,7 +171,7 @@ def main():
                 "already initialized"
             )
     run(args.models, args.epochs, args.batch_size, args.lr, args.seeds,
-        args.out, scan_steps=args.scan_steps)
+        args.out, scan_steps=args.scan_steps, device_data=args.device_data)
 
 
 if __name__ == "__main__":
