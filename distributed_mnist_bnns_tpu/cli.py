@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--stochastic", action="store_true",
                         help="stochastic activation binarization "
                              "(reference quant_mode='stoch')")
+        sp.add_argument("--xnor-scale", action="store_true",
+                        help="XNOR-Net per-channel alpha rescaling on "
+                             "binarized GEMMs (models that support it)")
         sp.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "trained epoch's early steps here")
@@ -131,6 +134,8 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         model_kwargs["infl_ratio"] = args.infl_ratio
     if args.stochastic:
         model_kwargs["stochastic"] = True
+    if args.xnor_scale:
+        model_kwargs["scale"] = True
     config = TrainConfig(
         model=args.model,
         model_kwargs=model_kwargs,

@@ -101,6 +101,7 @@ class BinarizedDense(nn.Module):
     use_bias: bool = True
     ste: STEMode = "identity"
     stochastic: bool = False  # reference quant_mode='stoch' on activations
+    scale: bool = False       # XNOR-Net per-channel analytic scaling
     backend: Backend | None = None
     param_dtype: Dtype = jnp.float32
 
@@ -119,6 +120,14 @@ class BinarizedDense(nn.Module):
         backend = _layer_backend(self)
         y = binary_matmul(x.reshape(-1, x.shape[-1]), wb, backend)
         y = y.reshape(*lead, self.features)
+        if self.scale:
+            # XNOR-Net: rescale the ±1 GEMM by the analytic per-output-
+            # channel alpha = mean|W_latent| (Rastegari et al.) —
+            # recomputed from the latent masters each forward (no new
+            # params), gradient flows to the latents through both the
+            # STE'd sign and the real |.|-mean. Beyond reference parity
+            # (the reference never rescales, models/binarized_modules.py).
+            y = y * jnp.abs(kernel).mean(axis=0)
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype
@@ -144,6 +153,7 @@ class BinarizedConv(nn.Module):
     use_bias: bool = True
     ste: STEMode = "identity"
     stochastic: bool = False
+    scale: bool = False       # XNOR-Net per-channel analytic scaling
     backend: Backend | None = None
     param_dtype: Dtype = jnp.float32
 
@@ -216,6 +226,10 @@ class BinarizedConv(nn.Module):
             y = binary_conv2d(
                 x, wb, tuple(self.strides), padding, dtype
             )
+        if self.scale:
+            # XNOR-Net alpha per output channel: mean |W_latent| over the
+            # (kh, kw, in) receptive field (see BinarizedDense.scale).
+            y = y * jnp.abs(kernel).mean(axis=(0, 1, 2))
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype

@@ -33,6 +33,7 @@ class XnorBasicBlock(nn.Module):
     strides: int = 1
     backend: Backend | None = None
     ste: str = "identity"
+    scale: bool = False  # XNOR-Net per-channel alpha on binarized convs
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -43,11 +44,11 @@ class XnorBasicBlock(nn.Module):
         y = bn()(x)
         y = BinarizedConv(
             self.features, (3, 3), strides=(self.strides, self.strides),
-            ste=self.ste, backend=self.backend,
+            ste=self.ste, backend=self.backend, scale=self.scale,
         )(y)
         y = bn()(y)
         y = BinarizedConv(self.features, (3, 3), ste=self.ste,
-                          backend=self.backend)(y)
+                          backend=self.backend, scale=self.scale)(y)
         if shortcut.shape[-1] != self.features or self.strides != 1:
             shortcut = nn.Conv(
                 self.features, (1, 1),
@@ -63,6 +64,7 @@ class XnorBottleneckBlock(nn.Module):
     strides: int = 1
     backend: Backend | None = None
     ste: str = "identity"
+    scale: bool = False  # XNOR-Net per-channel alpha on binarized convs
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -73,15 +75,15 @@ class XnorBottleneckBlock(nn.Module):
         shortcut = x
         y = bn()(x)
         y = BinarizedConv(self.features, (1, 1), ste=self.ste,
-                          backend=self.backend)(y)
+                          backend=self.backend, scale=self.scale)(y)
         y = bn()(y)
         y = BinarizedConv(
             self.features, (3, 3), strides=(self.strides, self.strides),
-            ste=self.ste, backend=self.backend,
+            ste=self.ste, backend=self.backend, scale=self.scale,
         )(y)
         y = bn()(y)
         y = BinarizedConv(out_ch, (1, 1), ste=self.ste,
-                          backend=self.backend)(y)
+                          backend=self.backend, scale=self.scale)(y)
         if shortcut.shape[-1] != out_ch or self.strides != 1:
             shortcut = nn.Conv(
                 out_ch, (1, 1), strides=(self.strides, self.strides),
@@ -100,6 +102,7 @@ class XnorResNet(nn.Module):
     cifar_stem: bool = True  # 3x3/1 stem (CIFAR); else 7x7/2 + maxpool
     backend: Backend | None = None
     ste: str = "identity"
+    scale: bool = False  # XNOR-Net per-channel alpha on binarized convs
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -118,7 +121,7 @@ class XnorResNet(nn.Module):
                 strides = 2 if stage > 0 and b == 0 else 1
                 x = block(
                     features, strides=strides, ste=self.ste,
-                    backend=self.backend,
+                    backend=self.backend, scale=self.scale,
                 )(x, train=train)
         x = nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5

@@ -65,6 +65,7 @@ class BinarizedSelfAttention(nn.Module):
     attention_fn: Optional[Callable] = None
     ste: STEMode = "identity"
     stochastic: bool = False
+    scale: bool = False  # XNOR-Net per-channel alpha on binarized GEMMs
     backend: Optional[Backend] = None
 
     @nn.compact
@@ -86,6 +87,7 @@ class BinarizedSelfAttention(nn.Module):
                 binarize_input=True,
                 ste=self.ste,
                 stochastic=self.stochastic,
+                scale=self.scale,
                 backend=self.backend,
             )
 
@@ -135,6 +137,7 @@ class BinarizedTransformer(nn.Module):
     attention_fn: Optional[Callable] = None  # e.g. a ring-attention fn
     ste: STEMode = "identity"
     stochastic: bool = False
+    scale: bool = False  # XNOR-Net per-channel alpha on binarized GEMMs
     backend: Optional[Backend] = None
 
     @nn.compact
@@ -172,6 +175,7 @@ class BinarizedTransformer(nn.Module):
                 attention_fn=self.attention_fn,
                 ste=self.ste,
                 stochastic=self.stochastic,
+                scale=self.scale,
                 backend=self.backend,
             )(y)
             if self.dropout:
@@ -183,6 +187,7 @@ class BinarizedTransformer(nn.Module):
                 binarize_input=True,
                 ste=self.ste,
                 stochastic=self.stochastic,
+                scale=self.scale,
                 backend=self.backend,
             )(y)
             y = nn.hard_tanh(y)
@@ -191,6 +196,7 @@ class BinarizedTransformer(nn.Module):
                 binarize_input=True,
                 ste=self.ste,
                 stochastic=self.stochastic,
+                scale=self.scale,
                 backend=self.backend,
             )(y)
             if self.dropout:

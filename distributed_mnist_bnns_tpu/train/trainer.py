@@ -546,7 +546,7 @@ class Trainer:
 
     @staticmethod
     def _build_model(name: str, mk: Dict[str, Any]):
-        optional = ("dtype", "backend", "stochastic")
+        optional = ("dtype", "backend", "stochastic", "scale")
         while True:
             try:
                 return get_model(name, **mk)

@@ -206,3 +206,74 @@ def test_fp32_mlp_twin_topology_and_no_latents():
     names = set(variables["params"])
     assert sum(n.startswith("Dense_") for n in names) == 4
     assert not any(n.startswith("Binarized") for n in names)
+
+
+class TestXnorNetScaling:
+    """XNOR-Net per-channel alpha (layers.py scale=True): y_scaled equals
+    the un-scaled binary GEMM times mean|W_latent| per output channel —
+    analytic, no new params."""
+
+    def test_dense_scale_equals_alpha_rescale(self):
+        from distributed_mnist_bnns_tpu.models.layers import BinarizedDense
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 32))
+        plain = BinarizedDense(16, backend="xla", use_bias=False)
+        scaled = BinarizedDense(
+            16, backend="xla", use_bias=False, scale=True
+        )
+        variables = plain.init(jax.random.PRNGKey(1), x)
+        alpha = np.abs(np.asarray(variables["params"]["kernel"])).mean(0)
+        np.testing.assert_allclose(
+            np.asarray(scaled.apply(variables, x)),
+            np.asarray(plain.apply(variables, x)) * alpha,
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_conv_scale_equals_alpha_rescale(self):
+        from distributed_mnist_bnns_tpu.models.layers import BinarizedConv
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        plain = BinarizedConv(8, (3, 3), backend="xla", use_bias=False)
+        scaled = BinarizedConv(
+            8, (3, 3), backend="xla", use_bias=False, scale=True
+        )
+        variables = plain.init(jax.random.PRNGKey(1), x)
+        alpha = np.abs(np.asarray(variables["params"]["kernel"])).mean(
+            (0, 1, 2)
+        )
+        np.testing.assert_allclose(
+            np.asarray(scaled.apply(variables, x)),
+            np.asarray(plain.apply(variables, x)) * alpha,
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_scaled_resnet_trains_no_new_params(self):
+        from distributed_mnist_bnns_tpu.models import (
+            latent_clamp_mask,
+            xnor_resnet18,
+        )
+
+        model = xnor_resnet18(backend="xla", scale=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(1), x, train=False)
+        plain = xnor_resnet18(backend="xla")
+        v2 = plain.init(jax.random.PRNGKey(1), x, train=False)
+        assert jax.tree.structure(variables["params"]) == jax.tree.structure(
+            v2["params"]
+        )  # alpha is analytic: no new params
+        # gradient flows through the alpha into the latents
+        def loss(params):
+            out = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, train=False,
+            )
+            return jnp.sum(out ** 2)
+
+        grads = jax.grad(loss)(variables["params"])
+        mask = latent_clamp_mask(variables["params"])
+        got_latent_grad = any(
+            float(jnp.abs(g).max()) > 0
+            for g, m in zip(jax.tree.leaves(grads), jax.tree.leaves(mask))
+            if m
+        )
+        assert got_latent_grad
