@@ -1,5 +1,5 @@
-from .layers import BinarizedDense, BinarizedConv
-from .mlp import BnnMLP, bnn_mlp_large, bnn_mlp_small, fp32_mlp_large
+from .layers import BinarizedDense, QuantizedDense, BinarizedConv
+from .mlp import qnn_mlp_large, BnnMLP, bnn_mlp_large, bnn_mlp_small, fp32_mlp_large
 from .convnet import ConvNet
 from .cnn import DeepCNN
 from .bnn_cnn import BinarizedCNN
@@ -14,10 +14,12 @@ from .registry import get_model, MODEL_REGISTRY, latent_clamp_mask
 
 __all__ = [
     "BinarizedDense",
+    "QuantizedDense",
     "BinarizedConv",
     "BnnMLP",
     "bnn_mlp_large",
     "bnn_mlp_small",
+    "qnn_mlp_large",
     "fp32_mlp_large",
     "ConvNet",
     "DeepCNN",

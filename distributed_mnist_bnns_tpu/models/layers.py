@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..ops.binarize import STEMode, binarize, binarize_ste
+from ..ops.binarize import STEMode, binarize, binarize_ste, quantize
 from ..ops.xnor_gemm import (
     Backend,
     binary_conv2d,
@@ -131,6 +131,62 @@ class BinarizedDense(nn.Module):
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype
+            )
+            y = y + bias
+        return y
+
+
+class QuantizedDense(nn.Module):
+    """k-bit fixed-point dense layer: y = Q_k(x) @ Q_k(W_latent) + b_fp32.
+
+    Puts the ``quantize`` op (ops/binarize.py — the reference's ``Quantize``,
+    models/binarized_modules.py:56-63, which its scripts never used and
+    whose stochastic branch was broken) into the model zoo as a live
+    layer: fp32 latent masters quantized to ``num_bits`` signed fixed
+    point each forward with identity-STE gradients, the same latent-
+    master pattern as the binarized layers (1-bit is ``BinarizedDense``;
+    this covers the k-bit middle ground). Latents live under a module
+    name starting with "Quantized", so the [-1, 1] clamp projection does
+    NOT apply (quantize clamps to its own 2^(b-1) grid).
+
+    ``quant_input=False`` passes raw activations through (first-layer
+    semantics); stochastic rounding uses the 'binarize' rng stream when
+    present (train-time), deterministic rounding otherwise.
+    """
+
+    features: int
+    num_bits: int = 8
+    quant_input: bool = True
+    use_bias: bool = True
+    stochastic: bool = False
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel",
+            _latent_init(),
+            (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+
+        def q(v, key=None):
+            if key is not None:
+                return quantize(v, "stoch", self.num_bits, key=key)
+            return quantize(v, "det", self.num_bits)
+
+        if self.quant_input:
+            x = q(
+                x,
+                self.make_rng("binarize")
+                if self.stochastic and self.has_rng("binarize") else None,
+            )
+        wq = q(kernel)
+        y = jnp.dot(x, wq, preferred_element_type=jnp.float32)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,),
+                self.param_dtype,
             )
             y = y + bias
         return y

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..ops.xnor_gemm import Backend
-from .layers import BinarizedDense
+from .layers import BinarizedDense, QuantizedDense
 
 
 class BnnMLP(nn.Module):
@@ -81,6 +81,56 @@ class BnnMLP(nn.Module):
         x = nn.hard_tanh(x)
         x = nn.Dense(self.num_classes)(x)  # fp32 classifier head
         return nn.log_softmax(x)
+
+
+class QnnMLP(nn.Module):
+    """k-bit quantized twin of the flagship topology (QuantizedDense in
+    place of BinarizedDense, same BN/Hardtanh/dropout-before-bn3 ordering)
+    — makes the reference's dead ``Quantize`` op (models/
+    binarized_modules.py:56-63) a live, trainable model family covering
+    the middle ground between the 1-bit BNNs and the fp32 twin."""
+
+    hidden: Sequence[int] = (3072, 1536, 768)
+    num_classes: int = 10
+    dropout_rate: float = 0.3
+    num_bits: int = 8
+    stochastic: bool = False  # stochastic rounding (train-time)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1)
+        h1, h2, h3 = self.hidden
+        stoch = self.stochastic and train
+        bn = lambda: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5
+        )
+
+        def dense(features: int, first: bool = False) -> nn.Module:
+            return QuantizedDense(
+                features,
+                num_bits=self.num_bits,
+                quant_input=not first,
+                stochastic=stoch and not first,
+            )
+
+        x = dense(h1, first=True)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = dense(h2)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = dense(h3)(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x)
+
+
+def qnn_mlp_large(infl_ratio: int = 3, **kw) -> QnnMLP:
+    return QnnMLP(
+        hidden=(1024 * infl_ratio, 512 * infl_ratio, 256 * infl_ratio), **kw
+    )
 
 
 def fp32_mlp_large(infl_ratio: int = 3, **kw) -> BnnMLP:

@@ -21,7 +21,7 @@ from flax import linen as nn
 from .bnn_cnn import BinarizedCNN
 from .cnn import DeepCNN
 from .convnet import ConvNet
-from .mlp import bnn_mlp_large, bnn_mlp_small, fp32_mlp_large
+from .mlp import bnn_mlp_large, bnn_mlp_small, fp32_mlp_large, qnn_mlp_large
 from .resnet import xnor_resnet18, xnor_resnet50
 from .transformer import bnn_vit_small, bnn_vit_tiny
 
@@ -31,6 +31,8 @@ MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     "bnn-mlp-small": bnn_mlp_small,
     # fp32 twin of the flagship (accuracy yardstick, BASELINE.md north star)
     "fp32-mlp-large": fp32_mlp_large,
+    # k-bit quantized twin (the reference's Quantize op, made live)
+    "qnn-mlp-large": qnn_mlp_large,
     # fp32 baselines (mnist-dist.py:31-51, mnist-cnn server.py:7-52)
     "convnet": ConvNet,
     "deep-cnn": DeepCNN,
