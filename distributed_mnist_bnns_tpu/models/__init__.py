@@ -5,6 +5,8 @@ from .cnn import DeepCNN
 from .bnn_cnn import BinarizedCNN
 from .resnet import XnorResNet, xnor_resnet18, xnor_resnet50
 from .transformer import (
+    BinarizedLM,
+    lm_loss,
     BinarizedSelfAttention,
     BinarizedTransformer,
     bnn_vit_small,
@@ -29,6 +31,8 @@ __all__ = [
     "xnor_resnet50",
     "BinarizedSelfAttention",
     "BinarizedTransformer",
+    "BinarizedLM",
+    "lm_loss",
     "bnn_vit_tiny",
     "bnn_vit_small",
     "get_model",

@@ -42,10 +42,16 @@ from ..ops.xnor_gemm import Backend
 from .layers import BinarizedDense
 
 
-def _attend_xla(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+def _attend_xla(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False
+) -> jnp.ndarray:
     """Exact (B, T, H, D) softmax attention — the oracle path."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        t = scores.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -63,6 +69,7 @@ class BinarizedSelfAttention(nn.Module):
     num_heads: int
     attention: str = "xla"  # "xla" | "flash" | "flash_interpret"
     attention_fn: Optional[Callable] = None
+    causal: bool = False
     ste: STEMode = "identity"
     stochastic: bool = False
     scale: bool = False  # XNOR-Net per-channel alpha on binarized GEMMs
@@ -95,12 +102,14 @@ class BinarizedSelfAttention(nn.Module):
         k = dense()(x).reshape(b, t, self.num_heads, head_dim)
         v = dense()(x).reshape(b, t, self.num_heads, head_dim)
         if self.attention_fn is not None:
+            # attention_fn owns its masking (build a causal ring with
+            # make_ring_attention(mesh, causal=True) for causal SP).
             out = self.attention_fn(q, k, v)
         elif self.attention == "xla":
-            out = _attend_xla(q, k, v)
+            out = _attend_xla(q, k, v, causal=self.causal)
         elif self.attention in ("flash", "flash_interpret"):
             out = flash_attention(
-                q, k, v, causal=False,
+                q, k, v, causal=self.causal,
                 interpret=self.attention == "flash_interpret",
             )
         else:
@@ -205,6 +214,94 @@ class BinarizedTransformer(nn.Module):
         x = nn.LayerNorm(name="ln_head")(x).mean(axis=1)
         x = nn.Dense(self.num_classes, name="head")(x)
         return nn.log_softmax(x)
+
+
+class BinarizedLM(nn.Module):
+    """Causal binarized language model — the sequence-modeling twin of the
+    vit: fp32 token + position embeddings (binarizing an embedding lookup
+    would collapse the vocabulary to sign patterns), pre-norm causal
+    blocks with binarized q/k/v/out and MLP projections, fp32 LN + head
+    over the vocab. ``attention="flash"`` runs the causal Pallas kernel;
+    an ``attention_fn`` built with ``make_ring_attention(mesh,
+    causal=True)`` runs the context window sequence-parallel — the
+    long-context path of this framework, exercised by a trainable model.
+
+    Returns (B, T, vocab) next-token log-probs (position t predicts
+    token t+1; shift-and-mask lives in ``lm_loss``)."""
+
+    vocab: int = 256
+    max_len: int = 256
+    embed_dim: int = 128
+    depth: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 2
+    dropout: float = 0.0
+    attention: str = "xla"
+    attention_fn: Optional[Callable] = None
+    ste: STEMode = "identity"
+    stochastic: bool = False
+    scale: bool = False
+    backend: Optional[Backend] = None
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        b, t = tokens.shape
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+        x = nn.Embed(self.vocab, self.embed_dim, name="tok_embed")(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.max_len, self.embed_dim),
+        )
+        x = x + pos[:, :t]
+        for i in range(self.depth):
+            y = nn.LayerNorm(name=f"ln_attn_{i}")(x)
+            y = BinarizedSelfAttention(
+                self.embed_dim,
+                self.num_heads,
+                attention=self.attention,
+                attention_fn=self.attention_fn,
+                causal=True,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                scale=self.scale,
+                backend=self.backend,
+            )(y)
+            if self.dropout:
+                y = nn.Dropout(self.dropout, deterministic=not train)(y)
+            x = x + y
+            y = nn.LayerNorm(name=f"ln_mlp_{i}")(x)
+            y = BinarizedDense(
+                self.embed_dim * self.mlp_ratio,
+                binarize_input=True,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                scale=self.scale,
+                backend=self.backend,
+            )(y)
+            y = nn.hard_tanh(y)
+            y = BinarizedDense(
+                self.embed_dim,
+                binarize_input=True,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                scale=self.scale,
+                backend=self.backend,
+            )(y)
+            if self.dropout:
+                y = nn.Dropout(self.dropout, deterministic=not train)(y)
+            x = x + y
+        x = nn.LayerNorm(name="ln_head")(x)
+        return nn.log_softmax(nn.Dense(self.vocab, name="head")(x))
+
+
+def lm_loss(log_probs: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy: position t's log-probs score token t+1
+    (the final position has no target and is dropped)."""
+    targets = tokens[:, 1:]
+    lp = log_probs[:, :-1]
+    return -jnp.take_along_axis(lp, targets[..., None], axis=-1).mean()
 
 
 def bnn_vit_tiny(**kw) -> BinarizedTransformer:
