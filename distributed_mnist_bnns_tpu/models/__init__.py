@@ -9,6 +9,7 @@ from .transformer import (
     lm_loss,
     BinarizedSelfAttention,
     BinarizedTransformer,
+    TransformerBlock,
     bnn_vit_small,
     bnn_vit_tiny,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "xnor_resnet50",
     "BinarizedSelfAttention",
     "BinarizedTransformer",
+    "TransformerBlock",
     "BinarizedLM",
     "lm_loss",
     "bnn_vit_tiny",

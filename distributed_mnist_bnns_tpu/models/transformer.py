@@ -126,6 +126,65 @@ class BinarizedSelfAttention(nn.Module):
         return dense()(out.reshape(b, t, self.embed_dim))
 
 
+class TransformerBlock(nn.Module):
+    """Pre-norm block shared by the vit and the LM:
+    x += attn(LN(x)); x += mlp(LN(x)) with the MLP as BinarizedDense ->
+    Hardtanh -> BinarizedDense.
+
+    NOTE: deliberately NOT named Binarized* — latent_clamp_mask matches
+    module-path components by that prefix, and this block also holds
+    LayerNorm params that must stay unclamped; the BinarizedDense /
+    BinarizedSelfAttention children re-establish the prefix for the
+    latents."""
+
+    embed_dim: int
+    num_heads: int
+    mlp_ratio: int = 2
+    dropout: float = 0.0
+    attention: str = "xla"
+    attention_fn: Optional[Callable] = None
+    causal: bool = False
+    ste: STEMode = "identity"
+    stochastic: bool = False
+    scale: bool = False
+    backend: Optional[Backend] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        def dense(features):
+            return BinarizedDense(
+                features,
+                binarize_input=True,
+                ste=self.ste,
+                stochastic=self.stochastic,
+                scale=self.scale,
+                backend=self.backend,
+            )
+
+        y = nn.LayerNorm(name="ln_attn")(x)
+        y = BinarizedSelfAttention(
+            self.embed_dim,
+            self.num_heads,
+            attention=self.attention,
+            attention_fn=self.attention_fn,
+            causal=self.causal,
+            ste=self.ste,
+            stochastic=self.stochastic,
+            scale=self.scale,
+            backend=self.backend,
+        )(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(name="ln_mlp")(x)
+        y = dense(self.embed_dim * self.mlp_ratio)(y)
+        y = nn.hard_tanh(y)
+        y = dense(self.embed_dim)(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
 class BinarizedTransformer(nn.Module):
     """Patch-embedding binarized transformer classifier.
 
@@ -175,42 +234,19 @@ class BinarizedTransformer(nn.Module):
             (1, nh * nw, self.embed_dim),
         )
         x = x + pos
-        for i in range(self.depth):
-            y = nn.LayerNorm(name=f"ln_attn_{i}")(x)
-            y = BinarizedSelfAttention(
+        for _ in range(self.depth):
+            x = TransformerBlock(
                 self.embed_dim,
                 self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout,
                 attention=self.attention,
                 attention_fn=self.attention_fn,
                 ste=self.ste,
                 stochastic=self.stochastic,
                 scale=self.scale,
                 backend=self.backend,
-            )(y)
-            if self.dropout:
-                y = nn.Dropout(self.dropout, deterministic=not train)(y)
-            x = x + y
-            y = nn.LayerNorm(name=f"ln_mlp_{i}")(x)
-            y = BinarizedDense(
-                self.embed_dim * self.mlp_ratio,
-                binarize_input=True,
-                ste=self.ste,
-                stochastic=self.stochastic,
-                scale=self.scale,
-                backend=self.backend,
-            )(y)
-            y = nn.hard_tanh(y)
-            y = BinarizedDense(
-                self.embed_dim,
-                binarize_input=True,
-                ste=self.ste,
-                stochastic=self.stochastic,
-                scale=self.scale,
-                backend=self.backend,
-            )(y)
-            if self.dropout:
-                y = nn.Dropout(self.dropout, deterministic=not train)(y)
-            x = x + y
+            )(x, train=train)
         x = nn.LayerNorm(name="ln_head")(x).mean(axis=1)
         x = nn.Dense(self.num_classes, name="head")(x)
         return nn.log_softmax(x)
@@ -255,11 +291,12 @@ class BinarizedLM(nn.Module):
             (1, self.max_len, self.embed_dim),
         )
         x = x + pos[:, :t]
-        for i in range(self.depth):
-            y = nn.LayerNorm(name=f"ln_attn_{i}")(x)
-            y = BinarizedSelfAttention(
+        for _ in range(self.depth):
+            x = TransformerBlock(
                 self.embed_dim,
                 self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout,
                 attention=self.attention,
                 attention_fn=self.attention_fn,
                 causal=True,
@@ -267,31 +304,7 @@ class BinarizedLM(nn.Module):
                 stochastic=self.stochastic,
                 scale=self.scale,
                 backend=self.backend,
-            )(y)
-            if self.dropout:
-                y = nn.Dropout(self.dropout, deterministic=not train)(y)
-            x = x + y
-            y = nn.LayerNorm(name=f"ln_mlp_{i}")(x)
-            y = BinarizedDense(
-                self.embed_dim * self.mlp_ratio,
-                binarize_input=True,
-                ste=self.ste,
-                stochastic=self.stochastic,
-                scale=self.scale,
-                backend=self.backend,
-            )(y)
-            y = nn.hard_tanh(y)
-            y = BinarizedDense(
-                self.embed_dim,
-                binarize_input=True,
-                ste=self.ste,
-                stochastic=self.stochastic,
-                scale=self.scale,
-                backend=self.backend,
-            )(y)
-            if self.dropout:
-                y = nn.Dropout(self.dropout, deterministic=not train)(y)
-            x = x + y
+            )(x, train=train)
         x = nn.LayerNorm(name="ln_head")(x)
         return nn.log_softmax(nn.Dense(self.vocab, name="head")(x))
 
