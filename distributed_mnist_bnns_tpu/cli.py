@@ -41,6 +41,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="step = reference x0.1-every-40-epochs decay; "
                              "cosine anneals to 0 over --epochs")
         sp.add_argument("--warmup-epochs", type=int, default=0)
+        sp.add_argument("--clip-grad-norm", type=float, default=None,
+                        help="global-norm gradient clipping threshold")
         sp.add_argument("--seed", type=int, default=42)
         sp.add_argument("--log-interval", type=int, default=100)
         from .ops.xnor_gemm import BACKENDS
@@ -145,6 +147,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         learning_rate=args.lr,
         lr_schedule=args.lr_schedule,
         warmup_epochs=args.warmup_epochs,
+        clip_grad_norm=args.clip_grad_norm,
         seed=args.seed,
         log_interval=args.log_interval,
         loss=args.loss,

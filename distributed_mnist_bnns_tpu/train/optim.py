@@ -76,17 +76,37 @@ _HP_KEYS = ("learning_rate", "momentum", "b1", "b2", "eps", "weight_decay")
 
 
 def make_optimizer(
-    name: str, learning_rate: float, **kwargs: Any
+    name: str, learning_rate: float, *, clip_grad_norm: float | None = None,
+    **kwargs: Any,
 ) -> optax.GradientTransformation:
     """Build a registry optimizer wrapped in inject_hyperparams so the
     learning rate (and other numeric HPs) can be retuned per epoch without
-    resetting moment state."""
+    resetting moment state.
+
+    ``clip_grad_norm`` prepends global-norm gradient clipping INSIDE the
+    inject_hyperparams wrapper — the hyperparams dict stays the outermost
+    state attribute, so the Trainer's per-epoch lr/regime writes keep
+    working (chaining outside would bury it and silently disable the lr
+    schedule)."""
     try:
-        ctor = OPTIMIZER_REGISTRY[name.lower()]
+        base_ctor = OPTIMIZER_REGISTRY[name.lower()]
     except KeyError:
         raise ValueError(
             f"unknown optimizer {name!r}; available: {sorted(OPTIMIZER_REGISTRY)}"
         ) from None
+    if clip_grad_norm is not None:
+        if clip_grad_norm <= 0:
+            raise ValueError(f"clip_grad_norm must be > 0, got {clip_grad_norm}")
+
+        def ctor(*a, **kw):
+            return optax.chain(
+                optax.clip_by_global_norm(clip_grad_norm), base_ctor(*a, **kw)
+            )
+
+        # inject_hyperparams introspects the ctor signature:
+        ctor.__signature__ = inspect.signature(base_ctor)
+    else:
+        ctor = base_ctor
     # Materialize numeric values for HP keys the ctor accepts with a
     # non-numeric default (e.g. sgd's momentum=None): inject_hyperparams
     # only exposes numeric args, and a regime must be able to retune any

@@ -406,6 +406,7 @@ class TrainConfig:
     lr_schedule: str = "step"      # "step" (reference decay) | "cosine"
     warmup_epochs: int = 0         # linear warmup before either schedule
     regime: Optional[Dict[int, Dict[str, Any]]] = None
+    clip_grad_norm: Optional[float] = None  # global-norm gradient clipping
     seed: int = 42
     log_interval: int = 100
     loss: str = "ce"
@@ -505,7 +506,10 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         self.clamp_mask = latent_clamp_mask(params)
-        tx = make_optimizer(config.optimizer, config.learning_rate)
+        tx = make_optimizer(
+            config.optimizer, config.learning_rate,
+            clip_grad_norm=config.clip_grad_norm,
+        )
         self.state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -859,6 +863,7 @@ class Trainer:
             tx = make_optimizer(
                 cfg["optimizer"],
                 cfg.get("learning_rate", self.config.learning_rate),
+                clip_grad_norm=self.config.clip_grad_norm,
                 **regime_hp_kwargs(cfg["optimizer"], cfg),
             )
             self.state = self.state.replace(

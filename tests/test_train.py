@@ -370,3 +370,61 @@ class TestLrSchedules:
         assert float(hp["learning_rate"]) == pytest.approx(
             t._lr_for_epoch(2), rel=1e-6
         )
+
+
+class TestGradClipping:
+    """--clip-grad-norm: global-norm clipping inside inject_hyperparams."""
+
+    def _trainer(self, **kw):
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        return Trainer(
+            TrainConfig(
+                model="bnn-mlp-small",
+                model_kwargs={"infl_ratio": 1},
+                batch_size=16,
+                optimizer="sgd",
+                learning_rate=1.0,
+                backend="xla",
+                seed=2,
+                **kw,
+            )
+        )
+
+    def test_update_norm_bounded(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        clip = 1e-3
+        t = self._trainer(clip_grad_norm=clip)
+        rng = np.random.RandomState(0)
+        images = jnp.asarray(rng.rand(16, 28, 28, 1).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, 10, 16).astype(np.int32))
+        before = jax.device_get(t.state.params)
+        t.state, _ = t.train_step(t.state, images, labels, t.rng)
+        after = jax.device_get(t.state.params)
+        # SGD lr=1: ||delta|| == ||clipped grad|| <= clip (clamp can only
+        # shrink params further)
+        delta_sq = sum(
+            float(((a - b) ** 2).sum())
+            for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))
+        )
+        assert delta_sq ** 0.5 <= clip * 1.01
+
+    def test_lr_schedule_still_reaches_optimizer(self):
+        import pytest as _pytest
+
+        t = self._trainer(clip_grad_norm=0.5, epochs=4, lr_schedule="cosine")
+        t._apply_epoch_regime(2)
+        hp = t.state.opt_state.hyperparams
+        assert float(hp["learning_rate"]) == _pytest.approx(
+            t._lr_for_epoch(2), rel=1e-6
+        )
+
+    def test_rejects_nonpositive_clip(self):
+        import pytest as _pytest
+
+        from distributed_mnist_bnns_tpu.train import make_optimizer
+
+        with _pytest.raises(ValueError, match="clip_grad_norm"):
+            make_optimizer("sgd", 0.1, clip_grad_norm=0.0)
