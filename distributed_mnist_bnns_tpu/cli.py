@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "trained epoch's early steps here")
         sp.add_argument("--loss", default="ce",
                         choices=["ce", "hinge", "sqrt_hinge"])
+        sp.add_argument("--label-smoothing", type=float, default=0.0,
+                        help="uniform target mixing for the ce loss")
         sp.add_argument("--precision", default="fp32",
                         choices=["fp32", "bf16"],
                         help="bf16 = mixed precision (AMP O2 parity)")
@@ -151,6 +153,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         seed=args.seed,
         log_interval=args.log_interval,
         loss=args.loss,
+        label_smoothing=args.label_smoothing,
         precision=args.precision,
         backend=args.backend,
         results_path=args.results,

@@ -54,13 +54,32 @@ def _sqrt_hinge_bwd(res, g):
 sqrt_hinge_loss.defvjp(_sqrt_hinge_fwd, _sqrt_hinge_bwd)
 
 
-def make_loss(name: str, num_classes: int = 10):
+def make_loss(name: str, num_classes: int = 10, label_smoothing: float = 0.0):
     """Loss registry for the trainer: 'ce' (the reference training loops),
     'hinge' / 'sqrt_hinge' (the reference's HingeLoss / SqrtHingeLoss
     modules, models/binarized_modules.py:20-54, which take ±1-coded
-    targets — integer labels are one-hot ±1 encoded here)."""
+    targets — integer labels are one-hot ±1 encoded here).
+
+    ``label_smoothing`` (ce only) mixes the one-hot target with the
+    uniform distribution — a per-sample mean loss, so the masked-eval and
+    grad-accum exactness properties are preserved."""
+    if label_smoothing and name != "ce":
+        raise ValueError("label_smoothing only applies to the 'ce' loss")
     if name == "ce":
-        return cross_entropy_loss
+        if not label_smoothing:
+            return cross_entropy_loss
+        if not 0.0 < label_smoothing < 1.0:
+            raise ValueError(
+                f"label_smoothing must be in (0, 1), got {label_smoothing}"
+            )
+
+        def smoothed(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+            target = optax.smooth_labels(
+                jax.nn.one_hot(labels, num_classes), label_smoothing
+            )
+            return optax.softmax_cross_entropy(logits, target).mean()
+
+        return smoothed
     if name in ("hinge", "sqrt_hinge"):
         base = hinge_loss if name == "hinge" else sqrt_hinge_loss
 

@@ -410,6 +410,7 @@ class TrainConfig:
     seed: int = 42
     log_interval: int = 100
     loss: str = "ce"
+    label_smoothing: float = 0.0   # ce-only uniform target mixing
     precision: str = "fp32"        # "bf16": AMP-O2 parity (mnist-mixed.py:70)
     backend: Optional[str] = None  # GEMM backend override for binarized layers
     results_path: Optional[str] = None
@@ -520,7 +521,9 @@ class Trainer:
         )
         from ..ops.losses import make_loss
 
-        loss_fn = make_loss(config.loss)
+        loss_fn = make_loss(
+            config.loss, label_smoothing=config.label_smoothing
+        )
         self._loss_fn = loss_fn
         if config.grad_accum > 1 and config.batch_size % config.grad_accum:
             raise ValueError(

@@ -54,3 +54,51 @@ def test_cross_entropy_shift_invariant_logsoftmax_quirk():
     once = cross_entropy_loss(logits, jnp.array([0, 2]))
     twice = cross_entropy_loss(jax.nn.log_softmax(logits), jnp.array([0, 2]))
     assert np.isfinite(float(once)) and np.isfinite(float(twice))
+
+
+class TestLabelSmoothing:
+    def test_zero_smoothing_is_plain_ce(self):
+        import jax
+
+        from distributed_mnist_bnns_tpu.ops.losses import (
+            cross_entropy_loss,
+            make_loss,
+        )
+
+        assert make_loss("ce") is cross_entropy_loss
+        logits = jax.random.normal(jax.random.PRNGKey(0), (8, 10))
+        labels = jnp.arange(8) % 10
+        smoothed = make_loss("ce", label_smoothing=0.1)
+        # smoothing by eps mixes in the uniform target: loss_eps =
+        # (1-eps)*ce + eps*mean-over-classes CE term -> strictly different
+        # from plain ce but close for small eps
+        a = float(cross_entropy_loss(logits, labels))
+        b = float(smoothed(logits, labels))
+        assert a != b
+        assert abs(a - b) < 1.0
+
+    def test_smoothed_ce_matches_manual(self):
+        import jax
+        import numpy as np
+
+        from distributed_mnist_bnns_tpu.ops.losses import make_loss
+
+        eps = 0.2
+        logits = jax.random.normal(jax.random.PRNGKey(1), (4, 10))
+        labels = jnp.array([0, 3, 7, 9])
+        lp = jax.nn.log_softmax(logits)
+        onehot = jax.nn.one_hot(labels, 10)
+        target = onehot * (1 - eps) + eps / 10
+        manual = float(-(target * lp).sum(-1).mean())
+        got = float(make_loss("ce", label_smoothing=eps)(logits, labels))
+        np.testing.assert_allclose(got, manual, rtol=1e-6)
+
+    def test_rejects_bad_configs(self):
+        import pytest as _pytest
+
+        from distributed_mnist_bnns_tpu.ops.losses import make_loss
+
+        with _pytest.raises(ValueError, match="only applies"):
+            make_loss("hinge", label_smoothing=0.1)
+        with _pytest.raises(ValueError, match="label_smoothing"):
+            make_loss("ce", label_smoothing=1.5)
