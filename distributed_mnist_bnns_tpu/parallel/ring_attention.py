@@ -123,8 +123,16 @@ def _finalize_ring(local_fn, mesh: Mesh, axis: str):
     """shard_map + jit the per-device ring body, resharding inputs onto
     the seq layout first — a no-op for already-sharded arrays, and the
     reshard that lets callers holding single-device (committed) q/k/v —
-    e.g. a model calling this mid-forward — use the ring directly."""
-    seq_sharded = P(None, axis, None, None)
+    e.g. a model calling this mid-forward — use the ring directly.
+
+    On a 2-D mesh with a 'data' axis (e.g. make_mesh(data=2) x seq=4),
+    the batch dim additionally shards over 'data': each data-row runs its
+    own independent K/V ring over ICI while batches split across rows —
+    simultaneous DP x SP, the long-context scale-out layout."""
+    batch_axis = next(
+        (a for a in mesh.axis_names if a == "data" and a != axis), None
+    )
+    seq_sharded = P(batch_axis, axis, None, None)
     fn = jax.shard_map(
         local_fn,
         mesh=mesh,
