@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["ce", "hinge", "sqrt_hinge"])
         sp.add_argument("--label-smoothing", type=float, default=0.0,
                         help="uniform target mixing for the ce loss")
+        sp.add_argument("--augment", action="store_true",
+                        help="device-side random crop+flip inside the "
+                             "train step (the CIFAR recipe)")
         sp.add_argument("--precision", default="fp32",
                         choices=["fp32", "bf16"],
                         help="bf16 = mixed precision (AMP O2 parity)")
@@ -154,6 +157,7 @@ def _make_trainer(args, input_shape=(28, 28, 1)):
         log_interval=args.log_interval,
         loss=args.loss,
         label_smoothing=args.label_smoothing,
+        augment=args.augment,
         precision=args.precision,
         backend=args.backend,
         results_path=args.results,

@@ -1,3 +1,4 @@
+from .augment import random_crop_flip
 from .binarize import binarize, binarize_ste, quantize
 from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss, make_loss
 from .bitpack import pack_bits, pack_bits_mxu, unpack_bits, packed_dim
@@ -13,6 +14,7 @@ from .xnor_gemm import (
 )
 
 __all__ = [
+    "random_crop_flip",
     "binarize",
     "binarize_ste",
     "quantize",

@@ -1,0 +1,40 @@
+"""Device-side image augmentation — runs INSIDE the jitted train step.
+
+No reference counterpart (its transforms are normalize-only,
+mnist-dist2.py:96-99); included because the CIFAR stretch configs
+(XNOR-ResNets) need crop/flip augmentation to train to competitive
+accuracy, and on TPU the right place for it is the device: a pad +
+per-sample dynamic-slice crop + lax flip fuses into the step program, so
+augmentation costs no host work and composes with the scan /
+device-resident dispatch paths (train/trainer.py) — the torchvision
+RandomCrop(padding=4) + RandomHorizontalFlip recipe, functionally.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop_flip(
+    images: jnp.ndarray, key: jax.Array, *, pad: int = 4
+) -> jnp.ndarray:
+    """Per-sample random shifted crop (zero padding) + horizontal flip.
+
+    images: (B, H, W, C); returns the same shape. Each sample draws its
+    own crop offset in [0, 2*pad] per spatial axis and its own flip coin.
+    """
+    b, h, w, c = images.shape
+    ky, kx, kf = jax.random.split(key, 3)
+    padded = jnp.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0))
+    )
+    oy = jax.random.randint(ky, (b,), 0, 2 * pad + 1)
+    ox = jax.random.randint(kx, (b,), 0, 2 * pad + 1)
+
+    def crop(img, oy, ox):
+        return jax.lax.dynamic_slice(img, (oy, ox, 0), (h, w, c))
+
+    out = jax.vmap(crop)(padded, oy, ox)
+    flip = jax.random.bernoulli(kf, 0.5, (b,))
+    return jnp.where(flip[:, None, None, None], out[:, :, ::-1, :], out)

@@ -92,6 +92,7 @@ def make_dp_train_step(
     donate: bool = True,
     remat: bool = False,
     grad_accum: int = 1,
+    augment: bool = False,
 ) -> Callable:
     """GSPMD data-parallel train step (grad all-reduce inserted by XLA).
 
@@ -100,7 +101,8 @@ def make_dp_train_step(
     — XLA turns the batch-sharded loss/grad reductions into ICI
     all-reduces, the role of DDP's backward hooks."""
     train_step = make_step_body(
-        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum,
+        augment=augment,
     )
     repl = NamedSharding(mesh, P())
     data_sh = NamedSharding(mesh, P("data"))

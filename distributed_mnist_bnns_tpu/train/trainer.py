@@ -78,6 +78,7 @@ def make_step_body(
     loss_fn: Callable = cross_entropy_loss,
     remat: bool = False,
     grad_accum: int = 1,
+    augment: bool = False,
 ) -> Callable:
     """The un-jitted train-step body: fwd -> loss -> bwd -> optax -> clamp.
 
@@ -91,6 +92,11 @@ def make_step_body(
     that lets batch sizes (or models) that would not otherwise fit run on a
     chip. No reference counterpart (SURVEY §5: no memory management at all);
     this is a TPU-first addition.
+
+    ``augment=True`` applies the device-side random crop+flip
+    (ops/augment.py) to the batch inside the step (train path only, its
+    own rng stream split from the step rng) — the torchvision
+    RandomCrop+Flip recipe with zero host work.
 
     ``grad_accum=N`` splits the batch into N microbatches scanned
     sequentially inside the step, averaging the gradients before ONE
@@ -149,6 +155,11 @@ def make_step_body(
         rng: jax.Array,
     ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         step_rng = jax.random.fold_in(rng, state.step)
+        if augment:
+            from ..ops.augment import random_crop_flip
+
+            step_rng, aug_rng = jax.random.split(step_rng)
+            images = random_crop_flip(images, aug_rng)
         dropout_rng, binarize_rng = jax.random.split(step_rng)
         rngs = {"dropout": dropout_rng, "binarize": binarize_rng}
 
@@ -178,10 +189,12 @@ def make_train_step(
     donate: bool = True,
     remat: bool = False,
     grad_accum: int = 1,
+    augment: bool = False,
 ) -> Callable:
     """Jitted single-batch train step (see ``make_step_body``)."""
     body = make_step_body(
-        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum,
+        augment=augment,
     )
     return jax.jit(body, donate_argnums=(0,) if donate else ())
 
@@ -193,6 +206,7 @@ def make_train_scan(
     donate: bool = True,
     remat: bool = False,
     grad_accum: int = 1,
+    augment: bool = False,
     mesh=None,
 ) -> Callable:
     """Multi-step train dispatch: ``lax.scan`` the step body over a stacked
@@ -214,7 +228,8 @@ def make_train_scan(
     sharded per step, steps replicated) and the state replicated — the
     GSPMD DP layout of parallel/data_parallel.py."""
     body = make_step_body(
-        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum,
+        augment=augment,
     )
 
     def train_scan(state, images, labels, rng):
@@ -248,6 +263,7 @@ def make_train_epoch_fn(
     donate: bool = True,
     remat: bool = False,
     grad_accum: int = 1,
+    augment: bool = False,
     mesh=None,
 ) -> Callable:
     """Whole-epoch device-resident training: ONE dispatch per epoch.
@@ -266,7 +282,8 @@ def make_train_epoch_fn(
     (no collective); XLA inserts only the usual grad all-reduce.
     Trainer wiring: TrainConfig.device_data."""
     body = make_step_body(
-        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum,
+        augment=augment,
     )
 
     def epoch_fn(state, images_all, labels_all, idx, rng):
@@ -411,6 +428,7 @@ class TrainConfig:
     log_interval: int = 100
     loss: str = "ce"
     label_smoothing: float = 0.0   # ce-only uniform target mixing
+    augment: bool = False          # device-side random crop+flip in-step
     precision: str = "fp32"        # "bf16": AMP-O2 parity (mnist-mixed.py:70)
     backend: Optional[str] = None  # GEMM backend override for binarized layers
     results_path: Optional[str] = None
@@ -532,7 +550,7 @@ class Trainer:
             )
         self.train_step = make_train_step(
             self.clamp_mask, loss_fn=loss_fn, remat=config.remat,
-            grad_accum=config.grad_accum,
+            grad_accum=config.grad_accum, augment=config.augment,
         )
         self.eval_step = make_eval_step(loss_fn=loss_fn)
         self.mesh = None
@@ -615,6 +633,7 @@ class Trainer:
         dp_step = make_dp_train_step(
             self.clamp_mask, self.mesh, loss_fn=loss_fn,
             remat=self.config.remat, grad_accum=self.config.grad_accum,
+            augment=self.config.augment,
         )
         mesh = self.mesh
         rng_global = _make_rng_replicator(mesh)
@@ -637,6 +656,7 @@ class Trainer:
         base = make_train_step(
             self.clamp_mask, loss_fn=loss_fn, donate=False,
             remat=self.config.remat, grad_accum=self.config.grad_accum,
+            augment=self.config.augment,
         )
         fsdp_step = make_fsdp_train_step(base, self.mesh, self.state)
         self.state = shard_state_fsdp(self.state, self.mesh)
@@ -716,7 +736,7 @@ class Trainer:
         scan = make_train_scan(
             self.clamp_mask, loss_fn=self._loss_fn,
             remat=self.config.remat, grad_accum=self.config.grad_accum,
-            mesh=self.mesh,
+            augment=self.config.augment, mesh=self.mesh,
         )
         if self.mesh is not None:
             from ..parallel import shard_batch
@@ -758,7 +778,8 @@ class Trainer:
             self._epoch_fn = make_train_epoch_fn(
                 self.clamp_mask, loss_fn=self._loss_fn,
                 remat=self.config.remat,
-                grad_accum=self.config.grad_accum, mesh=self.mesh,
+                grad_accum=self.config.grad_accum,
+                augment=self.config.augment, mesh=self.mesh,
             )
         return self._epoch_fn
 
@@ -885,6 +906,7 @@ class Trainer:
                     self.clamp_mask, loss_fn=self._loss_fn,
                     remat=self.config.remat,
                     grad_accum=self.config.grad_accum,
+                    augment=self.config.augment,
                 )
         # In-place retune of the regime's non-lr HPs (momentum/b1/b2/eps/
         # weight_decay) — the reference's "any param-group key" semantics
