@@ -68,3 +68,37 @@ def test_trainer_augment_trains():
         )
     ]
     assert max(diffs) > 1e-6
+
+
+def test_augment_under_dp_gspmd():
+    """Per-sample dynamic-slice crops inside the GSPMD DP step (sharded
+    batch dim) compile and train on the 8-device mesh."""
+    import pytest
+
+    from distributed_mnist_bnns_tpu.data.common import ImageClassData
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.RandomState(0)
+    data = ImageClassData(
+        train_images=rng.rand(96, 28, 28, 1).astype(np.float32),
+        train_labels=rng.randint(0, 10, 96).astype(np.int32),
+        test_images=rng.rand(32, 28, 28, 1).astype(np.float32),
+        test_labels=rng.randint(0, 10, 32).astype(np.int32),
+    )
+    t = Trainer(
+        TrainConfig(
+            model="bnn-mlp-small",
+            model_kwargs={"infl_ratio": 1},
+            batch_size=16,
+            epochs=1,
+            seed=5,
+            backend="xla",
+            augment=True,
+            data_parallel=8,
+        )
+    )
+    row = t.train_epoch(data, 0)
+    assert int(t.state.step) == 6
+    assert np.isfinite(row["train_loss"])
