@@ -1,0 +1,108 @@
+"""Train the causal binarized LM on a synthetic character corpus.
+
+Runnable demo of the sequence-modeling family (models/transformer.py
+BinarizedLM): next-token training with lm_loss on a periodic synthetic
+corpus (predictable, so loss falls fast), optionally with the causal
+flash kernel (--attention flash) or sequence-parallel ring attention over
+every local device (--ring).
+
+Run: python -m distributed_mnist_bnns_tpu.examples.lm_demo \
+        [--steps 200] [--seq-len 32] [--attention xla|flash] [--ring]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
+        num_heads=4, lr=3e-3, seed=0, attention="xla", ring=False,
+        log_every=25):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..models import BinarizedLM, lm_loss
+
+    attention_fn = None
+    if ring:
+        from jax.sharding import Mesh
+
+        from ..parallel import make_ring_attention
+
+        devices = jax.devices()
+        if seq_len % len(devices):
+            raise ValueError(
+                f"--ring needs seq_len divisible by {len(devices)} devices"
+            )
+        mesh = Mesh(np.array(devices), axis_names=("seq",))
+        attention_fn = make_ring_attention(mesh, causal=True)
+
+    model = BinarizedLM(
+        vocab=vocab, max_len=seq_len, embed_dim=embed_dim, depth=depth,
+        num_heads=num_heads, attention=attention, attention_fn=attention_fn,
+    )
+    rng = np.random.RandomState(seed)
+    period = seq_len // 4
+    base = rng.randint(0, vocab, (batch, period))
+    tokens = jnp.asarray(np.tile(base, (1, seq_len // period)), jnp.int32)
+
+    variables = model.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        tokens, train=False,
+    )
+    params = variables["params"]
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out = model.apply({"params": p}, tokens, train=False)
+            return lm_loss(out, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    history = []
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state)
+        if i % log_every == 0 or i == steps - 1:
+            loss = float(loss)
+            history.append(loss)
+            print(f"step {i:4d}  next-token loss {loss:.4f} "
+                  f"({loss / float(jnp.log(2.0)):.3f} bits/token)")
+    return history
+
+
+def main():
+    # Re-assert JAX_PLATFORMS over any sitecustomize that flipped the jax
+    # config at interpreter start (same dance as cli/bench) — must run
+    # before anything initializes a backend.
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        from ..utils.platform import pin_platform
+
+        pin_platform(os.environ["JAX_PLATFORMS"])
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--depth", type=int, default=2)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--attention", default="xla", choices=["xla", "flash"])
+    p.add_argument("--ring", action="store_true",
+                   help="sequence-parallel causal ring attention over all "
+                        "local devices")
+    a = p.parse_args()
+    run(steps=a.steps, seq_len=a.seq_len, batch=a.batch, depth=a.depth,
+        lr=a.lr, seed=a.seed, attention=a.attention, ring=a.ring)
+
+
+if __name__ == "__main__":
+    main()
