@@ -192,3 +192,43 @@ class TestBatchPool:
             jax.tree.leaves(t_nat.state.params),
         ):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_native_loader_composes_with_scan(self):
+        """--native-loader + --scan-steps: the pool feeds _scan_chunks;
+        trajectory identical to the python loader."""
+        import jax
+
+        from distributed_mnist_bnns_tpu.data.common import ImageClassData
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        rng = np.random.RandomState(0)
+        data = ImageClassData(
+            train_images=rng.rand(96, 28, 28, 1).astype(np.float32),
+            train_labels=rng.randint(0, 10, 96).astype(np.int32),
+            test_images=rng.rand(16, 28, 28, 1).astype(np.float32),
+            test_labels=rng.randint(0, 10, 16).astype(np.int32),
+        )
+
+        def make(native_loader):
+            return Trainer(
+                TrainConfig(
+                    model="bnn-mlp-small",
+                    model_kwargs={"infl_ratio": 1},
+                    batch_size=16,
+                    epochs=1,
+                    seed=4,
+                    backend="xla",
+                    native_loader=native_loader,
+                    scan_steps=3,
+                )
+            )
+
+        t_py, t_nat = make(False), make(True)
+        t_py.train_epoch(data, 0)
+        t_nat.train_epoch(data, 0)
+        assert int(t_py.state.step) == int(t_nat.state.step) == 6
+        for a, b in zip(
+            jax.tree.leaves(t_py.state.params),
+            jax.tree.leaves(t_nat.state.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
