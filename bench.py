@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -282,6 +283,168 @@ def _device_responsive(timeout_s: float) -> bool:
         return False
 
 
+def _probe_device_retry(attempt_timeout_s: float, budget_s: float):
+    """Probe with retry-and-backoff across ``budget_s``: the tunnel
+    endpoint goes down for stretches and comes back (r01: down at bench
+    time; r02: up; r03: one 150 s probe failed and the whole round shipped
+    without a number). A single give-up-once probe wastes any live window
+    later in the budget, so keep probing with growing sleeps until the
+    endpoint answers or the budget is spent.
+
+    Returns (alive, probe_log): probe_log is one record per attempt so a
+    persistent failure ships with evidence the endpoint stayed dead."""
+    log = []
+    start = time.monotonic()
+    deadline = start + budget_s
+    sleep = 30.0
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.monotonic()
+        ok = _device_responsive(attempt_timeout_s)
+        log.append({
+            "attempt": attempt,
+            "at_s": round(t0 - start, 1),
+            "probe_s": round(time.monotonic() - t0, 1),
+            "alive": ok,
+        })
+        if ok:
+            return True, log
+        # Stop when another sleep+probe cannot finish inside the budget.
+        if time.monotonic() + sleep + attempt_timeout_s > deadline:
+            return False, log
+        time.sleep(sleep)
+        sleep = min(sleep * 2.0, 480.0)
+
+
+# Per-chip bf16 peak (dense MXU FLOPs/s) by device_kind substring, most
+# specific first. Sources: public TPU spec sheets (v5e 197 TF, v5p 459 TF,
+# v4 275 TF, v6e 918 TF, v3 123 TF, v2 45 TF bf16 per chip).
+_PEAKS_BF16 = (
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _chip_peak_bf16(device) -> float | None:
+    kind = (getattr(device, "device_kind", "") or str(device)).lower()
+    for sub, peak in _PEAKS_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _dense_macs_per_image(params) -> int:
+    """Analytic per-image MAC count of every Dense kernel in the model
+    (rank-2 (in, out) kernels contribute in*out MACs per image). Exact
+    for the MLP/QNN families where all FLOPs are in Dense layers; returns
+    0 if no rank-2 kernel is found (conv models: use XLA cost analysis
+    instead)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        if getattr(leaf, "ndim", 0) == 2:
+            total += int(leaf.shape[0]) * int(leaf.shape[1])
+    return total
+
+
+def _step_flops(trainer, batch_size: int) -> tuple[float, str] | None:
+    """FLOPs of one optimizer step over ``batch_size`` images: analytic
+    3x forward GEMM FLOPs — fwd = 2*MACs, plus ~2x fwd for the two
+    backward GEMMs per layer (dL/dW and dL/dx), the standard
+    training-FLOPs estimate. (XLA's cost_analysis is not used: it is
+    unavailable through the remote-compile tunnel backend, and its flop
+    count would include optimizer/elementwise noise the MFU convention
+    excludes.) Returns (flops, method) or None for models where the
+    dense count would undercount (convs)."""
+    model = getattr(trainer.config, "model", "")
+    if not ("mlp" in model or "qnn" in model):
+        # Conv models put most FLOPs outside rank-2 kernels; the dense
+        # analytic count would be a large undercount — no MFU claim.
+        return None
+    macs = _dense_macs_per_image(trainer.state.params)
+    if macs > 0:
+        return 3.0 * 2.0 * macs * batch_size, "analytic_3x_dense_gemms"
+    return None
+
+
+def _mfu(step_flops: float | None, step_time_s: float | None,
+         peak: float | None) -> float | None:
+    """Model FLOPs Utilization: achieved model FLOPs/s over the chip's
+    dense bf16 peak (BASELINE.md names images/sec/chip and MFU-style
+    utilization as the headline metrics)."""
+    if not step_flops or not step_time_s or not peak or step_time_s <= 0:
+        return None
+    return round(step_flops / step_time_s / peak, 4)
+
+
+def _cpu_fallback_extras(args):
+    """When the device endpoint stays dead for the whole probe budget,
+    still emit CPU-verifiable evidence: a short flagship train-step run
+    on the CPU backend (correctness + a lower-bound throughput, clearly
+    labeled — NOT the TPU headline). Only possible because the probe runs
+    in subprocesses, so no backend has been initialized in-process yet."""
+    from distributed_mnist_bnns_tpu.utils.platform import pin_platform
+
+    if not pin_platform("cpu"):
+        return "unavailable (a non-cpu backend is already initialized)"
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    if args.input_shape is not None:
+        input_shape = tuple(args.input_shape)
+    elif args.model.startswith("xnor-resnet"):
+        input_shape = (32, 32, 3)
+    else:
+        input_shape = (28, 28, 1)
+    bs = min(args.batch_size, 256)  # CPU evidence, keep it quick
+    trainer = Trainer(
+        TrainConfig(
+            model=args.model, batch_size=bs, optimizer="adam",
+            learning_rate=0.01, backend="bf16", seed=0,
+        ),
+        input_shape=input_shape,
+    )
+    key = jax.random.PRNGKey(0)
+    images = jax.random.normal(key, (bs, *input_shape), jnp.float32)
+    labels = jax.random.randint(key, (bs,), 0, 10)
+    loss = None
+    for _ in range(3):  # compile + warm
+        trainer.state, m = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    steps = 10
+    for _ in range(steps):
+        trainer.state, m = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+    loss = float(m["loss"])  # host fetch = sync (trustworthy on CPU)
+    dt = (time.perf_counter() - t0) / steps
+    return {
+        "note": "CPU-backend evidence only: correctness + lower-bound "
+                "throughput while the TPU endpoint was unreachable",
+        "platform": "cpu",
+        "model": args.model,
+        "batch_size": bs,
+        "input_shape": list(input_shape),
+        "images_per_sec": round(bs / dt, 1),
+        "step_time_ms": round(dt * 1e3, 3),
+        "loss_finite": math.isfinite(loss),
+    }
+
+
 def _bench_device_epoch(args, deadline):
     """Device-resident full-epoch benchmark: a reference-sized (60k-image)
     epoch as ONE dispatched program over the resident dataset
@@ -334,14 +497,22 @@ def _bench_device_epoch(args, deadline):
     dt, _ = _measure(one, fetch, 1, 4, args.reps, deadline)
     if dt is None:
         return "below measurement floor"
+    import jax
+
+    n_img = nb * args.batch_size
+    flops_info = _step_flops(trainer, n_img)  # whole epoch = one "step"
     return {
         "epoch_time_s": round(dt, 4),
-        "images_per_sec": round(nb * args.batch_size / dt, 1),
-        "n_images": nb * args.batch_size,
+        "images_per_sec": round(n_img / dt, 1),
+        "n_images": n_img,
         "batch_size": args.batch_size,
         "dispatches_per_epoch": 1,
-        "loss_finite": bool(holder["loss"] == holder["loss"]),
+        "loss_finite": math.isfinite(holder["loss"]),
         "vs_reference_epoch_s": 8.25,
+        "mfu": _mfu(
+            flops_info[0] if flops_info else None, dt,
+            _chip_peak_bf16(jax.devices()[0]),
+        ),
     }
 
 
@@ -383,22 +554,39 @@ def main() -> None:
     p.add_argument("--epoch-bench-images", type=int, default=60000,
                    help="epoch size for --epoch-bench (reference: 60k)")
     p.add_argument("--verbose", action="store_true")
-    p.add_argument("--probe-timeout", type=float, default=150.0,
-                   help="seconds to wait for the device-responsiveness "
-                        "probe (first compile included) before reporting "
-                        "the endpoint down; 0 skips the probe")
+    p.add_argument("--probe-timeout", type=float, default=90.0,
+                   help="seconds per device-responsiveness probe attempt "
+                        "(first compile included); 0 skips probing")
+    p.add_argument("--probe-budget-s", type=float, default=1500.0,
+                   help="total wall-clock budget for probe retries with "
+                        "backoff before declaring the endpoint dead "
+                        "(sleeps 30s doubling to 480s between attempts)")
     args = p.parse_args()
-    deadline = time.monotonic() + args.budget_s
 
-    if args.probe_timeout > 0 and not _device_responsive(args.probe_timeout):
-        print(json.dumps({
-            "metric": "train_throughput_mnist_bnn_mlp_large",
-            "value": None, "unit": "images/sec", "vs_baseline": None,
-            "note": "device endpoint unresponsive (a 128x128 matmul did "
-                    f"not complete in {args.probe_timeout:.0f}s in a probe "
-                    "subprocess); no measurement possible",
-        }))
-        return
+    probe_log = None
+    if args.probe_timeout > 0:
+        alive, probe_log = _probe_device_retry(
+            args.probe_timeout, args.probe_budget_s
+        )
+        if not alive:
+            result = {
+                "metric": "train_throughput_mnist_bnn_mlp_large",
+                "value": None, "unit": "images/sec", "vs_baseline": None,
+                "note": "device endpoint unresponsive: a 128x128 matmul "
+                        f"did not complete in {args.probe_timeout:.0f}s in "
+                        f"any of {len(probe_log)} probe subprocesses "
+                        f"retried with backoff over "
+                        f"{args.probe_budget_s:.0f}s; no TPU measurement "
+                        "possible",
+                "probe_log": probe_log,
+            }
+            try:
+                result["cpu_fallback"] = _cpu_fallback_extras(args)
+            except Exception as e:
+                result["cpu_fallback"] = f"failed: {e!r:.300}"
+            print(json.dumps(result))
+            return
+    deadline = time.monotonic() + args.budget_s
 
     import jax
     import jax.numpy as jnp
@@ -437,7 +625,7 @@ def main() -> None:
         """Scan-dispatch timing (device-bound); falls back to per-step
         dispatch when --scan-steps 0 or the scan is unmeasurable. Returns
         (per-step seconds, loss, scan_steps actually used: 0 = per-step
-        dispatch) so the output never misattributes the mode."""
+        dispatch, trainer) so the output never misattributes the mode."""
         trainer = make_trainer(backend)
         if args.scan_steps > 0:
             dispatches = max(1, -(-args.steps // args.scan_steps))
@@ -446,19 +634,21 @@ def main() -> None:
                 dispatches, args.warmup, args.reps, deadline,
             )
             if dt is not None:
-                return dt, loss, args.scan_steps
+                return dt, loss, args.scan_steps, trainer
             if time.monotonic() > deadline:
                 # Budget already consumed by the scan attempt: the per-step
                 # fallback would compile + warm a second program past the
                 # --budget-s contract. Report unmeasurable instead.
-                return None, loss, 0
+                return None, loss, 0, trainer
         dt, loss = _bench_train_step(
             trainer, images, labels, args.steps, args.warmup, args.reps,
             deadline,
         )
-        return dt, loss, 0
+        return dt, loss, 0, trainer
 
-    step_time, last_loss, scan_used = bench_backend(args.backend)
+    step_time, last_loss, scan_used, headline_trainer = bench_backend(
+        args.backend
+    )
     if step_time is None:
         print(json.dumps({
             "metric": "train_throughput_unmeasurable",
@@ -502,11 +692,29 @@ def main() -> None:
         ),
         "backend": args.backend,
         "device": str(jax.devices()[0]),
-        "loss_finite": bool(last_loss == last_loss),
+        "loss_finite": math.isfinite(last_loss),
         # 0 = per-step dispatch (scan disabled or fell below the
         # measurement floor); >0 = device-resident scan of that length.
         "scan_steps": scan_used,
     }
+    # MFU: achieved model FLOPs/s over the chip's dense bf16 peak.
+    chip_peak = _chip_peak_bf16(jax.devices()[0])
+    flops_info = _step_flops(headline_trainer, args.batch_size)
+    if flops_info is not None:
+        step_flops, flops_method = flops_info
+        result["mfu"] = _mfu(step_flops, step_time, chip_peak)
+        result["mfu_detail"] = {
+            "step_flops": step_flops,
+            "flops_method": flops_method,
+            "model_tflops_per_sec": round(step_flops / step_time / 1e12, 2),
+            "chip_peak_bf16_tflops": (
+                round(chip_peak / 1e12, 1) if chip_peak else None
+            ),
+            "note": "MFU vs dense bf16 MXU peak; the int8 backend's "
+                    "precision-matched peak is 2x, halve its MFU reading",
+        }
+    if probe_log is not None:
+        result["probe_attempts"] = len(probe_log)
     if per_step_dispatch_ms is not None:
         # dispatch-bound per-step time vs device-bound scan time: the
         # difference is host/tunnel dispatch latency (see PERF.md).
@@ -553,7 +761,7 @@ def main() -> None:
                     "step_time_ms": round(st_dt * 1e3, 3),
                     "batch_size": args.stretch_batch_size,
                     "backend": "pallas_xnor",
-                    "loss_finite": bool(st_loss == st_loss),
+                    "loss_finite": math.isfinite(st_loss),
                 }
         except Exception as e:  # never let the stretch kill the bench line
             result["stretch_xnor_resnet18_cifar"] = f"failed: {e!r:.300}"
@@ -578,14 +786,22 @@ def main() -> None:
                     "images_per_sec": round(ips, 1),
                     "step_time_ms": round(step_time * 1e3, 3),
                     "scan_steps": scan_used,
+                    "mfu": result.get("mfu"),
                 }
                 continue
-            dt, _, b_scan = bench_backend(b)
+            dt, _, b_scan, b_trainer = bench_backend(b)
+            if dt is None:
+                per_backend[b] = "below measurement floor"
+                continue
+            b_flops = _step_flops(b_trainer, args.batch_size)
             per_backend[b] = {
                 "images_per_sec": round(args.batch_size / dt, 1),
                 "step_time_ms": round(dt * 1e3, 3),
                 "scan_steps": b_scan,
-            } if dt is not None else "below measurement floor"
+                "mfu": _mfu(
+                    b_flops[0] if b_flops else None, dt, chip_peak
+                ),
+            }
         result["train_step_per_backend"] = per_backend
     if not args.no_crossover:
         if time.monotonic() > deadline:

@@ -23,7 +23,8 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
     import numpy as np
     import optax
 
-    from ..models import BinarizedLM, lm_loss
+    from ..models import BinarizedLM, latent_clamp_mask, lm_loss
+    from ..train import clamp_latent
 
     attention_fn = None
     if ring:
@@ -54,6 +55,7 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
         tokens, train=False,
     )
     params = variables["params"]
+    clamp_mask = latent_clamp_mask(params)
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 
@@ -65,7 +67,11 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        params = optax.apply_updates(params, updates)
+        # The projection half of BNN training (same as the Trainer):
+        # without the clamp, latents drift outside [-1, 1] over long runs
+        # and the binarization regime degrades.
+        return clamp_latent(params, clamp_mask), opt_state, loss
 
     history = []
     for i in range(steps):

@@ -8,6 +8,7 @@ from .trainer import (
     TrainConfig,
     Trainer,
     TrainState,
+    clamp_latent,
     make_eval_step,
     make_eval_epoch_fn,
     make_masked_eval_step,
@@ -25,6 +26,7 @@ __all__ = [
     "TrainConfig",
     "Trainer",
     "TrainState",
+    "clamp_latent",
     "make_train_step",
     "make_train_scan",
     "make_train_epoch_fn",

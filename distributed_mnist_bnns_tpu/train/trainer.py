@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -62,6 +63,18 @@ class TrainState(struct.PyTreeNode):
     opt_state: optax.OptState
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+def _dataset_ref(data: Any) -> Callable[[], Any]:
+    """Identity key for the device-resident dataset caches: a weakref when
+    the type supports it (a freed dataset's id() can be recycled by a new
+    object, which would silently serve stale device arrays), else a
+    strong-reference closure (always correct; pins the object, which a
+    caller passing a non-weakref-able dataset has accepted)."""
+    try:
+        return weakref.ref(data)
+    except TypeError:
+        return lambda: data
 
 
 def clamp_latent(params: Any, mask: Any) -> Any:
@@ -109,7 +122,7 @@ def make_step_body(
     all of them wrap this body."""
 
     def grads_and_metrics(state, params, images, labels, rngs):
-        def compute_loss(params, batch_stats, images, labels):
+        def compute_loss(params, batch_stats, images, labels, rngs):
             outs, mutated = state.apply_fn(
                 {"params": params, "batch_stats": batch_stats},
                 images,
@@ -125,7 +138,7 @@ def make_step_body(
         if grad_accum <= 1:
             (loss, (outs, new_bs)), grads = jax.value_and_grad(
                 compute_loss, has_aux=True
-            )(params, state.batch_stats, images, labels)
+            )(params, state.batch_stats, images, labels, rngs)
             acc = (jnp.argmax(outs, -1) == labels).mean() * 100.0
             return grads, new_bs, loss, acc
 
@@ -135,15 +148,24 @@ def make_step_body(
 
         def micro_step(carry, xs):
             bs = carry
-            im, lb = xs
+            im, lb, i = xs
+            # Each microbatch draws independent dropout / stochastic-
+            # binarization noise: without the fold-in, all N microbatches
+            # would share one key and their masks would be perfectly
+            # correlated.
+            m_rngs = jax.tree.map(
+                lambda k: jax.random.fold_in(k, i), rngs
+            )
             (loss, (outs, new_bs)), grads = jax.value_and_grad(
                 compute_loss, has_aux=True
-            )(params, bs, im, lb)
+            )(params, bs, im, lb, m_rngs)
             acc = (jnp.argmax(outs, -1) == lb).mean() * 100.0
             return (new_bs if new_bs else bs), (grads, loss, acc)
 
         new_bs, (g_stack, losses, accs) = jax.lax.scan(
-            micro_step, state.batch_stats, (m_images, m_labels)
+            micro_step,
+            state.batch_stats,
+            (m_images, m_labels, jnp.arange(grad_accum)),
         )
         grads = jax.tree.map(lambda g: g.mean(0), g_stack)
         return grads, new_bs, losses.mean(), accs.mean()
@@ -563,7 +585,9 @@ class Trainer:
         self._train_scan = None        # built lazily when scan_steps > 1
         self._epoch_fn = None          # built lazily for device_data
         self._eval_epoch_fn = None
-        self._device_dataset = None    # (id(data), images, labels) cache
+        # Device-resident array caches, keyed by a _dataset_ref identity
+        # closure: (ref, images, labels).
+        self._device_dataset = None
         self._device_testset = None
         self._checkpointer = (
             AsyncCheckpointer() if config.async_checkpoint else None
@@ -785,10 +809,11 @@ class Trainer:
 
     def _get_device_dataset(self, data):
         """Upload (and cache) the train arrays; replicated over the DP
-        mesh when present — gathers stay device-local."""
+        mesh when present — gathers stay device-local. Cache keyed by
+        object identity via ``_dataset_ref`` (not id(), see there)."""
         if (
             self._device_dataset is not None
-            and self._device_dataset[0] == id(data)
+            and self._device_dataset[0]() is data
         ):
             return self._device_dataset[1], self._device_dataset[2]
         if self.mesh is not None:
@@ -804,7 +829,7 @@ class Trainer:
         else:
             images = jnp.asarray(data.train_images, jnp.float32)
             labels = jnp.asarray(data.train_labels, jnp.int32)
-        self._device_dataset = (id(data), images, labels)
+        self._device_dataset = (_dataset_ref(data), images, labels)
         return images, labels
 
     def _train_epoch_device(self, data, epoch: int) -> Dict[str, float]:
@@ -1047,7 +1072,7 @@ class Trainer:
 
         if (
             self._device_testset is None
-            or self._device_testset[0] != id(data)
+            or self._device_testset[0]() is not data
         ):
             imgs = np.asarray(data.test_images, np.float32)
             lbls = np.asarray(data.test_labels, np.int32)
@@ -1058,7 +1083,7 @@ class Trainer:
                 )
             else:
                 imgs, lbls = jnp.asarray(imgs), jnp.asarray(lbls)
-            self._device_testset = (id(data), imgs, lbls)
+            self._device_testset = (_dataset_ref(data), imgs, lbls)
         _, images_all, labels_all = self._device_testset
         n = len(data.test_labels)
         if self.mesh is not None:

@@ -122,7 +122,13 @@ def test_bnn_vit_flash_forward_on_chip():
     """BinarizedTransformer with attention='flash' (real Mosaic lowering)
     matches its attention='xla' twin on identical params — the model-level
     proof that the flash kernel composes with the binarized stack on
-    hardware."""
+    hardware.
+
+    Per the repo numerics policy (tests/test_transformer.py:176): compare
+    the *pre-sign* attn_core intermediates, not end-to-end logits —
+    downstream binarized layers sign() the attention output, so few-ulp
+    kernel differences legitimately flip near-zero bits and final logits
+    are not a meaningful equality target."""
     from distributed_mnist_bnns_tpu.models import BinarizedTransformer
 
     xla = BinarizedTransformer(
@@ -138,10 +144,18 @@ def test_bnn_vit_flash_forward_on_chip():
         x,
         train=False,
     )
-    got = np.asarray(jax.jit(
-        lambda v, x: flash.apply(v, x, train=False)
-    )(variables, x))
-    want = np.asarray(jax.jit(
-        lambda v, x: xla.apply(v, x, train=False)
-    )(variables, x))
-    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-4)
+
+    def attn_cores(model):
+        out, state = jax.jit(
+            lambda v, x: model.apply(
+                v, x, train=False, mutable=["intermediates"]
+            )
+        )(variables, x)
+        caps = jax.tree.leaves(state["intermediates"])
+        assert len(caps) == 1  # one attn_core sow for the single block
+        assert np.isfinite(np.asarray(out)).all()
+        return np.asarray(caps[0])
+
+    np.testing.assert_allclose(
+        attn_cores(flash), attn_cores(xla), atol=5e-4, rtol=5e-4
+    )
