@@ -83,11 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="rematerialize activations in backward "
                              "(jax.checkpoint) to cut HBM use")
         sp.add_argument("--dataset", default="mnist",
-                        choices=["mnist", "cifar10"])
+                        choices=["mnist", "cifar10", "imagenet"])
         sp.add_argument("--data-dir", default=None)
         sp.add_argument("--norm", default=None,
-                        choices=["mnist", "cifar", "half", "none"],
+                        choices=["mnist", "cifar", "imagenet", "half",
+                                 "none"],
                         help="default: the dataset's own statistics")
+        sp.add_argument("--image-size", type=int, default=224,
+                        help="imagenet decode/synthetic resolution")
         sp.add_argument("--synthetic-sizes", type=int, nargs=2,
                         default=None, metavar=("TRAIN", "TEST"),
                         help="fallback synthetic dataset sizes")
@@ -133,12 +136,14 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _make_trainer(args, input_shape=(28, 28, 1)):
+def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
     from .train import TrainConfig, Trainer
 
     model_kwargs = {}
     if args.model.startswith("bnn-mlp"):
         model_kwargs["infl_ratio"] = args.infl_ratio
+    if num_classes != 10:
+        model_kwargs["num_classes"] = num_classes
     if args.stochastic:
         model_kwargs["stochastic"] = True
     if args.xnor_scale:
@@ -203,7 +208,9 @@ def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.norm is not None and args.norm not in (
-        "half", "none", {"mnist": "mnist", "cifar10": "cifar"}[args.dataset]
+        "half", "none",
+        {"mnist": "mnist", "cifar10": "cifar",
+         "imagenet": "imagenet"}[args.dataset],
     ):
         parser.error(
             f"--norm {args.norm} is not valid for --dataset {args.dataset}"
@@ -236,11 +243,16 @@ def main(argv=None) -> int:
         kwargs["norm"] = args.norm
     if args.synthetic_sizes is not None:
         kwargs["synthetic_sizes"] = tuple(args.synthetic_sizes)
+    if args.dataset == "imagenet":
+        kwargs["image_size"] = args.image_size
     data = load_dataset(args.dataset, args.data_dir, **kwargs)
     log.info("data source: %s/%s (%d train / %d test)", args.dataset,
              data.source, len(data.train_labels), len(data.test_labels))
 
-    trainer = _make_trainer(args, input_shape=data.input_shape)
+    trainer = _make_trainer(
+        args, input_shape=data.input_shape,
+        num_classes=getattr(data, "n_classes", 10),
+    )
 
     if args.cmd == "train":
         history = trainer.fit(data)

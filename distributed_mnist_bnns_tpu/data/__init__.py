@@ -1,5 +1,12 @@
 from .cifar import CIFAR10_MEAN, CIFAR10_STD, load_cifar10
 from .common import ImageClassData, prefetch_to_device
+from .imagenet import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ImageNetStream,
+    load_imagenet,
+    open_imagenet_stream,
+)
 from .mnist import (
     MnistData,
     load_idx,
@@ -13,12 +20,17 @@ from .mnist import (
 
 
 def load_dataset(name: str, data_dir=None, **kwargs) -> ImageClassData:
-    """Dispatch to a dataset pipeline by name ("mnist" | "cifar10")."""
+    """Dispatch to a dataset pipeline by name
+    ("mnist" | "cifar10" | "imagenet")."""
     if name == "mnist":
         return load_mnist(data_dir, **kwargs)
     if name in ("cifar10", "cifar"):
         return load_cifar10(data_dir, **kwargs)
-    raise ValueError(f"unknown dataset {name!r} (have: mnist, cifar10)")
+    if name == "imagenet":
+        return load_imagenet(data_dir, **kwargs)
+    raise ValueError(
+        f"unknown dataset {name!r} (have: mnist, cifar10, imagenet)"
+    )
 
 
 __all__ = [
@@ -28,6 +40,9 @@ __all__ = [
     "load_idx",
     "load_mnist",
     "load_cifar10",
+    "load_imagenet",
+    "open_imagenet_stream",
+    "ImageNetStream",
     "load_dataset",
     "shard_indices",
     "batch_iterator",
@@ -36,4 +51,6 @@ __all__ = [
     "MNIST_STD",
     "CIFAR10_MEAN",
     "CIFAR10_STD",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
 ]

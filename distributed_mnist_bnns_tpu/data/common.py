@@ -21,6 +21,7 @@ class ImageClassData:
     test_labels: np.ndarray
     source: str = ""        # e.g. "mnist" | "t10k-split" | "synthetic"
     name: str = "mnist"     # dataset family
+    n_classes: int = 10     # label-space size (imagenet: up to 1000)
 
     @property
     def input_shape(self):

@@ -1,0 +1,436 @@
+"""ImageNet-1k data pipeline for the BASELINE.json pod-scale config
+("ImageNet-1k XNOR-ResNet-50 on v5p-32 pod").
+
+The reference repo is MNIST-only (SURVEY §2.4), so this module has no
+reference counterpart — it extends the mnist.py/cifar.py design to the
+one dataset that cannot live in host RAM as float32 (1.28M x 224x224x3 x
+4B ≈ 770 GB):
+
+  * **In-memory subsets** (``load_imagenet``) return the same
+    ``ImageClassData`` container every other pipeline uses, capped at
+    ``max_train``/``max_test`` class-balanced images — enough for smoke
+    runs, tests, and the CLI, with a synthetic fallback shaped exactly
+    like the real thing (H x W x 3 uint8, ``n_classes`` up to 1000).
+  * **Streaming epochs** (``open_imagenet_stream`` -> ``ImageNetStream``)
+    decode JPEGs on host worker threads per batch, reusing the
+    DistributedSampler-equivalent ``shard_indices`` (data/mnist.py) for
+    multi-host sharding — the full-dataset path.
+
+TPU-first division of labor: the host does the minimal deterministic
+decode (resize shorter side, center crop, normalize); *random*
+augmentation (crop jitter + flip) runs on device inside the train step
+(ops/augment.py, ``--augment``), so the host never becomes the
+bottleneck doing per-sample random transforms the VPU does for free.
+
+Supported on-disk layouts (found automatically under the data dir):
+  * folder: ``train/<wnid>/*.JPEG`` and ``val/<wnid>/*.JPEG`` (the
+    standard torchvision ImageFolder layout);
+  * per-class tars: ``train/<wnid>.tar`` — exactly what unpacking the
+    official ``ILSVRC2012_img_train.tar`` one level produces.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import tarfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .common import ImageClassData, normalize_u8
+from .mnist import shard_indices
+
+log = logging.getLogger(__name__)
+
+# Standard ImageNet per-channel statistics (train split).
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+_DEFAULT_DIRS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "data"),
+    "./data",
+)
+_IMG_EXTS = (".jpeg", ".jpg", ".png")
+
+
+def _normalize(images_u8: np.ndarray, norm: str) -> np.ndarray:
+    """(N, H, W, 3) uint8 -> normalized float32 NHWC."""
+    return normalize_u8(
+        images_u8, norm, stats_name="imagenet",
+        mean=IMAGENET_MEAN, std=IMAGENET_STD,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Decoding
+
+
+def _decode_u8(data: bytes, image_size: int) -> np.ndarray:
+    """JPEG/PNG bytes -> (image_size, image_size, 3) uint8.
+
+    The standard eval transform: resize the shorter side to
+    256/224 * image_size (bilinear), center-crop image_size. Train-time
+    randomness is applied later on device (ops/augment.py)."""
+    from PIL import Image
+
+    with Image.open(io.BytesIO(data)) as im:
+        im = im.convert("RGB")
+        short = round(image_size * 256 / 224)
+        w, h = im.size
+        if w <= h:
+            w, h = short, max(1, round(h * short / w))
+        else:
+            w, h = max(1, round(w * short / h)), short
+        im = im.resize((w, h), Image.BILINEAR)
+        left = (w - image_size) // 2
+        top = (h - image_size) // 2
+        im = im.crop((left, top, left + image_size, top + image_size))
+        return np.asarray(im, np.uint8)
+
+
+class _TarCache:
+    """Per-thread cache of open TarFile handles (TarFile is not
+    thread-safe; each decode worker keeps its own handles open instead of
+    re-opening the archive per member)."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def member_bytes(self, tar_path: str, member: str) -> bytes:
+        handles = getattr(self._local, "handles", None)
+        if handles is None:
+            handles = self._local.handles = {}
+        tf = handles.get(tar_path)
+        if tf is None:
+            tf = handles[tar_path] = tarfile.open(tar_path, "r")
+        f = tf.extractfile(member)
+        if f is None:
+            raise FileNotFoundError(f"{member} not in {tar_path}")
+        with f:
+            return f.read()
+
+
+# ---------------------------------------------------------------------------
+# Index
+
+
+@dataclass
+class ImageNetIndex:
+    """A split's item index: (source, label) pairs where source is either
+    a filesystem path or a (tar_path, member_name) pair."""
+
+    items: List[Tuple]          # [(path_or_(tar,member), int label), ...]
+    wnids: Sequence[str]        # sorted; label i <-> wnids[i]
+    split: str                  # "train" | "val"
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.wnids)
+
+    def labels(self) -> np.ndarray:
+        return np.asarray([lb for _, lb in self.items], np.int32)
+
+
+def _tar_members(tar_path: str) -> Optional[List[str]]:
+    try:
+        with tarfile.open(tar_path, "r") as tf:
+            return sorted(
+                m.name for m in tf.getmembers()
+                if m.isfile() and m.name.lower().endswith(_IMG_EXTS)
+            )
+    except tarfile.TarError:
+        log.warning("skipping unreadable tar %s", tar_path)
+        return None
+
+
+def _index_split(
+    split_dir: str, wnids: Optional[Sequence[str]] = None, workers: int = 8
+) -> Optional[ImageNetIndex]:
+    """Index one split dir in either supported layout; None if absent.
+
+    ``wnids``: an existing label space to index against (the train
+    split's) — items whose wnid is not in it are dropped with a warning,
+    so val labels always mean the same class as train labels even when
+    the two splits' wnid sets disagree (partial downloads)."""
+    if not os.path.isdir(split_dir):
+        return None
+    entries = sorted(os.listdir(split_dir))
+    wnid_dirs = [
+        e for e in entries if os.path.isdir(os.path.join(split_dir, e))
+    ]
+    wnid_tars = [e for e in entries if e.endswith(".tar")]
+    # wnid -> sorted sources within that class
+    per_class: dict = {}
+    if wnid_dirs:
+        for wnid in wnid_dirs:
+            d = os.path.join(split_dir, wnid)
+            per_class[wnid] = [
+                os.path.join(d, name)
+                for name in sorted(os.listdir(d))
+                if name.lower().endswith(_IMG_EXTS)
+            ]
+    elif wnid_tars:
+        # Header scans are independent per archive: parallelize (1000
+        # per-class tars scanned serially would gate first-batch latency).
+        paths = [os.path.join(split_dir, t) for t in wnid_tars]
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            scanned = list(pool.map(_tar_members, paths))
+        for tar_name, tar_path, members in zip(wnid_tars, paths, scanned):
+            if members is None:
+                continue  # unreadable: class excluded from the label space
+            per_class[tar_name[: -len(".tar")]] = [
+                (tar_path, m) for m in members
+            ]
+    else:
+        return None
+    if wnids is None:
+        wnids = sorted(per_class)
+    mapping = {w: i for i, w in enumerate(wnids)}
+    dropped = sorted(set(per_class) - set(mapping))
+    if dropped:
+        log.warning(
+            "%s: dropping %d wnid(s) absent from the train label space "
+            "(e.g. %s)", split_dir, len(dropped), dropped[:3],
+        )
+    items: List[Tuple] = []
+    for wnid in sorted(per_class):
+        if wnid in mapping:
+            items.extend((src, mapping[wnid]) for src in per_class[wnid])
+    if not items:
+        return None
+    return ImageNetIndex(
+        items=items, wnids=list(wnids), split=os.path.basename(split_dir)
+    )
+
+
+def _find_split_dir(data_dir: Optional[str], split: str) -> Optional[str]:
+    roots = [data_dir] if data_dir else list(_DEFAULT_DIRS)
+    for root in roots:
+        if root is None or not os.path.isdir(root):
+            continue
+        for sub in (os.path.join("imagenet", split), split):
+            d = os.path.join(root, sub)
+            if os.path.isdir(d):
+                return d
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Streaming
+
+
+@dataclass
+class ImageNetStream:
+    """Streaming split: decodes per batch on worker threads, shards with
+    the DistributedSampler-equivalent ``shard_indices``. The full-scale
+    path — nothing here holds more than ``workers * batch_size`` decoded
+    images at once."""
+
+    index: ImageNetIndex
+    image_size: int = 224
+    norm: str = "imagenet"
+    workers: int = 8
+    _tars: _TarCache = field(default_factory=_TarCache, repr=False)
+
+    def __len__(self) -> int:
+        return len(self.index.items)
+
+    @property
+    def n_classes(self) -> int:
+        return self.index.n_classes
+
+    def _decode_item(self, i: int) -> np.ndarray:
+        src, _ = self.index.items[i]
+        if isinstance(src, tuple):
+            data = self._tars.member_bytes(*src)
+        else:
+            with open(src, "rb") as f:
+                data = f.read()
+        return _decode_u8(data, self.image_size)
+
+    def decode_indices(self, idx: Sequence[int]) -> np.ndarray:
+        """Decode a batch of items to normalized float32 NHWC."""
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+            imgs = list(pool.map(self._decode_item, idx))
+        return _normalize(np.stack(imgs), self.norm)
+
+    def batches(
+        self,
+        batch_size: int,
+        *,
+        epoch: int = 0,
+        seed: int = 0,
+        host_id: int = 0,
+        num_hosts: int = 1,
+        shuffle: bool = True,
+        drop_last: bool = True,
+    ):
+        """Yield (images, labels) batches of this host's epoch shard."""
+        labels = self.index.labels()
+        idx = shard_indices(
+            len(self), epoch=epoch, seed=seed, host_id=host_id,
+            num_hosts=num_hosts, shuffle=shuffle,
+        )
+        n_full = len(idx) // batch_size
+        stop = n_full * batch_size if drop_last else len(idx)
+        pool = ThreadPoolExecutor(max_workers=self.workers)
+        try:
+            for start in range(0, stop, batch_size):
+                chunk = idx[start : start + batch_size]
+                imgs = list(pool.map(self._decode_item, chunk))
+                yield (
+                    _normalize(np.stack(imgs), self.norm),
+                    labels[chunk],
+                )
+        finally:
+            pool.shutdown(wait=False)
+
+    def materialize(
+        self, max_images: Optional[int], *, seed: int = 0
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode a class-balanced subset (or everything if max_images is
+        None) into memory: (normalized float32 images, int32 labels)."""
+        labels = self.index.labels()
+        if max_images is None or max_images >= len(self):
+            take = np.arange(len(self))
+        else:
+            rng = np.random.RandomState(seed)
+            take = _balanced_subset(labels, max_images, rng)
+        return self.decode_indices(take), labels[take]
+
+
+def _balanced_subset(
+    labels: np.ndarray, n: int, rng: np.random.RandomState
+) -> np.ndarray:
+    """Round-robin over classes so a small cap still covers all of them."""
+    order = rng.permutation(len(labels))
+    by_class: dict = {}
+    for i in order:
+        by_class.setdefault(int(labels[i]), []).append(i)
+    out: List[int] = []
+    queues = list(by_class.values())
+    while len(out) < n and queues:
+        queues = [q for q in queues if q]
+        for q in queues:
+            if len(out) >= n:
+                break
+            out.append(q.pop())
+    return np.asarray(out, np.int64)
+
+
+def open_imagenet_stream(
+    data_dir: Optional[str] = None,
+    split: str = "train",
+    *,
+    image_size: int = 224,
+    norm: str = "imagenet",
+    workers: int = 8,
+    wnids: Optional[Sequence[str]] = None,
+) -> Optional[ImageNetStream]:
+    """Open a streaming view of an on-disk split; None if not found.
+
+    Pass the train stream's ``index.wnids`` as ``wnids`` when opening a
+    val stream so both splits share one label space."""
+    d = _find_split_dir(data_dir, split)
+    index = _index_split(d, wnids=wnids, workers=workers) if d else None
+    if index is None:
+        return None
+    return ImageNetStream(
+        index=index, image_size=image_size, norm=norm, workers=workers
+    )
+
+
+# ---------------------------------------------------------------------------
+# Synthetic fallback + in-memory loader
+
+
+def synthetic_imagenet(
+    image_shape: Tuple[int, int, int],
+    n_train: int,
+    n_test: int,
+    seed: int,
+    n_classes: int = 1000,
+) -> Tuple[np.ndarray, ...]:
+    """ImageNet-shaped class-conditional synthetic data.
+
+    common.synthetic_blobs stores one full-resolution template per class —
+    at 1000 x 224x224x3 that is ~1.2 GB of templates alone. Here each
+    class gets an 8x8x3 coarse pattern (96 KB for all 1000 classes),
+    nearest-upsampled to full resolution per sample, plus pixel noise:
+    same statistical role (linearly separable, correctly shaped uint8),
+    O(n_samples) memory."""
+    H, W, C = image_shape
+    rng = np.random.RandomState(seed)
+    coarse = rng.randint(0, 256, size=(n_classes, 8, 8, C), dtype=np.int16)
+
+    def make(n: int):
+        labels = rng.randint(0, n_classes, size=n).astype(np.int32)
+        t = coarse[labels]                                 # (n, 8, 8, C)
+        t = np.repeat(np.repeat(t, -(-H // 8), 1), -(-W // 8), 2)[:, :H, :W]
+        noise = rng.randint(-32, 33, size=t.shape, dtype=np.int16)
+        return np.clip(t + noise, 0, 255).astype(np.uint8), labels
+
+    tr_x, tr_y = make(n_train)
+    te_x, te_y = make(n_test)
+    return tr_x, tr_y, te_x, te_y
+
+
+def load_imagenet(
+    data_dir: Optional[str] = None,
+    *,
+    norm: str = "imagenet",
+    image_size: int = 224,
+    max_train: Optional[int] = 4096,
+    max_test: Optional[int] = 1024,
+    synthetic_ok: bool = True,
+    synthetic_sizes: Tuple[int, int] = (1024, 256),
+    synthetic_classes: int = 1000,
+    seed: int = 0,
+    workers: int = 8,
+) -> ImageClassData:
+    """In-memory ImageNet subset as an ``ImageClassData`` (the container
+    the Trainer and every parallel wrapper duck-type against).
+
+    Real data: class-balanced ``max_train``/``max_test`` caps bound host
+    memory (the full set cannot fit; use ``open_imagenet_stream`` for
+    whole-dataset epochs). Falls back to ImageNet-shaped synthetic data
+    when no on-disk layout is found."""
+    train = open_imagenet_stream(
+        data_dir, "train", image_size=image_size, norm=norm, workers=workers
+    )
+    if train is not None:
+        val = open_imagenet_stream(
+            data_dir, "val", image_size=image_size, norm=norm,
+            workers=workers, wnids=train.index.wnids,
+        )
+        tr_x, tr_y = train.materialize(max_train, seed=seed)
+        if val is not None:
+            te_x, te_y = val.materialize(max_test, seed=seed)
+        else:  # no val split on disk: hold out from the train subset
+            n_hold = max(1, len(tr_y) // 10)
+            te_x, te_y = tr_x[:n_hold], tr_y[:n_hold]
+            tr_x, tr_y = tr_x[n_hold:], tr_y[n_hold:]
+        return ImageClassData(
+            tr_x, tr_y, te_x, te_y,
+            source="imagenet", name="imagenet",
+            n_classes=train.n_classes,
+        )
+    if not synthetic_ok:
+        raise FileNotFoundError(
+            f"no ImageNet layout (train/<wnid>/ dirs or <wnid>.tar files) "
+            f"found under {data_dir or _DEFAULT_DIRS}"
+        )
+    log.warning("no ImageNet layout found; using synthetic data")
+    tr_x, tr_y, te_x, te_y = synthetic_imagenet(
+        (image_size, image_size, 3), *synthetic_sizes, seed=seed,
+        n_classes=synthetic_classes,
+    )
+    return ImageClassData(
+        _normalize(tr_x, norm), tr_y, _normalize(te_x, norm), te_y,
+        source="synthetic", name="imagenet", n_classes=synthetic_classes,
+    )

@@ -1,0 +1,245 @@
+"""Pipeline-parallel transformer models — the model-level layer over
+parallel/pipeline.py's GPipe schedule.
+
+``make_pipeline_fn`` pipelines any homogeneous stage chain; this module
+stages the actual ``TransformerBlock`` stack of the registry's
+transformer families (BinarizedTransformer / BinarizedLM,
+models/transformer.py) through it, so pipeline parallelism is a
+*trainable Trainer configuration* (``--pp N``), not a library primitive.
+
+The reference's only model parallelism is a 2-device layer placement
+with no microbatching (mnist-distributed-BNNS2.py:32-46); this is the
+TPU-native superset: stage s owns ``depth/N`` consecutive blocks
+(parameters sharded over the 'pipe' mesh axis), microbatches stream
+through the ring schedule, embeddings/head stay replicated (they are a
+tiny fraction of parameters and their compute is one tick of the
+pipeline).
+
+Parameter layout: a pipelined state stores
+``{"blocks": stage-major stacked block params, "rest": everything
+else}``; ``split_block_params`` / ``merge_block_params`` convert to and
+from the sequential layout (checkpoint interchange + the equality tests
+in tests/test_pipeline_model.py).
+
+Dropout is not supported through the pipelined path (the stage schedule
+re-executes blocks under masking, so per-call rng plumbing would differ
+from the sequential model); models must be built with dropout=0.0 —
+enforced at setup.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.layers import BinarizedDense
+from ..models.transformer import (
+    BinarizedLM,
+    BinarizedTransformer,
+    TransformerBlock,
+)
+from .pipeline import make_pipeline_fn
+
+_BLOCK = "TransformerBlock_"
+
+
+def split_block_params(params: Dict) -> Tuple[Any, Dict, List[str]]:
+    """Sequential params -> (stage-major stacked blocks, rest, names).
+
+    The stacked pytree's leaves get a new leading ``depth`` axis in block
+    order; ``rest`` holds embeddings / final norm / head."""
+    names = sorted(
+        (k for k in params if k.startswith(_BLOCK)),
+        key=lambda k: int(k.rsplit("_", 1)[1]),
+    )
+    if not names:
+        raise ValueError("params contain no TransformerBlock_* submodules")
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *(params[n] for n in names)
+    )
+    rest = {k: v for k, v in params.items() if k not in set(names)}
+    return stacked, rest, names
+
+
+def merge_block_params(stacked: Any, rest: Dict, names: List[str]) -> Dict:
+    """Inverse of ``split_block_params``."""
+    out = dict(rest)
+    for i, n in enumerate(names):
+        out[n] = jax.tree.map(lambda x, i=i: x[i], stacked)
+    return out
+
+
+def _block_module(model) -> TransformerBlock:
+    """The stage's block module, rebuilt from the parent model's knobs."""
+    if model.dropout:
+        raise ValueError(
+            "pipeline parallelism requires dropout=0.0 (see module doc)"
+        )
+    return TransformerBlock(
+        model.embed_dim,
+        model.num_heads,
+        mlp_ratio=model.mlp_ratio,
+        dropout=0.0,
+        attention=model.attention,
+        attention_fn=model.attention_fn,
+        causal=isinstance(model, BinarizedLM),
+        ste=model.ste,
+        stochastic=model.stochastic,
+        scale=model.scale,
+        backend=model.backend,
+    )
+
+
+def _make_stage_fn(model, blocks_per_stage: int) -> Callable:
+    """stage params (blocks_per_stage, ...) -> apply that many blocks."""
+    block = _block_module(model)
+
+    def stage_fn(p_group, x):
+        def body(carry, p_one):
+            return block.apply({"params": p_one}, carry), None
+
+        x, _ = jax.lax.scan(body, x, p_group)
+        return x
+
+    return stage_fn
+
+
+def _vit_embed(model: BinarizedTransformer, rest: Dict, x: jnp.ndarray):
+    """Patchify + binarized patch embedding + pos embed — the pre-block
+    part of BinarizedTransformer.__call__ (models/transformer.py)."""
+    b, h, w, c = x.shape
+    p = model.patch_size
+    nh, nw = h // p, w // p
+    x = x.reshape(b, nh, p, nw, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, p * p * c)
+    embed = BinarizedDense(
+        model.embed_dim, binarize_input=False, ste=model.ste,
+        backend=model.backend,
+    )
+    x = embed.apply({"params": rest["BinarizedDense_0"]}, x)
+    return x + rest["pos_embed"]
+
+
+def _vit_head(model: BinarizedTransformer, rest: Dict, x: jnp.ndarray):
+    x = nn.LayerNorm().apply({"params": rest["ln_head"]}, x).mean(axis=1)
+    x = nn.Dense(model.num_classes).apply({"params": rest["head"]}, x)
+    return nn.log_softmax(x)
+
+
+def _lm_embed(model: BinarizedLM, rest: Dict, tokens: jnp.ndarray):
+    t = tokens.shape[1]
+    x = nn.Embed(model.vocab, model.embed_dim).apply(
+        {"params": rest["tok_embed"]}, tokens
+    )
+    return x + rest["pos_embed"][:, :t]
+
+
+def _lm_head(model: BinarizedLM, rest: Dict, x: jnp.ndarray):
+    x = nn.LayerNorm().apply({"params": rest["ln_head"]}, x)
+    return nn.log_softmax(
+        nn.Dense(model.vocab).apply({"params": rest["head"]}, x)
+    )
+
+
+def make_pipelined_apply(
+    model,
+    mesh: Mesh,
+    depth: int,
+    *,
+    axis: str = "pipe",
+    n_micro: int = 0,
+) -> Callable:
+    """Build an ``apply_fn(variables, x, train=..., rngs=..., mutable=...)``
+    running the model's block stack as a GPipe pipeline over ``axis``.
+
+    Drop-in for ``model.apply`` in the trainer's step body (same call
+    contract: returns ``(out, {})`` when ``mutable`` is non-empty). The
+    variables' params must be in the pipelined layout
+    ``{"blocks": stacked, "rest": rest}`` (see ``pipeline_params``).
+    ``n_micro=0`` defaults to the stage count."""
+    n_stages = mesh.shape[axis]
+    if depth % n_stages:
+        raise ValueError(
+            f"model depth {depth} not divisible by pipeline stages "
+            f"{n_stages}"
+        )
+    blocks_per_stage = depth // n_stages
+    n_micro = n_micro or n_stages
+    if isinstance(model, BinarizedTransformer):
+        embed, head = _vit_embed, _vit_head
+    elif isinstance(model, BinarizedLM):
+        embed, head = _lm_embed, _lm_head
+    else:
+        raise ValueError(
+            "pipeline parallelism supports the transformer families "
+            f"(BinarizedTransformer / BinarizedLM), got {type(model).__name__}"
+        )
+    stage_fn = _make_stage_fn(model, blocks_per_stage)
+    pipe = make_pipeline_fn(mesh, stage_fn, axis=axis, n_micro=n_micro)
+
+    def apply_fn(variables, x, train=False, rngs=None, mutable=()):
+        del train, rngs  # dropout unsupported (enforced at setup)
+        params = variables["params"]
+        stacked, rest = params["blocks"], params["rest"]
+        # (depth, ...) -> (n_stages, blocks_per_stage, ...): stage-major
+        # leading axis for the shard_map's P(axis) in_spec.
+        grouped = jax.tree.map(
+            lambda p: p.reshape(
+                n_stages, blocks_per_stage, *p.shape[1:]
+            ),
+            stacked,
+        )
+        h = embed(model, rest, x)
+        h = pipe(grouped, h)
+        out = head(model, rest, h)
+        if mutable:
+            return out, {}
+        return out
+
+    return apply_fn
+
+
+def pipeline_params(params: Dict) -> Dict:
+    """Sequential params dict -> pipelined layout {"blocks", "rest"}."""
+    stacked, rest, _ = split_block_params(params)
+    return {"blocks": stacked, "rest": rest}
+
+
+def sequential_params(pipelined: Dict, depth: int) -> Dict:
+    """Pipelined layout -> sequential params dict (checkpoint export)."""
+    names = [f"{_BLOCK}{i}" for i in range(depth)]
+    return merge_block_params(pipelined["blocks"], pipelined["rest"], names)
+
+
+def place_pipelined_state(state, mesh: Mesh, *, axis: str = "pipe"):
+    """device_put a pipelined TrainState onto the mesh: block params (and
+    their optimizer moments) sharded stage-major over ``axis``, the rest
+    replicated — each stage's weights and Adam moments live only on the
+    devices that run it (ZeRO-style memory scaling along the pipeline)."""
+    repl = NamedSharding(mesh, P())
+    blocks_sh = NamedSharding(mesh, P(axis))
+
+    def spec_like(tree):
+        def leaf_spec(path, _):
+            keys = [getattr(p, "key", None) for p in path]
+            return blocks_sh if "blocks" in keys else repl
+
+        flat = jax.tree_util.tree_flatten_with_path(tree)
+        specs = [leaf_spec(path, leaf) for path, leaf in flat[0]]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(tree), specs
+        )
+
+    return jax.device_put(
+        state,
+        state.replace(
+            step=repl,
+            params=spec_like(state.params),
+            batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+            opt_state=spec_like(state.opt_state),
+        ),
+    )
