@@ -113,6 +113,17 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--dp-mode", default="gspmd",
                         choices=["gspmd", "fsdp"],
                         help="fsdp = ZeRO-style sharded params/opt state")
+        sp.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel width: Megatron col/row "
+                             "sharding over a 'model' mesh axis (MLP/QNN "
+                             "and ViT families); builds a (dp x tp) mesh")
+        sp.add_argument("--pp", type=int, default=1,
+                        help="pipeline-parallel stages: GPipe the "
+                             "transformer block stack over N devices "
+                             "(bnn-vit models; depth %% N == 0)")
+        sp.add_argument("--pp-microbatches", type=int, default=0,
+                        help="microbatches per pipelined step "
+                             "(0 = one per stage)")
         sp.add_argument("--log-file", default="log.txt")
         # multi-host rendezvous (replaces MASTER_ADDR/MASTER_PORT env://)
         sp.add_argument("--nodes", type=int, default=1)
@@ -174,6 +185,9 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
         dp_mode=args.dp_mode,
+        pipeline_parallel=args.pp,
+        pp_microbatches=args.pp_microbatches,
+        tensor_parallel=args.tp,
         profile_dir=args.profile_dir,
         remat=args.remat,
         grad_accum=args.grad_accum,

@@ -11,9 +11,22 @@ from .data_parallel import (
     shard_batch,
     replicate,
 )
-from .model_parallel import bnn_mlp_tp_rules, make_tp_train_step
+from .model_parallel import (
+    bnn_mlp_tp_rules,
+    make_tp_train_step,
+    tp_rules_by_path,
+    tp_rules_for,
+)
 from .ring_attention import attention_reference, make_ring_attention
 from .pipeline import make_pipeline_fn, sequential_reference
+from .pipeline_model import (
+    make_pipelined_apply,
+    merge_block_params,
+    pipeline_params,
+    place_pipelined_state,
+    sequential_params,
+    split_block_params,
+)
 from .expert_parallel import (
     init_expert_params,
     make_expert_parallel_moe,
@@ -33,10 +46,18 @@ __all__ = [
     "replicate",
     "bnn_mlp_tp_rules",
     "make_tp_train_step",
+    "tp_rules_by_path",
+    "tp_rules_for",
     "attention_reference",
     "make_ring_attention",
     "make_pipeline_fn",
     "sequential_reference",
+    "make_pipelined_apply",
+    "pipeline_params",
+    "sequential_params",
+    "split_block_params",
+    "merge_block_params",
+    "place_pipelined_state",
     "init_expert_params",
     "make_expert_parallel_moe",
     "moe_reference",

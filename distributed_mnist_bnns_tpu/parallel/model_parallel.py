@@ -20,38 +20,66 @@ the activation sharding of the layer they modulate.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import fnmatch
+from typing import Any, Callable, Dict
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..train.trainer import TrainState
 
+# Megatron-style roles a module can play in a TP layout:
+#   col     — column-parallel GEMM: kernel P(None, axis), bias P(axis)
+#             (output features sharded; no collective on the forward)
+#   row     — row-parallel GEMM: kernel P(axis, None), bias P(None)
+#             (contracting dim sharded; XLA inserts the psum)
+#   feat    — feature-wise layer (BatchNorm/LayerNorm/bias-only) whose
+#             features follow a column-parallel producer: all P(axis)
+#   repl    — replicated: all P()
+_ROLES = ("col", "row", "feat", "repl")
 
-def bnn_mlp_tp_rules(params: Any, axis: str = "model") -> Any:
-    """PartitionSpec tree for a BnnMLP params pytree (tensor parallelism).
 
-    Alternates column/row parallel binarized layers; the fp32 head is
-    row-parallel. BatchNorm & bias specs follow the producing layer's
-    output sharding (sharded after column-parallel, replicated after
-    row-parallel)."""
+def tp_rules_by_path(
+    params: Any,
+    table: Dict[str, str],
+    axis: str = "model",
+    *,
+    strict: bool = True,
+) -> Any:
+    """PartitionSpec tree from an explicit {module-path-pattern: role}
+    table (roles above). Patterns are fnmatch globs over the
+    '/'-joined module path (leaf name excluded), first match wins in
+    table order.
+
+    Matching is by *path name*, never by auto-name index arithmetic: a
+    model edit that inserts or renames a layer makes the lookup fail
+    loudly (strict=True) instead of silently sharding the wrong
+    layers. strict=False replicates unmatched modules instead."""
+    for role in table.values():
+        if role not in _ROLES:
+            raise ValueError(f"unknown TP role {role!r} (have {_ROLES})")
 
     def spec_for(path, leaf) -> P:
-        keys = [getattr(p, "key", "") for p in path]
-        name = next((k for k in keys if "_" in k), "")
+        keys = [getattr(p, "key", "") for p in path if hasattr(p, "key")]
+        mod_path = "/".join(keys[:-1])
         kind = keys[-1] if keys else ""
-        if name.startswith("BinarizedDense"):
-            idx = int(name.split("_")[-1])
-            col = idx % 2 == 0  # fc1/fc3 column-parallel, fc2 row-parallel
-            if kind == "kernel":
-                return P(None, axis) if col else P(axis, None)
-            return P(axis) if col else P(None)  # bias
-        if name.startswith("Dense"):  # fp32 head: row-parallel
+        role = next(
+            (r for pat, r in table.items() if fnmatch.fnmatch(mod_path, pat)),
+            None,
+        )
+        if role is None:
+            if strict:
+                raise KeyError(
+                    f"no TP rule matches module path {mod_path!r} "
+                    "(pass strict=False to replicate unmatched modules)"
+                )
+            return P()
+        if role == "col":
+            return P(None, axis) if kind == "kernel" else P(axis)
+        if role == "row":
             return P(axis, None) if kind == "kernel" else P(None)
-        if name.startswith("BatchNorm"):
-            idx = int(name.split("_")[-1])
-            after_col = idx % 2 == 0  # bn1/bn3 follow column-parallel layers
-            return P(axis) if after_col else P(None)
+        if role == "feat":
+            return P(axis)
         return P()
 
     flat = jax.tree_util.tree_flatten_with_path(params)
@@ -59,6 +87,74 @@ def bnn_mlp_tp_rules(params: Any, axis: str = "model") -> Any:
     return jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(params), specs
     )
+
+
+# The flagship BnnMLP's layout (mnist-dist2.py:46-76 topology): fc1/fc3
+# column-parallel, fc2 and the fp32 head row-parallel; each BatchNorm
+# follows its producing GEMM's output sharding. Explicit names — an
+# inserted layer breaks the lookup loudly rather than flipping parities.
+BNN_MLP_TP_TABLE: Dict[str, str] = {
+    "BinarizedDense_0": "col",
+    "BatchNorm_0": "feat",
+    "BinarizedDense_1": "row",
+    "BatchNorm_1": "repl",
+    "BinarizedDense_2": "col",
+    "BatchNorm_2": "feat",
+    "Dense_0": "row",
+}
+
+# The k-bit QNN twin has the same topology under QuantizedDense names.
+QNN_MLP_TP_TABLE: Dict[str, str] = {
+    "QuantizedDense_0": "col",
+    "BatchNorm_0": "feat",
+    "QuantizedDense_1": "row",
+    "BatchNorm_1": "repl",
+    "QuantizedDense_2": "col",
+    "BatchNorm_2": "feat",
+    "Dense_0": "row",
+}
+
+# Binarized ViT/LM blocks (models/transformer.py): Megatron attention
+# (q/k/v column-parallel over heads, out-projection row-parallel) and
+# MLP (up column, down row). Embeddings, LayerNorms, pos embeds and the
+# fp32 head are replicated — they are a tiny parameter fraction and the
+# residual stream stays replicated between blocks.
+BNN_VIT_TP_TABLE: Dict[str, str] = {
+    "TransformerBlock_*/BinarizedSelfAttention_0/BinarizedDense_0": "col",
+    "TransformerBlock_*/BinarizedSelfAttention_0/BinarizedDense_1": "col",
+    "TransformerBlock_*/BinarizedSelfAttention_0/BinarizedDense_2": "col",
+    "TransformerBlock_*/BinarizedSelfAttention_0/BinarizedDense_3": "row",
+    "TransformerBlock_*/BinarizedDense_0": "col",
+    "TransformerBlock_*/BinarizedDense_1": "row",
+    "TransformerBlock_*/ln_*": "repl",
+    "BinarizedDense_0": "repl",   # patch embedding
+    "tok_embed": "repl",
+    "ln_head": "repl",
+    "head": "repl",
+    "": "repl",                   # top-level raw params (pos_embed)
+}
+
+
+def tp_rules_for(model_name: str, params: Any, axis: str = "model") -> Any:
+    """The TP layout for a registry model family, by path-name table."""
+    if model_name.startswith("qnn"):
+        return tp_rules_by_path(params, QNN_MLP_TP_TABLE, axis)
+    if model_name.startswith("bnn-mlp"):
+        return tp_rules_by_path(params, BNN_MLP_TP_TABLE, axis)
+    if "vit" in model_name:
+        return tp_rules_by_path(params, BNN_VIT_TP_TABLE, axis)
+    # fp32-mlp-large deliberately not matched: its all-Dense topology
+    # (Dense_0..3) would collide with the head rule and mis-shard.
+    raise ValueError(
+        f"no TP rule table for model {model_name!r} "
+        "(have: the BNN-MLP/QNN and ViT families)"
+    )
+
+
+def bnn_mlp_tp_rules(params: Any, axis: str = "model") -> Any:
+    """PartitionSpec tree for a BnnMLP params pytree (tensor
+    parallelism) — the explicit-name table, see BNN_MLP_TP_TABLE."""
+    return tp_rules_by_path(params, BNN_MLP_TP_TABLE, axis)
 
 
 def make_tp_train_step(

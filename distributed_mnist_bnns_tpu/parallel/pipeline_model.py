@@ -193,9 +193,18 @@ def make_pipelined_apply(
             ),
             stacked,
         )
+        # The schedule needs B divisible by n_micro; pad (statically, the
+        # batch dim is a trace-time constant) and slice back — partial
+        # final eval batches just ride a slightly padded pipeline.
+        b = x.shape[0]
+        pad = (-b) % n_micro
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad, *x.shape[1:]), x.dtype)]
+            )
         h = embed(model, rest, x)
         h = pipe(grouped, h)
-        out = head(model, rest, h)
+        out = head(model, rest, h)[:b]
         if mutable:
             return out, {}
         return out

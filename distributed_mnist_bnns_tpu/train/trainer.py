@@ -463,6 +463,14 @@ class TrainConfig:
     data_parallel: Optional[object] = None  # None | "auto" | int devices
     dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
                                    # (ZeRO-style sharded params/opt state)
+    pipeline_parallel: int = 1     # >1: GPipe the transformer block stack
+                                   # over N devices (parallel/pipeline_model)
+    pp_microbatches: int = 0       # microbatches per pipelined step
+                                   # (0 = one per stage)
+    tensor_parallel: int = 1       # >1: Megatron-style TP over a 'model'
+                                   # mesh axis (parallel/model_parallel);
+                                   # composes with data_parallel as a
+                                   # (data x model) mesh
     remat: bool = False            # jax.checkpoint the forward (HBM saver)
     grad_accum: int = 1            # >1: N sequential microbatches per
                                    # optimizer step (~N-fold activation-
@@ -576,7 +584,11 @@ class Trainer:
         )
         self.eval_step = make_eval_step(loss_fn=loss_fn)
         self.mesh = None
-        if config.data_parallel:
+        if config.pipeline_parallel > 1:
+            self._setup_pipeline_parallel(loss_fn)
+        elif config.tensor_parallel > 1:
+            self._setup_tensor_parallel(loss_fn)
+        elif config.data_parallel:
             self._setup_data_parallel(loss_fn)
         self.results = ResultsLog(config.results_path or "results.csv")
         self.batch_meter = AverageMeter()
@@ -611,6 +623,129 @@ class Trainer:
                     raise
                 mk.pop(bad)
                 log.warning("model %r does not take %r; ignored", name, bad)
+
+    def _setup_pipeline_parallel(self, loss_fn) -> None:
+        """Switch the model's apply to the GPipe pipelined forward over a
+        'pipe' mesh (parallel/pipeline_model): transformer block params —
+        and their optimizer moments — are sharded stage-major, the
+        generic STE step body runs unchanged on top. The TPU-native
+        superset of the reference's 2-device layer placement
+        (mnist-distributed-BNNS2.py:32-46)."""
+        from jax.sharding import Mesh
+
+        from ..parallel import (  # local import: parallel depends on train
+            make_pipelined_apply,
+            pipeline_params,
+            place_pipelined_state,
+        )
+
+        cfg = self.config
+        pp = int(cfg.pipeline_parallel)
+        dp = cfg.data_parallel
+        if dp == "auto" or (isinstance(dp, int) and dp > 1):
+            raise ValueError(
+                "pipeline_parallel does not compose with data_parallel "
+                "yet; pick one"
+            )
+        if cfg.tensor_parallel > 1:
+            raise ValueError(
+                "pipeline_parallel does not compose with tensor_parallel "
+                "yet; pick one"
+            )
+        devices = jax.devices()
+        if len(devices) < pp:
+            raise ValueError(
+                f"pipeline_parallel={pp} needs {pp} devices, have "
+                f"{len(devices)}"
+            )
+        depth = getattr(self.model, "depth", None)
+        if depth is None:
+            raise ValueError(
+                f"model {cfg.model!r} has no block stack to pipeline "
+                "(transformer families only)"
+            )
+        mesh = Mesh(np.array(devices[:pp]), axis_names=("pipe",))
+        apply_fn = make_pipelined_apply(
+            self.model, mesh, depth, n_micro=cfg.pp_microbatches or pp,
+        )
+        new_params = pipeline_params(self.state.params)
+        tx = self.state.tx
+        state = TrainState(
+            step=self.state.step,
+            params=new_params,
+            batch_stats=self.state.batch_stats,
+            opt_state=tx.init(new_params),
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+        self.state = place_pipelined_state(state, mesh)
+        self.clamp_mask = latent_clamp_mask(new_params)
+        self.train_step = make_train_step(
+            self.clamp_mask, loss_fn=loss_fn, remat=cfg.remat,
+            grad_accum=cfg.grad_accum, augment=cfg.augment,
+        )
+        # self.mesh stays None: the DP/mesh eval paths key on a 'data'
+        # axis; the pipelined apply carries its own mesh in the shard_map
+        # (the generic eval_step from __init__ works unchanged on top).
+        self._pp_mesh = mesh
+        log.info("pipeline-parallel over %d stages (depth %d)", pp, depth)
+
+    def _setup_tensor_parallel(self, loss_fn) -> None:
+        """Megatron-style tensor parallelism over a (data x model) mesh:
+        params sharded by the model family's path-name rule table
+        (parallel/model_parallel.tp_rules_for), batch sharded over
+        'data', XLA inserting the row-parallel psums — the declarative
+        generalization of the reference's Net(dev0, dev1) layer split
+        (mnist-distributed-BNNS2.py:32-46,193-213), composed with DDP."""
+        from ..parallel import make_mesh  # local import (cycle)
+        from ..parallel.data_parallel import shard_batch
+        from ..parallel.model_parallel import make_tp_train_step, tp_rules_for
+
+        cfg = self.config
+        tp = int(cfg.tensor_parallel)
+        if cfg.dp_mode != "gspmd":
+            raise ValueError(
+                "tensor_parallel composes with dp_mode='gspmd' only"
+            )
+        dp = cfg.data_parallel
+        if dp == "auto":
+            dp_n = jax.device_count() // tp
+        else:
+            dp_n = int(dp) if dp else 1
+        if dp_n < 1:
+            raise ValueError(
+                f"tensor_parallel={tp} exceeds the {jax.device_count()} "
+                "available devices"
+            )
+        if cfg.batch_size % max(dp_n, 1):
+            raise ValueError(
+                f"batch_size {cfg.batch_size} not divisible by "
+                f"data_parallel={dp_n}"
+            )
+        self.mesh = make_mesh(data=dp_n, model=tp)
+        specs = tp_rules_for(cfg.model, self.state.params)
+        body = make_step_body(
+            self.clamp_mask, loss_fn=loss_fn, remat=cfg.remat,
+            grad_accum=cfg.grad_accum, augment=cfg.augment,
+        )
+        tp_step, self.state = make_tp_train_step(
+            body, self.mesh, self.state, specs
+        )
+        mesh = self.mesh
+        rng_global = _make_rng_replicator(mesh)
+
+        def step(state, images, labels, rng):
+            return tp_step(
+                state,
+                shard_batch(images, mesh),
+                shard_batch(labels, mesh),
+                rng_global(rng),
+            )
+
+        self.train_step = step
+        log.info(
+            "tensor-parallel over (data=%d x model=%d) devices", dp_n, tp
+        )
 
     def _setup_data_parallel(self, loss_fn) -> None:
         """Switch the train step to the GSPMD DP step over a 1-D mesh —
@@ -746,10 +881,14 @@ class Trainer:
         """scan_steps, gated to the paths the scan composes with (single
         device and GSPMD DP; FSDP/shard_map keep the per-step path)."""
         s = max(int(self.config.scan_steps), 1)
-        if s > 1 and self.mesh is not None and self.config.dp_mode != "gspmd":
+        if s > 1 and self.mesh is not None and (
+            self.config.dp_mode != "gspmd"
+            or self.config.tensor_parallel > 1
+        ):
             log.warning(
                 "scan_steps=%d is only supported single-device or with "
-                "dp_mode='gspmd'; falling back to per-step dispatch", s,
+                "dp_mode='gspmd' (no tensor parallelism); falling back "
+                "to per-step dispatch", s,
             )
             return 1
         return s
@@ -788,11 +927,15 @@ class Trainer:
         if not self.config.device_data:
             return False
         if jax.process_count() > 1 or (
-            self.mesh is not None and self.config.dp_mode != "gspmd"
+            self.mesh is not None and (
+                self.config.dp_mode != "gspmd"
+                or self.config.tensor_parallel > 1
+            )
         ):
             log.warning(
                 "device_data is only supported single-process with "
-                "dp_mode='gspmd'; falling back to the streaming path"
+                "dp_mode='gspmd' (no tensor parallelism); falling back "
+                "to the streaming path"
             )
             return False
         return True
@@ -1133,13 +1276,25 @@ class Trainer:
         }
 
     def try_resume(self) -> int:
-        """Restore the latest checkpoint if present; returns start epoch."""
+        """Restore the latest checkpoint if present; returns start epoch.
+
+        Checkpoints carry the run's parameter layout: a pipeline-parallel
+        run saves the {blocks, rest} stage-major layout (convert with
+        parallel.sequential_params for interchange with non-pp runs) and
+        is re-placed onto its 'pipe' mesh after restore."""
         if self._checkpointer is not None:
             self._checkpointer.wait()  # make any in-flight save visible
         ckpt = self.config.checkpoint_dir
         if not (ckpt and latest_exists(ckpt)):
             return 0
         self.state = load_checkpoint(self.state, ckpt)
+        if self.config.pipeline_parallel > 1:
+            # load_checkpoint restores host arrays; without this the
+            # resumed run would lose the per-stage placement of block
+            # params and optimizer moments.
+            from ..parallel import place_pipelined_state
+
+            self.state = place_pipelined_state(self.state, self._pp_mesh)
         meta = read_meta(ckpt)
         self.best_acc = float(meta.get("best_acc") or 0.0)
         start = int(meta.get("epoch", -1)) + 1
