@@ -14,9 +14,14 @@ Exactness: same math as softmax(QK^T)V with fp32 accumulation; the only
 difference from the naive oracle is reassociation of the exp/sum, the
 standard flash rescaling.
 
-Backward: custom_vjp that recomputes attention in fp32 and differentiates
-the oracle — O(L^2) memory in backward, fine at the sizes this framework
-trains; the forward kernel is the HBM-bound hot path.
+Backward: custom_vjp with a K-chunked fp32 recompute driven by the
+forward's saved (out, lse) — the flash-attention backward identity
+  ds = p * (do.v - rowsum(do*o) + g_lse),  p = exp(s - lse)
+evaluated one K block at a time under lax.scan, accumulating dq and
+emitting per-block dk/dv. Peak memory is O(Lq * block) per step, never
+the (Lq, Lk) score matrix — training memory stays linear in sequence
+length, matching the forward (the long-context requirement the
+flash+ring stack exists for).
 """
 
 from __future__ import annotations
@@ -253,19 +258,92 @@ def flash_attention_with_lse(
 
 
 def _fa_fwd(q, k, v, causal, interpret):
-    return flash_attention_with_lse(q, k, v, causal, interpret), (q, k, v)
+    out, lse = flash_attention_with_lse(q, k, v, causal, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+# K-block length of the chunked backward. Module-level so tests can force
+# multiple chunks at small L; 512 matches the forward kernel's block cap.
+_BWD_BLOCK_K = 512
 
 
 def _fa_bwd(causal, interpret, res, g):
-    q, k, v = res
-    f32 = jnp.float32
-    _, vjp = jax.vjp(
-        lambda q, k, v: _oracle_with_lse(q, k, v, causal),
-        q.astype(f32), k.astype(f32), v.astype(f32),
-    )
+    """Memory-bounded flash backward from the saved (out, lse).
+
+    With p_ij = exp(s_ij - lse_i) (softmax probabilities, never
+    materialized whole) and delta_i = sum_d do_id * o_id:
+
+        dv_j = sum_i p_ij do_i
+        ds_ij = p_ij * (do_i . v_j - delta_i + g_lse_i) * scale
+        dq_i  = sum_j ds_ij k_j          dk_j = sum_i ds_ij q_i
+
+    (g_lse enters because lse is a second differentiable output:
+    d lse_i / d s_ij = p_ij.) The j sums run one K block per lax.scan
+    step: per-step live tensors are (Lq, block) — linear-in-L training
+    memory, no (Lq, Lk) intermediate anywhere in the backward."""
+    del interpret
+    q, k, v, out, lse = res
     g_out, g_lse = g
-    gq, gk, gv = vjp((g_out.astype(f32), g_lse.astype(f32)))
-    return gq.astype(q.dtype), gk.astype(k.dtype), gv.astype(v.dtype)
+    f32 = jnp.float32
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = d**-0.5
+    hi = jax.lax.Precision.HIGHEST
+
+    def bhld(x):  # (B, L, H, D) -> (B, H, L, D) fp32
+        return x.transpose(0, 2, 1, 3).astype(f32)
+
+    qt, kt, vt = bhld(q), bhld(k), bhld(v)
+    do, o = bhld(g_out), bhld(out)
+    lse_t = lse.transpose(0, 2, 1).astype(f32)     # (B, H, Lq)
+    gl = g_lse.transpose(0, 2, 1).astype(f32)      # (B, H, Lq)
+    delta = jnp.sum(do * o, axis=-1)               # (B, H, Lq)
+    coeff = (gl - delta)[..., None]                # (B, H, Lq, 1)
+
+    bk = min(_BWD_BLOCK_K, lk)
+    lk_p = -(-lk // bk) * bk
+    if lk_p != lk:  # padded keys are masked off via their positions
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, lk_p - lk), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, lk_p - lk), (0, 0)))
+    n_blocks = lk_p // bk
+    # (B, H, n, bk, D) -> (n, B, H, bk, D): scan over the leading axis.
+    kc = kt.reshape(b, h, n_blocks, bk, d).transpose(2, 0, 1, 3, 4)
+    vc = vt.reshape(b, h, n_blocks, bk, d).transpose(2, 0, 1, 3, 4)
+    q_pos = jnp.arange(lq)[:, None]                # (Lq, 1)
+
+    def block(carry, xs):
+        dq_acc, blk = carry
+        k_blk, v_blk = xs                          # (B, H, bk, D)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, k_blk, precision=hi) * scale
+        k_pos = blk * bk + jnp.arange(bk)[None, :]  # (1, bk)
+        invalid = k_pos >= lk
+        if causal:
+            invalid = invalid | (k_pos > q_pos + (lk - lq))
+        # Masked (or padding) keys contribute p=0; rows with no valid key
+        # have lse=NEG_INF, which must not turn into exp(+inf).
+        log_p = s - lse_t[..., None]
+        p = jnp.where(
+            invalid | (lse_t[..., None] < NEG_INF / 2), 0.0, jnp.exp(log_p)
+        )
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do, precision=hi)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_blk, precision=hi)
+        ds = p * (dp + coeff) * scale
+        dq_acc = dq_acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, k_blk, precision=hi
+        )
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qt, precision=hi)
+        return (dq_acc, blk + 1), (dk_blk, dv_blk)
+
+    (dq, _), (dk_blocks, dv_blocks) = jax.lax.scan(
+        block, (jnp.zeros_like(qt), jnp.int32(0)), (kc, vc)
+    )
+    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, lk_p, d)[:, :, :lk]
+    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, lk_p, d)[:, :, :lk]
+
+    def blhd(x, dtype):  # back to (B, L, H, D)
+        return x.transpose(0, 2, 1, 3).astype(dtype)
+
+    return blhd(dq, q.dtype), blhd(dk, k.dtype), blhd(dv, v.dtype)
 
 
 flash_attention_with_lse.defvjp(_fa_fwd, _fa_bwd)

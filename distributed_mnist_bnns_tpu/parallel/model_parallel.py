@@ -59,12 +59,23 @@ def tp_rules_by_path(
         if role not in _ROLES:
             raise ValueError(f"unknown TP role {role!r} (have {_ROLES})")
 
+    def path_match(mod_path: str, pattern: str) -> bool:
+        # Segment-wise: '*' must not cross '/' (a bare fnmatch would let
+        # 'TransformerBlock_*/BinarizedDense_0' swallow a NEWLY NESTED
+        # '.../RotaryAttention_0/BinarizedDense_0', silently sharding a
+        # module the table never named — the failure mode strict mode
+        # exists to prevent).
+        segs, pats = mod_path.split("/"), pattern.split("/")
+        return len(segs) == len(pats) and all(
+            fnmatch.fnmatch(s, p) for s, p in zip(segs, pats)
+        )
+
     def spec_for(path, leaf) -> P:
         keys = [getattr(p, "key", "") for p in path if hasattr(p, "key")]
         mod_path = "/".join(keys[:-1])
         kind = keys[-1] if keys else ""
         role = next(
-            (r for pat, r in table.items() if fnmatch.fnmatch(mod_path, pat)),
+            (r for pat, r in table.items() if path_match(mod_path, pat)),
             None,
         )
         if role is None:

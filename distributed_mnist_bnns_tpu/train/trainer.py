@@ -698,8 +698,6 @@ class Trainer:
         generalization of the reference's Net(dev0, dev1) layer split
         (mnist-distributed-BNNS2.py:32-46,193-213), composed with DDP."""
         from ..parallel import make_mesh  # local import (cycle)
-        from ..parallel.data_parallel import shard_batch
-        from ..parallel.model_parallel import make_tp_train_step, tp_rules_for
 
         cfg = self.config
         tp = int(cfg.tensor_parallel)
@@ -723,6 +721,19 @@ class Trainer:
                 f"data_parallel={dp_n}"
             )
         self.mesh = make_mesh(data=dp_n, model=tp)
+        self._set_tp_step(loss_fn)
+        log.info(
+            "tensor-parallel over (data=%d x model=%d) devices", dp_n, tp
+        )
+
+    def _set_tp_step(self, loss_fn) -> None:
+        """(Re)build the TP train step over the existing (data x model)
+        mesh — also the regime-rebuild path, so an optimizer switch keeps
+        the model-axis sharding instead of silently falling back to DP."""
+        from ..parallel.data_parallel import shard_batch
+        from ..parallel.model_parallel import make_tp_train_step, tp_rules_for
+
+        cfg = self.config
         specs = tp_rules_for(cfg.model, self.state.params)
         body = make_step_body(
             self.clamp_mask, loss_fn=loss_fn, remat=cfg.remat,
@@ -743,9 +754,6 @@ class Trainer:
             )
 
         self.train_step = step
-        log.info(
-            "tensor-parallel over (data=%d x model=%d) devices", dp_n, tp
-        )
 
     def _setup_data_parallel(self, loss_fn) -> None:
         """Switch the train step to the GSPMD DP step over a 1-D mesh —
@@ -1067,6 +1075,8 @@ class Trainer:
             if self.mesh is not None:
                 if self.config.dp_mode == "fsdp":
                     self._set_fsdp_step(self._loss_fn)
+                elif self.config.tensor_parallel > 1:
+                    self._set_tp_step(self._loss_fn)
                 else:
                     self._set_dp_step(self._loss_fn)
             else:

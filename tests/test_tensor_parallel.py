@@ -41,6 +41,22 @@ class TestPathRules:
         )
         assert specs["SurpriseLayer_0"]["kernel"] == P()
 
+    def test_glob_star_does_not_cross_slash(self):
+        """A newly NESTED module whose leaf name collides with a table
+        pattern must still fail loudly — '*' matches within one path
+        segment only."""
+        params = {
+            "TransformerBlock_0": {
+                "RotaryAttention_0": {
+                    "BinarizedDense_0": {"kernel": jnp.zeros((4, 4))}
+                }
+            }
+        }
+        with pytest.raises(KeyError, match="RotaryAttention_0"):
+            tp_rules_by_path(
+                params, {"TransformerBlock_*/BinarizedDense_0": "col"}
+            )
+
     def test_unknown_role_rejected(self):
         with pytest.raises(ValueError, match="role"):
             tp_rules_by_path({}, {"X": "diagonal"})
@@ -177,6 +193,29 @@ class TestTrainerTP:
         )
         history = trainer.fit(self._data(32))
         assert np.isfinite(history[0]["train_loss"])
+
+    def test_regime_optimizer_switch_keeps_tp_sharding(self):
+        """An epoch-regime optimizer switch must rebuild the TP step, not
+        fall back to the pure-DP step (which would silently replicate the
+        model-axis-sharded params/opt state)."""
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        if jax.device_count() < 2:
+            pytest.skip("needs 2 virtual devices")
+        trainer = Trainer(
+            TrainConfig(
+                model="bnn-mlp-small", epochs=2, batch_size=16,
+                optimizer="adam", learning_rate=0.003, backend="xla",
+                seed=0, tensor_parallel=2,
+                regime={0: {"optimizer": "adam"},
+                        1: {"optimizer": "sgd", "learning_rate": 0.05}},
+            )
+        )
+        history = trainer.fit(self._data(32))
+        assert len(history) == 2
+        assert np.isfinite(history[1]["train_loss"])
+        k0 = trainer.state.params["BinarizedDense_0"]["kernel"]
+        assert k0.sharding.spec == P(None, "model")  # survived the switch
 
     def test_cli_tp_flag(self, tmp_path, monkeypatch):
         from distributed_mnist_bnns_tpu.cli import main
