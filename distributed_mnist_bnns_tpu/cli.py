@@ -138,8 +138,9 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--best", action="store_true")
     x = sub.add_parser(
         "export",
-        help="freeze a trained BNN MLP checkpoint into the packed 1-bit "
-             "serving artifact (infer.load_packed)",
+        help="freeze a trained BNN checkpoint (bnn-mlp, bnn-cnn or "
+             "xnor-resnet18) into the packed 1-bit serving artifact "
+             "(infer.load_packed)",
     )
     common(x)
     x.add_argument("--best", action="store_true")
@@ -305,6 +306,7 @@ def main(argv=None) -> int:
                 "batch_stats": trainer.state.batch_stats,
             },
             args.out,
+            input_shape=data.input_shape,
         )
         log.info("exported packed model to %s: %s", args.out, info)
         print({"out": args.out, **info})

@@ -86,6 +86,7 @@ def _freeze_tensors(model: BnnMLP, variables: Dict) -> Dict[str, Any]:
             "wp": wp, "k": k, "n": n, "bias": params[name]["bias"],
         })
     frozen = {
+        "family": "bnn-mlp",
         "w1": binarize_ste(params["BinarizedDense_0"]["kernel"]),
         "b1": params["BinarizedDense_0"]["bias"],
         "bn0": {"params": dict(params["BatchNorm_0"]),
@@ -106,6 +107,7 @@ def _freeze_tensors(model: BnnMLP, variables: Dict) -> Dict[str, Any]:
         int(l["wp"].size) * 4 for l in layers
     )
     frozen["info"] = {
+        "family": "bnn-mlp",
         "latent_fp32_weight_bytes": latent_bytes,
         "frozen_weight_bytes": packed_bytes,
         "compression": round(latent_bytes / packed_bytes, 2),
@@ -163,20 +165,63 @@ def freeze_bnn_mlp(
     return _build_apply(frozen, interpret), frozen["info"]
 
 
-def export_packed(model: BnnMLP, variables: Dict, path: str) -> Dict[str, Any]:
+def _freeze_any(model, variables, input_shape=None) -> Dict[str, Any]:
+    """Family dispatch: frozen-tensor dict for every freezable model."""
+    from .infer_conv import _freeze_cnn_tensors, _freeze_resnet_tensors
+    from .models.bnn_cnn import BinarizedCNN
+    from .models.resnet import XnorResNet
+
+    if isinstance(model, BnnMLP):
+        return _freeze_tensors(model, variables)
+    if isinstance(model, BinarizedCNN):
+        return _freeze_cnn_tensors(
+            model, variables, input_shape or (28, 28, 1)
+        )
+    if isinstance(model, XnorResNet):
+        return _freeze_resnet_tensors(
+            model, variables, input_shape or (32, 32, 3)
+        )
+    raise ValueError(
+        f"no packed freeze for {type(model).__name__} (freezable: BnnMLP, "
+        "BinarizedCNN, basic-block XnorResNet)"
+    )
+
+
+def _build_any(frozen: Dict[str, Any], interpret: bool) -> Callable:
+    family = frozen.get("family", "bnn-mlp")
+    if family == "bnn-mlp":
+        return _build_apply(frozen, interpret)
+    from .infer_conv import _build_cnn_apply, _build_resnet_apply
+
+    if family == "bnn-cnn":
+        return _build_cnn_apply(frozen, interpret)
+    if family == "xnor-resnet":
+        return _build_resnet_apply(frozen, interpret)
+    raise ValueError(f"unknown packed-artifact family {family!r}")
+
+
+def export_packed(
+    model, variables: Dict, path: str, *, input_shape=None
+) -> Dict[str, Any]:
     """Write the frozen packed artifact to ``path`` (msgpack). The file
     holds the 1-bit hidden weights, ±1 first layer, raw BN moments and the
     fp32 head — everything ``load_packed`` needs, nothing else (no latent
-    masters, no optimizer state). Returns the size-info dict."""
+    masters, no optimizer state). Covers the MLP, CNN and basic-block
+    XNOR-ResNet families (a ``family`` key dispatches at load); conv
+    artifacts additionally carry their freeze-time input resolution and
+    padding corrections. Returns the size-info dict."""
     from flax import serialization
 
-    frozen = _freeze_tensors(model, variables)
+    frozen = _freeze_any(model, variables, input_shape)
     frozen = jax.tree.map(
         lambda x: np.asarray(x) if hasattr(x, "shape") else x, frozen
     )
-    # On disk the ±1 first layer goes as int8 (4x smaller artifact); the
-    # runtime still dots it in fp32 (load_packed casts back).
-    frozen["w1"] = frozen["w1"].astype(np.int8)
+    if "w1" in frozen:
+        # On disk the ±1 first layer goes as int8 (4x smaller artifact);
+        # the runtime still dots it in fp32 (load_packed casts back).
+        frozen["w1"] = frozen["w1"].astype(np.int8)
+    if "conv1_w" in frozen:
+        frozen["conv1_w"] = frozen["conv1_w"].astype(np.int8)
     with open(path, "wb") as f:
         f.write(serialization.msgpack_serialize(frozen))
     return frozen["info"]
@@ -190,4 +235,4 @@ def load_packed(
 
     with open(path, "rb") as f:
         frozen = serialization.msgpack_restore(f.read())
-    return _build_apply(frozen, interpret), dict(frozen["info"])
+    return _build_any(frozen, interpret), dict(frozen["info"])

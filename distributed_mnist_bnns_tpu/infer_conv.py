@@ -1,0 +1,386 @@
+"""Packed-bitplane serving for the binarized conv families (bnn-cnn and
+xnor-resnet18) — the conv extension of infer.py's MLP freeze.
+
+Same deployment story (infer.py module doc): after training, the fp32
+latent masters are dead weight; hidden conv kernels pack to 1 bit per
+parameter and every hidden GEMM runs on the bitplane XNOR kernel. The
+conv-specific pieces:
+
+  * **im2col packed GEMM** — a frozen BinarizedConv becomes patch
+    extraction (``conv_general_dilated_patches``, the same lowering the
+    training path uses, models/layers.py:236-244) followed by
+    ``xnor_matmul_packed`` on the pre-packed (kh*kw*cin, F) bitplane
+    matrix.
+  * **SAME-padding correction** — zero border taps enter the ±1 GEMM as
+    -1; the batch-independent correction (ops.conv_padding_correction,
+    the same helper the training layer uses) is rebuilt at load from the
+    shipped (kh, kw, F) per-tap channel sums for the declared input
+    resolution — the runtime never needs the unpacked kernel, and the
+    artifact stays dominated by the 1-bit weights.
+  * **BN -> threshold after convs** — wherever the next consumer
+    sign()-binarizes, ``binarize(hardtanh?(BN(y)))`` folds to the
+    per-channel threshold compare of infer._bn_sign_fn; max-pooling
+    commutes with the fold (sign and hardtanh are monotone, so
+    ``sign(pool(hardtanh(bn(y)))) == pool(sign_thresh(y))``), so pooled
+    hidden activations are ±1 bits end to end.
+  * **fp32 first/last layers** — the stem conv, residual-shortcut 1x1
+    convs, the final BN/relu (resnet) or BN/hardtanh (cnn) block and the
+    classifier head stay full precision, exactly like the live model.
+
+Frozen conv artifacts are resolution-specific (the padding corrections
+bake in Ho x Wo); the apply fn checks and reports a shape mismatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .infer import _bn_affine_fn, _bn_sign_fn
+from .models.bnn_cnn import BinarizedCNN
+from .models.resnet import XnorResNet
+from .ops.binarize import binarize_ste
+from .ops.xnor_gemm import (
+    conv_padding_correction,
+    conv_patch_weight,
+    prepack_weights,
+    xnor_matmul_packed,
+)
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _out_hw(hw, strides):
+    """SAME-padding output resolution."""
+    return tuple(-(-d // s) for d, s in zip(hw, strides))
+
+
+def _freeze_conv(
+    kernel_latent: jnp.ndarray,
+    bias: jnp.ndarray,
+    in_hw: Tuple[int, int],
+    strides: Tuple[int, int],
+) -> Dict[str, Any]:
+    """Freeze one hidden BinarizedConv: packed bitplanes (canonical
+    im2col ordering, ops.conv_patch_weight — the same helper the training
+    layer uses) plus the (kh, kw, F) per-tap channel sums from which the
+    dense SAME-padding correction is rebuilt at load
+    (ops.conv_padding_correction) — shipping the sums instead of the
+    (Ho, Wo, F) map keeps the artifact dominated by the 1-bit weights."""
+    kh, kw, in_ch, features = kernel_latent.shape
+    wb = binarize_ste(kernel_latent)
+    wp, k, n = prepack_weights(conv_patch_weight(wb))
+    return {
+        "wp": wp, "k": int(k), "n": int(n), "bias": bias,
+        "kh": kh, "kw": kw, "strides": list(strides),
+        "in_hw": list(in_hw),
+        "tap_sums": jnp.sum(wb, axis=2),  # (kh, kw, F)
+    }
+
+
+def _packed_conv_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
+    wp = jnp.asarray(layer["wp"])
+    bias = jnp.asarray(layer["bias"])
+    k, n = int(layer["k"]), int(layer["n"])
+    kh, kw = int(layer["kh"]), int(layer["kw"])
+    strides = tuple(int(s) for s in layer["strides"])
+    in_hw = tuple(int(d) for d in layer["in_hw"])
+    corr = conv_padding_correction(
+        jnp.asarray(layer["tap_sums"], jnp.float32), in_hw, strides, "SAME"
+    )
+
+    def fn(bits: jnp.ndarray) -> jnp.ndarray:
+        if tuple(bits.shape[1:3]) != in_hw:
+            raise ValueError(
+                f"frozen conv was packed for {in_hw} inputs, got "
+                f"{tuple(bits.shape[1:3])} (the padding correction is "
+                "resolution-specific; re-freeze for this input size)"
+            )
+        patches = jax.lax.conv_general_dilated_patches(
+            bits, filter_shape=(kh, kw), window_strides=strides,
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        nb, ho, wo, _ = patches.shape
+        y = xnor_matmul_packed(
+            patches.reshape(-1, k), wp, k, n, interpret=interpret
+        ).reshape(nb, ho, wo, n)
+        return y + corr + bias
+
+    return fn
+
+
+def _fp32_conv_fn(kernel, bias, strides=(1, 1)):
+    w = jnp.asarray(kernel, jnp.float32)
+    b = jnp.asarray(bias, jnp.float32) if bias is not None else None
+
+    def fn(x):
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32, precision=_HI,
+        )
+        return y if b is None else y + b
+
+    return fn
+
+
+def _maxpool_bits(x):
+    """2x2/2 max-pool of ±1 maps (any +1 in the window wins)."""
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def _bn_pack(params, stats):
+    return {"params": dict(params), "stats": dict(stats)}
+
+
+# ---------------------------------------------------------------------------
+# bnn-cnn
+
+
+def _freeze_cnn_tensors(
+    model: BinarizedCNN, variables: Dict, input_shape
+) -> Dict[str, Any]:
+    if model.stochastic:
+        raise ValueError(
+            "stochastic binarization is train-time; freeze the "
+            "deterministic eval path"
+        )
+    if getattr(model, "scale", False):
+        raise ValueError(
+            "XNOR-Net alpha scaling (scale=True) is not folded by the "
+            "packed freeze; freeze an unscaled model"
+        )
+    params, stats = variables["params"], variables["batch_stats"]
+    h, w, c = input_shape
+    hw1 = _out_hw((h, w), (1, 1))          # conv1 SAME/1
+    hw_pool1 = (hw1[0] // 2, hw1[1] // 2)  # 2x2 pool
+    frozen = {
+        "family": "bnn-cnn",
+        "arch": {"input_shape": list(input_shape)},
+        # fp32 first layer: raw pixels x ±1 kernel as a real conv
+        "conv1_w": binarize_ste(params["BinarizedConv_0"]["kernel"]),
+        "conv1_b": params["BinarizedConv_0"]["bias"],
+        "bn0": _bn_pack(params["BatchNorm_0"], stats["BatchNorm_0"]),
+        "conv2": _freeze_conv(
+            params["BinarizedConv_1"]["kernel"],
+            params["BinarizedConv_1"]["bias"], hw_pool1, (1, 1),
+        ),
+        "bn1": _bn_pack(params["BatchNorm_1"], stats["BatchNorm_1"]),
+        "bn2": _bn_pack(params["BatchNorm_2"], stats["BatchNorm_2"]),
+        "head_w": params["Dense_0"]["kernel"],
+        "head_b": params["Dense_0"]["bias"],
+    }
+    dense_w = binarize_ste(params["BinarizedDense_0"]["kernel"])
+    wp, k, n = prepack_weights(dense_w)
+    frozen["dense"] = {
+        "wp": wp, "k": int(k), "n": int(n),
+        "bias": params["BinarizedDense_0"]["bias"],
+    }
+    latent = sum(
+        int(params[m]["kernel"].size) * 4
+        for m in ("BinarizedConv_0", "BinarizedConv_1", "BinarizedDense_0")
+    )
+    packed = (
+        int(frozen["conv1_w"].size) * 4
+        + int(frozen["conv2"]["wp"].size) * 4
+        + int(wp.size) * 4
+    )
+    frozen["info"] = {
+        "family": "bnn-cnn",
+        "latent_fp32_weight_bytes": latent,
+        "frozen_weight_bytes": packed,
+        "compression": round(latent / packed, 2),
+        "packed_layers": ["BinarizedConv_1", "BinarizedDense_0"],
+    }
+    return frozen
+
+
+def _build_cnn_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
+    ishape = tuple(int(d) for d in frozen["arch"]["input_shape"])
+    conv1 = _fp32_conv_fn(
+        jnp.asarray(frozen["conv1_w"], jnp.float32), frozen["conv1_b"]
+    )
+    sign0 = _bn_sign_fn(frozen["bn0"]["params"], frozen["bn0"]["stats"])
+    conv2 = _packed_conv_fn(frozen["conv2"], interpret)
+    sign1 = _bn_sign_fn(frozen["bn1"]["params"], frozen["bn1"]["stats"])
+    d = frozen["dense"]
+    dwp, dk, dn = jnp.asarray(d["wp"]), int(d["k"]), int(d["n"])
+    db = jnp.asarray(d["bias"])
+    affine2 = _bn_affine_fn(frozen["bn2"]["params"], frozen["bn2"]["stats"])
+    wh, bh = jnp.asarray(frozen["head_w"]), jnp.asarray(frozen["head_b"])
+
+    def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
+        x = images.astype(jnp.float32)
+        if x.ndim == 2:
+            x = x.reshape(x.shape[0], *ishape)
+        elif tuple(x.shape[1:]) != ishape:
+            raise ValueError(
+                f"frozen cnn expects {ishape} inputs, got "
+                f"{tuple(x.shape[1:])} (the packed convs bake in this "
+                "resolution; re-freeze for a different input size)"
+            )
+        y = conv1(x)
+        bits = _maxpool_bits(sign0(y))
+        y = conv2(bits)
+        bits = _maxpool_bits(sign1(y))
+        bits = bits.reshape(bits.shape[0], -1)
+        y = xnor_matmul_packed(bits, dwp, dk, dn, interpret=interpret) + db
+        h = jnp.clip(affine2(y), -1.0, 1.0)
+        logits = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
+        return jax.nn.log_softmax(logits)
+
+    return jax.jit(apply_fn)
+
+
+# ---------------------------------------------------------------------------
+# xnor-resnet (basic blocks)
+
+
+def _freeze_resnet_tensors(
+    model: XnorResNet, variables: Dict, input_shape
+) -> Dict[str, Any]:
+    if model.bottleneck:
+        raise ValueError(
+            "freeze supports the basic-block XNOR-ResNets (resnet18); "
+            "bottleneck freezing is not implemented"
+        )
+    if not model.cifar_stem:
+        raise ValueError("freeze supports the CIFAR-stem XNOR-ResNets")
+    if model.scale:
+        raise ValueError(
+            "XNOR-Net alpha scaling (scale=True) rescales each conv's "
+            "output by mean|W_latent| before bias — the packed freeze "
+            "does not fold it and would serve wrong logits silently; "
+            "freeze an unscaled model"
+        )
+    params, stats = variables["params"], variables["batch_stats"]
+    h, w, _ = input_shape
+    hw = (h, w)
+    blocks = []
+    latent = 0
+    packed_bytes = 0
+    bi = 0
+    for stage, n_blocks in enumerate(model.stage_sizes):
+        for b in range(n_blocks):
+            strides = 2 if stage > 0 and b == 0 else 1
+            name = f"XnorBasicBlock_{bi}"
+            bp, bs = params[name], stats[name]
+            out_hw = _out_hw(hw, (strides, strides))
+            blk = {
+                "bn0": _bn_pack(bp["BatchNorm_0"], bs["BatchNorm_0"]),
+                "conv1": _freeze_conv(
+                    bp["BinarizedConv_0"]["kernel"],
+                    bp["BinarizedConv_0"]["bias"], hw, (strides, strides),
+                ),
+                "bn1": _bn_pack(bp["BatchNorm_1"], bs["BatchNorm_1"]),
+                "conv2": _freeze_conv(
+                    bp["BinarizedConv_1"]["kernel"],
+                    bp["BinarizedConv_1"]["bias"], out_hw, (1, 1),
+                ),
+                "strides": strides,
+            }
+            if "Conv_0" in bp:  # fp32 projection shortcut
+                blk["shortcut_w"] = bp["Conv_0"]["kernel"]
+            for m in ("BinarizedConv_0", "BinarizedConv_1"):
+                latent += int(bp[m]["kernel"].size) * 4
+            packed_bytes += (
+                int(blk["conv1"]["wp"].size) + int(blk["conv2"]["wp"].size)
+            ) * 4
+            blocks.append(blk)
+            hw = out_hw
+            bi += 1
+    frozen = {
+        "family": "xnor-resnet",
+        "arch": {
+            "input_shape": list(input_shape),
+            "stage_sizes": list(model.stage_sizes),
+        },
+        "stem_w": params["Conv_0"]["kernel"],  # fp32 stem
+        "blocks": blocks,
+        "bn_final": _bn_pack(params["BatchNorm_0"], stats["BatchNorm_0"]),
+        "head_w": params["Dense_0"]["kernel"],
+        "head_b": params["Dense_0"]["bias"],
+    }
+    frozen["info"] = {
+        "family": "xnor-resnet",
+        "latent_fp32_weight_bytes": latent,
+        "frozen_weight_bytes": packed_bytes,
+        "compression": round(latent / max(packed_bytes, 1), 2),
+        "packed_layers": [
+            f"XnorBasicBlock_{i}/BinarizedConv_{j}"
+            for i in range(bi) for j in (0, 1)
+        ],
+    }
+    return frozen
+
+
+def _build_resnet_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
+    ishape = tuple(int(d) for d in frozen["arch"]["input_shape"])
+    stem = _fp32_conv_fn(frozen["stem_w"], None)
+    blocks = []
+    for blk in frozen["blocks"]:
+        strides = int(blk["strides"])
+        blocks.append({
+            "sign0": _bn_sign_fn(blk["bn0"]["params"], blk["bn0"]["stats"]),
+            "conv1": _packed_conv_fn(blk["conv1"], interpret),
+            "sign1": _bn_sign_fn(blk["bn1"]["params"], blk["bn1"]["stats"]),
+            "conv2": _packed_conv_fn(blk["conv2"], interpret),
+            "shortcut": (
+                _fp32_conv_fn(
+                    blk["shortcut_w"], None, (strides, strides)
+                )
+                if "shortcut_w" in blk else None
+            ),
+        })
+    affine_final = _bn_affine_fn(
+        frozen["bn_final"]["params"], frozen["bn_final"]["stats"]
+    )
+    wh, bh = jnp.asarray(frozen["head_w"]), jnp.asarray(frozen["head_b"])
+
+    def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
+        x = images.astype(jnp.float32)
+        if tuple(x.shape[1:]) != ishape:
+            raise ValueError(
+                f"frozen resnet expects {ishape} inputs, got "
+                f"{tuple(x.shape[1:])}"
+            )
+        x = stem(x)
+        for blk in blocks:
+            y = blk["conv1"](blk["sign0"](x))
+            y = blk["conv2"](blk["sign1"](y))
+            shortcut = x if blk["shortcut"] is None else blk["shortcut"](x)
+            x = y + shortcut
+        x = jax.nn.relu(affine_final(x)).mean(axis=(1, 2))
+        logits = jnp.dot(x, wh, preferred_element_type=jnp.float32) + bh
+        return logits
+
+    return jax.jit(apply_fn)
+
+
+# ---------------------------------------------------------------------------
+# public API (family dispatch lives in infer.py)
+
+
+def freeze_bnn_cnn(
+    model: BinarizedCNN, variables: Dict, *,
+    input_shape=(28, 28, 1), interpret: bool = False,
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained BinarizedCNN into packed inference; matches
+    ``model.apply(variables, x, train=False)`` up to threshold ties."""
+    frozen = _freeze_cnn_tensors(model, variables, input_shape)
+    return _build_cnn_apply(frozen, interpret), frozen["info"]
+
+
+def freeze_xnor_resnet(
+    model: XnorResNet, variables: Dict, *,
+    input_shape=(32, 32, 3), interpret: bool = False,
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained basic-block XnorResNet (resnet18 config) into
+    packed inference. Output is raw logits, matching the live model."""
+    frozen = _freeze_resnet_tensors(model, variables, input_shape)
+    return _build_resnet_apply(frozen, interpret), frozen["info"]

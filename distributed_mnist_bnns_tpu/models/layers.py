@@ -37,6 +37,8 @@ from ..ops.xnor_gemm import (
     Backend,
     binary_conv2d,
     binary_matmul,
+    conv_padding_correction,
+    conv_patch_weight,
     get_default_backend,
 )
 
@@ -239,9 +241,9 @@ class BinarizedConv(nn.Module):
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
             )  # (N, Ho, Wo, kh*kw*in_ch) — but channel-major patch order
             n, ho, wo, k = patches.shape
-            # conv_general_dilated_patches emits features as (in_ch, kh, kw)
-            # flattened; reorder the kernel to match.
-            wmat = jnp.transpose(wb, (2, 0, 1, 3)).reshape(kh * kw * in_ch, self.features)
+            # Canonical im2col weight ordering — shared with the frozen
+            # serving path (ops.conv_patch_weight).
+            wmat = conv_patch_weight(wb)
             y = binary_matmul(patches.reshape(-1, k), wmat, backend)
             y = y.reshape(n, ho, wo, self.features)
             pads_zeros = (
@@ -252,25 +254,17 @@ class BinarizedConv(nn.Module):
             if pads_zeros:
                 # Zero-padded border taps enter the bitplane GEMM as -1
                 # (pack_bits maps x > 0 to bit 1) instead of contributing
-                # nothing; add back the weights they spuriously subtracted.
-                # The correction is batch-independent — one ones-image conv:
-                #   sum_{padded taps} w = sum_all w - conv(ones, w).
-                # stop_gradient: binary_matmul's VJP differentiates the
-                # exact {-1, 0, +1} patches, so the gradient is already
-                # correct without the correction term.
-                ones = jnp.ones((1, *x.shape[1:]), jnp.float32)
-                valid_sum = jax.lax.conv_general_dilated(
-                    ones,
-                    wb.astype(jnp.float32),
-                    window_strides=tuple(self.strides),
-                    padding=self.padding
-                    if isinstance(self.padding, str)
-                    else tuple(tuple(p) for p in self.padding),
-                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                    preferred_element_type=jnp.float32,
-                )  # (1, Ho, Wo, features): sum of w over in-bounds taps
-                total = jnp.sum(wb, axis=(0, 1, 2))  # (features,)
-                y = y + jax.lax.stop_gradient(total[None, None, None, :] - valid_sum)
+                # nothing; add back the weights they spuriously subtracted
+                # (ops.conv_padding_correction — shared with the frozen
+                # serving path). stop_gradient: binary_matmul's VJP
+                # differentiates the exact {-1, 0, +1} patches, so the
+                # gradient is already correct without the correction term.
+                y = y + jax.lax.stop_gradient(
+                    conv_padding_correction(
+                        jnp.sum(wb, axis=2), x.shape[1:3],
+                        tuple(self.strides), self.padding,
+                    )
+                )
         else:
             dtype = {"bf16": jnp.bfloat16, "int8": jnp.int8}.get(
                 backend, x.dtype

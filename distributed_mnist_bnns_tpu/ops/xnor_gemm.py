@@ -343,3 +343,46 @@ def _bconv_bwd(strides, padding, dtype, res, g):
 
 
 binary_conv2d.defvjp(_bconv_fwd, _bconv_bwd)
+
+
+def conv_patch_weight(wb: jnp.ndarray) -> jnp.ndarray:
+    """(kh, kw, cin, F) conv kernel -> the (kh*kw*cin, F) GEMM matrix in
+    ``jax.lax.conv_general_dilated_patches`` feature order (channel-major:
+    patches flatten as (cin, kh, kw)).
+
+    THE canonical ordering for the im2col binarized-conv path — shared by
+    the training layer (models/layers.py BinarizedConv) and the frozen
+    serving path (infer_conv.py), so the two cannot drift."""
+    kh, kw, cin, f = wb.shape
+    return jnp.transpose(wb, (2, 0, 1, 3)).reshape(kh * kw * cin, f)
+
+
+def conv_padding_correction(
+    tap_sums: jnp.ndarray,
+    in_hw: tuple,
+    strides: tuple,
+    padding="SAME",
+) -> jnp.ndarray:
+    """Zero-padding correction for an im2col ±1 conv GEMM.
+
+    Padded border taps enter the bitplane GEMM as -1 (pack_bits maps
+    x > 0 to bit 1) instead of contributing nothing; the spurious
+    subtraction per output position is ``sum_all(w) - sum_in_bounds(w)``.
+    Only the per-tap channel sums matter, so ``tap_sums`` is the kernel
+    summed over its input channels, shape (kh, kw, F) — which is also all
+    a frozen artifact needs to ship (the dense (Ho, Wo, F) map rebuilds
+    here, ~cin*Ho*Wo/(kh*kw) times smaller on disk). Returns
+    (1, Ho, Wo, F); exactly zero in the interior. Shared by BinarizedConv
+    and the frozen conv serving path."""
+    ones = jnp.ones((1, *in_hw, 1), jnp.float32)
+    valid = jax.lax.conv_general_dilated(
+        ones,
+        tap_sums[:, :, None, :].astype(jnp.float32),
+        window_strides=tuple(strides),
+        padding=padding if isinstance(padding, str)
+        else tuple(tuple(p) for p in padding),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )  # (1, Ho, Wo, F): sum of w over in-bounds taps
+    total = jnp.sum(tap_sums, axis=(0, 1))
+    return total[None, None, None, :] - valid
