@@ -1,0 +1,133 @@
+"""Binarized Mixture-of-Experts MLP — the trainable MoE model family.
+
+No reference counterpart (the reference's models are dense MLPs/CNNs,
+SURVEY §2.2); this family makes the expert-parallel op stack
+(parallel/expert_parallel.py) trainable end to end through the generic
+Trainer: a flagship-style binarized MLP whose middle layer is a top-2
+routed bank of ``binarized_expert`` FFNs (sign(x) @ sign(W_e) + b_e)
+with the Switch-Transformer load-balancing auxiliary loss.
+
+Wiring conventions:
+  * the router is a plain fp32 Dense named ``router`` OUTSIDE any
+    ``Binarized*`` module path — latent_clamp_mask matches the
+    "Binarized" prefix, and router weights are ordinary fp32 params
+    that must not be clamped to [-1, 1];
+  * expert latents live under ``BinarizedExperts_0`` so the clamp mask
+    and the latent-master STE semantics apply to them exactly as to
+    BinarizedDense kernels;
+  * the auxiliary loss is sown into the ``intermediates`` collection
+    under the name ``aux_loss`` (already scaled by ``aux_coef``); the
+    train step body collects every such sow into the total loss
+    (train/trainer.py make_step_body), so any model can contribute
+    auxiliary objectives the same way;
+  * routing uses the same ``topk_dispatch`` the expert-parallel path
+    uses, with per-batch capacity ``ceil(capacity_factor * T * k / E)``
+    — the dense einsum formulation here is numerically the n_shards=1
+    oracle of ``moe_reference``, so the sharded deployment is covered by
+    the EP-vs-dense equality tests.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.binarize import binarize_ste
+from ..ops.routing import load_balance_loss, topk_dispatch
+from ..ops.xnor_gemm import Backend, binary_matmul
+from .layers import BinarizedDense
+
+
+class BinarizedExperts(nn.Module):
+    """A routed bank of binarized FFN experts.
+
+    Applies (dispatch, combine) routing tensors produced by the caller:
+    params are the stacked per-expert latents (E, D, Do) — the layout the
+    'expert' mesh axis shards in the EP deployment."""
+
+    num_experts: int
+    features: int
+
+    @nn.compact
+    def __call__(self, x, dispatch, combine):
+        d = x.shape[-1]
+        scale = d**-0.5
+        w = self.param(
+            "w",
+            lambda key, shape: jax.random.uniform(
+                key, shape, minval=-scale, maxval=scale
+            ),
+            (self.num_experts, d, self.features),
+        )
+        b = self.param(
+            "b", nn.initializers.zeros_init(),
+            (self.num_experts, self.features),
+        )
+        ex_in = jnp.einsum("tec,td->ecd", dispatch, x)   # (E, C, D)
+        xb = binarize_ste(ex_in)
+
+        def expert(w_e, b_e, x_e):
+            return binary_matmul(x_e, binarize_ste(w_e)) + b_e
+
+        ex_out = jax.vmap(expert)(w, b, xb)              # (E, C, Do)
+        return jnp.einsum("tec,ecd->td", combine, ex_out)
+
+
+class BnnMoEMLP(nn.Module):
+    """Flagship-style binarized MLP with a top-2 MoE middle layer."""
+
+    hidden: int = 512
+    num_experts: int = 8
+    expert_features: int = 512
+    num_classes: int = 10
+    router_k: int = 2
+    capacity_factor: float = 1.25
+    aux_coef: float = 0.01
+    backend: Backend | None = None
+    ste: str = "identity"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1)
+        bn = lambda: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5
+        )
+        x = BinarizedDense(
+            self.hidden, binarize_input=False, ste=self.ste,
+            backend=self.backend,
+        )(x)
+        x = bn()(x)
+        x = nn.hard_tanh(x)
+
+        # fp32 router on the continuous stream (sign patterns carry too
+        # little information to route on).
+        gates = jax.nn.softmax(nn.Dense(self.num_experts, name="router")(x))
+        t = x.shape[0]
+        capacity = max(
+            1,
+            math.ceil(
+                self.capacity_factor * t * self.router_k / self.num_experts
+            ),
+        )
+        dispatch, combine = topk_dispatch(gates, capacity, self.router_k)
+        self.sow(
+            "intermediates", "aux_loss",
+            self.aux_coef * load_balance_loss(gates),
+        )
+        y = BinarizedExperts(
+            self.num_experts, self.expert_features,
+            name="BinarizedExperts_0",
+        )(x, dispatch, combine)
+        x = bn()(y)
+        x = nn.hard_tanh(x)
+        x = BinarizedDense(
+            self.num_classes, ste=self.ste, backend=self.backend,
+        )(x)
+        return nn.log_softmax(x)
+
+
+def bnn_moe_mlp(**kw) -> BnnMoEMLP:
+    return BnnMoEMLP(**kw)

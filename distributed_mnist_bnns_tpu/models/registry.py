@@ -22,6 +22,7 @@ from .bnn_cnn import BinarizedCNN
 from .cnn import DeepCNN
 from .convnet import ConvNet
 from .mlp import bnn_mlp_large, bnn_mlp_small, fp32_mlp_large, qnn_mlp_large
+from .moe import bnn_moe_mlp
 from .resnet import xnor_resnet18, xnor_resnet50
 from .transformer import bnn_vit_small, bnn_vit_tiny
 
@@ -45,6 +46,9 @@ MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     # stack — flash/ring attention — as a trainable model family)
     "bnn-vit-tiny": bnn_vit_tiny,
     "bnn-vit-small": bnn_vit_small,
+    # binarized MoE (no reference counterpart: the expert-parallel stack
+    # — top-2 routing + load-balance aux loss — as a trainable family)
+    "bnn-moe-mlp": bnn_moe_mlp,
 }
 
 

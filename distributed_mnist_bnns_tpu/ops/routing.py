@@ -1,0 +1,94 @@
+"""MoE routing math — dispatch/combine construction and the router's
+load-balancing objective.
+
+Pure-ops (no parallel/ or train/ dependencies) so both the model
+families (models/moe.py) and the expert-parallel deployment
+(parallel/expert_parallel.py) use one definition of routing; the latter
+re-exports these names for its public API.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_dispatch(
+    gates: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 routing with capacity-bounded one-hot dispatch.
+
+    gates: (T, E) router probabilities. Returns (dispatch, combine), both
+    (T, E, C): dispatch is the 0/1 token->slot assignment (tokens beyond
+    ``capacity`` per expert are dropped, in token order); combine is
+    dispatch scaled by the chosen expert's gate probability.
+    """
+    t, e = gates.shape
+    expert_idx = jnp.argmax(gates, axis=-1)                      # (T,)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=gates.dtype)    # (T, E)
+    # 1-based arrival position of each token within its chosen expert.
+    pos = jnp.cumsum(onehot, axis=0) * onehot                    # (T, E)
+    keep = (pos > 0) & (pos <= capacity)
+    slot = jnp.where(keep, pos - 1, 0).astype(jnp.int32)
+    dispatch = (
+        keep.astype(gates.dtype)[..., None]
+        * jax.nn.one_hot(slot, capacity, dtype=gates.dtype)      # (T, E, C)
+    )
+    gate_val = jnp.sum(gates * onehot, axis=-1)                  # (T,)
+    combine = gate_val[:, None, None] * dispatch
+    return dispatch, combine
+
+
+def topk_dispatch(
+    gates: jnp.ndarray, capacity: int, k: int = 2
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k routing (GShard top-2 by default) with capacity bounds.
+
+    Each token sends to its k highest-gate experts; combine weights are
+    the chosen gates renormalized over the k choices. Expert slots fill
+    choice-major (everyone's first choice before anyone's second), each
+    choice in token order; tokens past ``capacity`` drop that choice.
+    Returns (dispatch, combine), both (T, E, C)."""
+    t, e = gates.shape
+    if k < 1 or k > e:
+        raise ValueError(f"top-k needs 1 <= k <= {e}, got {k}")
+    remaining = gates
+    chosen = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        onehot = jax.nn.one_hot(idx, e, dtype=gates.dtype)
+        chosen.append((jnp.sum(gates * onehot, axis=-1), onehot))
+        remaining = remaining - onehot * 2.0  # probs <= 1: never re-chosen
+    denom = sum(gv for gv, _ in chosen) + 1e-9
+    counts = jnp.zeros((e,), gates.dtype)  # kept slots used per expert
+    dispatch = jnp.zeros((t, e, capacity), gates.dtype)
+    combine = jnp.zeros((t, e, capacity), gates.dtype)
+    for gv, onehot in chosen:
+        pos = jnp.cumsum(onehot, axis=0) * onehot + counts[None, :] * onehot
+        keep = (pos > 0) & (pos <= capacity)
+        slot = jnp.where(keep, pos - 1, 0).astype(jnp.int32)
+        d_j = (
+            keep.astype(gates.dtype)[..., None]
+            * jax.nn.one_hot(slot, capacity, dtype=gates.dtype)
+        )
+        dispatch = dispatch + d_j
+        combine = combine + (gv / denom)[:, None, None] * d_j
+        counts = counts + jnp.sum(keep.astype(gates.dtype) * onehot, axis=0)
+    return dispatch, combine
+
+
+def load_balance_loss(gates: jnp.ndarray) -> jnp.ndarray:
+    """Switch-Transformer auxiliary load-balancing loss.
+
+    ``E * sum_e f_e * p_e`` with f_e the fraction of tokens whose top-1
+    choice is expert e and p_e the mean router probability of e; equals
+    1.0 at perfect balance, grows as routing collapses. Differentiable
+    through p (f's argmax is piecewise constant), which is what pushes
+    the router toward balance."""
+    t, e = gates.shape
+    top1 = jax.nn.one_hot(jnp.argmax(gates, axis=-1), e, dtype=gates.dtype)
+    f = jnp.mean(top1, axis=0)
+    p = jnp.mean(gates, axis=0)
+    return e * jnp.sum(f * p)

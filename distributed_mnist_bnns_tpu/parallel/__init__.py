@@ -29,8 +29,11 @@ from .pipeline_model import (
 )
 from .expert_parallel import (
     init_expert_params,
+    load_balance_loss,
     make_expert_parallel_moe,
     moe_reference,
+    top1_dispatch,
+    topk_dispatch,
 )
 
 __all__ = [
@@ -61,4 +64,7 @@ __all__ = [
     "init_expert_params",
     "make_expert_parallel_moe",
     "moe_reference",
+    "top1_dispatch",
+    "topk_dispatch",
+    "load_balance_loss",
 ]

@@ -25,40 +25,29 @@ oracle on the virtual CPU mesh.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.binarize import binarize
+from ..ops.routing import (  # canonical defs: ops/routing.py (re-exported)
+    load_balance_loss,
+    top1_dispatch,
+    topk_dispatch,
+)
 from ..ops.xnor_gemm import binary_matmul
 
-
-def top1_dispatch(
-    gates: jnp.ndarray, capacity: int
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-1 routing with capacity-bounded one-hot dispatch.
-
-    gates: (T, E) router probabilities. Returns (dispatch, combine), both
-    (T, E, C): dispatch is the 0/1 token->slot assignment (tokens beyond
-    ``capacity`` per expert are dropped, in token order); combine is
-    dispatch scaled by the chosen expert's gate probability.
-    """
-    t, e = gates.shape
-    expert_idx = jnp.argmax(gates, axis=-1)                      # (T,)
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=gates.dtype)    # (T, E)
-    # 1-based arrival position of each token within its chosen expert.
-    pos = jnp.cumsum(onehot, axis=0) * onehot                    # (T, E)
-    keep = (pos > 0) & (pos <= capacity)
-    slot = jnp.where(keep, pos - 1, 0).astype(jnp.int32)
-    dispatch = (
-        keep.astype(gates.dtype)[..., None]
-        * jax.nn.one_hot(slot, capacity, dtype=gates.dtype)      # (T, E, C)
-    )
-    gate_val = jnp.sum(gates * onehot, axis=-1)                  # (T,)
-    combine = gate_val[:, None, None] * dispatch
-    return dispatch, combine
+__all__ = [
+    "top1_dispatch",
+    "topk_dispatch",
+    "load_balance_loss",
+    "binarized_expert",
+    "init_expert_params",
+    "moe_reference",
+    "make_expert_parallel_moe",
+]
 
 
 def binarized_expert(params: Any, x: jnp.ndarray) -> jnp.ndarray:
@@ -94,20 +83,24 @@ def moe_reference(
     capacity: int,
     expert_fn: Callable[[Any, jnp.ndarray], jnp.ndarray] = binarized_expert,
     n_shards: int = 1,
+    k: int = 1,
 ) -> jnp.ndarray:
     """Dense single-device MoE oracle with per-shard routing.
 
     Routing runs independently per token shard (vmapped), with per-shard
     ``capacity`` — exactly the semantics of the expert-parallel path, so
-    outputs match it including which tokens get dropped.
-    """
+    outputs match it including which tokens get dropped. ``k=1`` keeps
+    the original top-1 combine (raw gate scaling); ``k>=2`` uses the
+    GShard top-k dispatch (renormalized combine weights)."""
     t, d = x.shape
     assert t % n_shards == 0, (t, n_shards)
     xs = x.reshape(n_shards, t // n_shards, d)
 
     def route(x_s):
         gates = jax.nn.softmax(x_s @ gate_w)
-        return top1_dispatch(gates, capacity)
+        if k == 1:
+            return top1_dispatch(gates, capacity)
+        return topk_dispatch(gates, capacity, k)
 
     dispatch, combine = jax.vmap(route)(xs)                  # (S, Tl, E, C)
     ex_in = jnp.einsum("stec,std->escd", dispatch, xs)       # (E, S, C, D)
@@ -126,19 +119,24 @@ def make_expert_parallel_moe(
     axis: str = "expert",
     capacity: int,
     expert_fn: Callable[[Any, jnp.ndarray], jnp.ndarray] = binarized_expert,
+    k: int = 1,
 ) -> Callable:
     """Build a jitted expert-parallel MoE over ``mesh``'s ``axis``.
 
     Returns f(expert_params, gate_w, x): expert_params leaves are stacked
     (E, ...) and sharded on the leading dim; x is (T, D) sharded on tokens;
     gate_w (D, E) is replicated. The axis size must divide both E and T.
+    ``k`` selects top-1 (original combine) or GShard top-k routing.
     """
     n = mesh.shape[axis]
 
     def local_fn(params_local, gate_w, x_local):
         # Per-device: params (E_local, ...), x (T_local, D).
         gates = jax.nn.softmax(x_local @ gate_w)             # (Tl, E)
-        dispatch, combine = top1_dispatch(gates, capacity)
+        if k == 1:
+            dispatch, combine = top1_dispatch(gates, capacity)
+        else:
+            dispatch, combine = topk_dispatch(gates, capacity, k)
         ex_in = jnp.einsum("tec,td->ecd", dispatch, x_local)  # (E, C, D)
         # Scatter expert groups to their owners; gather my experts' slices
         # from every source device: (E, C, D) -> (E_local, n*C, D).

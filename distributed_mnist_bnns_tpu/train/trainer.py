@@ -128,9 +128,24 @@ def make_step_body(
                 images,
                 train=True,
                 rngs=rngs,
-                mutable=["batch_stats"],
+                mutable=["batch_stats", "intermediates"],
             )
-            return loss_fn(outs, labels), (outs, mutated.get("batch_stats", {}))
+            loss = loss_fn(outs, labels)
+            # Auxiliary objectives: any value a model sows into
+            # "intermediates" under a name ending in "aux_loss" (already
+            # scaled by the model) joins the training loss — e.g. the
+            # MoE router's load-balancing term (models/moe.py). Other
+            # sows (observability hooks like attn_core) are untouched
+            # and dead-code-eliminated by XLA.
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                mutated.get("intermediates", {})
+            )[0]:
+                if any(
+                    str(getattr(p, "key", "")).endswith("aux_loss")
+                    for p in path
+                ):
+                    loss = loss + leaf
+            return loss, (outs, mutated.get("batch_stats", {}))
 
         if remat:
             compute_loss = jax.checkpoint(compute_loss)
