@@ -611,6 +611,7 @@ class Trainer:
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
         self._epoch_fn = None          # built lazily for device_data
+        self._rng_replicator = None    # cached mesh rng replicator
         self._eval_epoch_fn = None
         # Device-resident array caches, keyed by a _dataset_ref identity
         # closure: (ref, images, labels).
@@ -944,21 +945,25 @@ class Trainer:
         return self._train_scan
 
     def _device_data_active(self) -> bool:
-        """device_data is supported single-process, on the single-device
-        and GSPMD-DP paths (the dataset replicates over the mesh; FSDP and
-        multi-host keep their streaming paths)."""
+        """device_data runs on the single-device and GSPMD-DP paths —
+        including multi-process GSPMD, where every host holds the same
+        dataset files (the DDP contract), the device copy is assembled as
+        one replicated global array, and each host contributes its column
+        slice of the per-epoch gather-index matrix. FSDP / TP keep their
+        streaming paths, as does a multi-process run without a DP mesh
+        (nothing ties the processes' steps together there)."""
         if not self.config.device_data:
             return False
-        if jax.process_count() > 1 or (
+        if (jax.process_count() > 1 and self.mesh is None) or (
             self.mesh is not None and (
                 self.config.dp_mode != "gspmd"
                 or self.config.tensor_parallel > 1
             )
         ):
             log.warning(
-                "device_data is only supported single-process with "
-                "dp_mode='gspmd' (no tensor parallelism); falling back "
-                "to the streaming path"
+                "device_data needs dp_mode='gspmd' (no tensor "
+                "parallelism; multi-process additionally needs the DP "
+                "mesh); falling back to the streaming path"
             )
             return False
         return True
@@ -983,14 +988,16 @@ class Trainer:
         ):
             return self._device_dataset[1], self._device_dataset[2]
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            # replicate() also handles the multi-process assembly (each
+            # host holds the same dataset; device_put alone cannot
+            # address remote devices).
+            from ..parallel import replicate
 
-            repl = NamedSharding(self.mesh, P())
-            images = jax.device_put(
-                np.asarray(data.train_images, np.float32), repl
+            images = replicate(
+                np.asarray(data.train_images, np.float32), self.mesh
             )
-            labels = jax.device_put(
-                np.asarray(data.train_labels, np.int32), repl
+            labels = replicate(
+                np.asarray(data.train_labels, np.int32), self.mesh
             )
         else:
             images = jnp.asarray(data.train_images, jnp.float32)
@@ -998,17 +1005,34 @@ class Trainer:
         self._device_dataset = (_dataset_ref(data), images, labels)
         return images, labels
 
+    def _place_index_matrix(self, idx_local: np.ndarray):
+        """Place this host's (n, B_local) gather-index/valid matrix as
+        the P(None, 'data') global (n, B_local * n_processes) matrix.
+        Each host contributes the columns its local devices own —
+        exactly the DistributedSampler column layout the streaming
+        multi-host path feeds through the same shard_batch helper."""
+        if self.mesh is None:
+            return jnp.asarray(idx_local)
+        from ..parallel import shard_batch
+
+        return shard_batch(idx_local, self.mesh, batch_dim=1)
+
     def _train_epoch_device(self, data, epoch: int) -> Dict[str, float]:
         """One-dispatch epoch over the device-resident dataset. Per-batch
         times are the epoch time amortized (the host cannot observe
-        steps of a device-resident loop); metrics are epoch means."""
+        steps of a device-resident loop); metrics are epoch means.
+
+        Multi-process: each host draws its own DistributedSampler shard
+        (same as the streaming path) and contributes it as its column
+        block of the global per-step gather index — the global batch is
+        ``batch_size * n_processes``, matching streaming semantics."""
         from ..data.mnist import shard_indices
 
         cfg = self.config
         images_all, labels_all = self._get_device_dataset(data)
         idx = shard_indices(
             len(data.train_labels), epoch=epoch, seed=cfg.seed,
-            host_id=0, num_hosts=1,
+            host_id=jax.process_index(), num_hosts=jax.process_count(),
         )
         n_batches = len(idx) // cfg.batch_size
         idx = np.asarray(
@@ -1016,9 +1040,16 @@ class Trainer:
         ).reshape(n_batches, cfg.batch_size)
         epoch_fn = self._get_epoch_fn()
         self.batch_meter.reset()
+        if self.mesh is not None:
+            if self._rng_replicator is None:
+                self._rng_replicator = _make_rng_replicator(self.mesh)
+            rng_arg = self._rng_replicator(self.rng)
+        else:
+            rng_arg = self.rng
         epoch_start = time.perf_counter()
         self.state, metrics = epoch_fn(
-            self.state, images_all, labels_all, jnp.asarray(idx), self.rng
+            self.state, images_all, labels_all,
+            self._place_index_matrix(idx), rng_arg,
         )
         metrics = jax.tree.map(float, metrics)  # host fetch = device sync
         epoch_time = time.perf_counter() - epoch_start
@@ -1236,8 +1267,6 @@ class Trainer:
 
     def _eval_device(self, data, bs: int) -> Dict[str, float]:
         """One-dispatch eval over the device-resident test set."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         if (
             self._device_testset is None
             or self._device_testset[0]() is not data
@@ -1245,9 +1274,10 @@ class Trainer:
             imgs = np.asarray(data.test_images, np.float32)
             lbls = np.asarray(data.test_labels, np.int32)
             if self.mesh is not None:
-                repl = NamedSharding(self.mesh, P())
+                from ..parallel import replicate
+
                 imgs, lbls = (
-                    jax.device_put(imgs, repl), jax.device_put(lbls, repl)
+                    replicate(imgs, self.mesh), replicate(lbls, self.mesh)
                 )
             else:
                 imgs, lbls = jnp.asarray(imgs), jnp.asarray(lbls)
@@ -1258,19 +1288,27 @@ class Trainer:
             bs = -(-bs // int(self.mesh.devices.size)) * int(
                 self.mesh.devices.size
             )
-        n_chunks = -(-n // bs)
-        flat = np.zeros(n_chunks * bs, np.int32)
-        flat[:n] = np.arange(n, dtype=np.int32)
-        valid = np.zeros(n_chunks * bs, bool)
-        valid[:n] = True
+        # Multi-process: each host evaluates a disjoint strided shard of
+        # the test set (every example exactly once globally, same scheme
+        # as _eval_on_mesh) and contributes its columns of the global
+        # chunk matrix; padding is masked out of the aggregation.
+        num_hosts = jax.process_count()
+        w_local = max(bs // num_hosts, 1)
+        mine = np.arange(n, dtype=np.int32)[jax.process_index()::num_hosts]
+        per_host = -(-n // num_hosts)
+        n_chunks = max(-(-per_host // w_local), 1)
+        flat = np.zeros(n_chunks * w_local, np.int32)
+        flat[: len(mine)] = mine
+        valid = np.zeros(n_chunks * w_local, bool)
+        valid[: len(mine)] = True
         if self._eval_epoch_fn is None:
             self._eval_epoch_fn = make_eval_epoch_fn(
                 self._loss_fn, mesh=self.mesh
             )
         totals = self._eval_epoch_fn(
             self.state, images_all, labels_all,
-            jnp.asarray(flat.reshape(n_chunks, bs)),
-            jnp.asarray(valid.reshape(n_chunks, bs)),
+            self._place_index_matrix(flat.reshape(n_chunks, w_local)),
+            self._place_index_matrix(valid.reshape(n_chunks, w_local)),
         )
         return {k: float(v) for k, v in totals.items()}
 
