@@ -75,6 +75,17 @@ def make_hybrid_mesh(
             f"{len(devs)} devices not divisible by ICI shape {ici_axes}"
         )
     n_slices = len(devs) // ici
+    ordered = _group_devices_by_slice(devs, n_slices, ici)
+    grid = np.asarray(ordered).reshape(n_slices, *ici_axes.values())
+    return Mesh(grid, axis_names=(dcn_axis, *ici_axes.keys()))
+
+
+def _group_devices_by_slice(devs, n_slices: int, ici: int) -> list:
+    """Order devices slice-major so a reshape to (n_slices, ici) puts
+    each DCN group in one row: grouped by ``slice_index`` (real
+    multi-slice topology), falling back to ``process_index``
+    (one-process-per-host layouts), falling back to contiguous chunks
+    with a warning when neither matches the requested shape."""
 
     def group_key(d):
         idx = getattr(d, "slice_index", None)
@@ -86,16 +97,13 @@ def make_hybrid_mesh(
     if len(keys) == n_slices and all(
         sum(1 for d in devs if group_key(d) == k) == ici for k in keys
     ):
-        ordered = [d for k in keys for d in devs if group_key(d) == k]
-    else:  # no usable topology info — contiguous equal chunks
-        if n_slices > 1:
-            log.warning(
-                "make_hybrid_mesh: device slice/process grouping does not "
-                "match %d slices of %d devices; falling back to contiguous "
-                "chunks. On real multi-slice hardware this can place ICI "
-                "axes across the DCN boundary — verify the mesh layout.",
-                n_slices, ici,
-            )
-        ordered = devs
-    grid = np.asarray(ordered).reshape(n_slices, *ici_axes.values())
-    return Mesh(grid, axis_names=(dcn_axis, *ici_axes.keys()))
+        return [d for k in keys for d in devs if group_key(d) == k]
+    if n_slices > 1:  # no usable topology info — contiguous equal chunks
+        log.warning(
+            "make_hybrid_mesh: device slice/process grouping does not "
+            "match %d slices of %d devices; falling back to contiguous "
+            "chunks. On real multi-slice hardware this can place ICI "
+            "axes across the DCN boundary — verify the mesh layout.",
+            n_slices, ici,
+        )
+    return devs

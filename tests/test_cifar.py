@@ -86,7 +86,7 @@ def test_synthetic_fallback_and_dispatch(tmp_path):
     assert data.source == "synthetic"
     assert data.train_images.shape == (32, 32, 32, 3)
     with pytest.raises(ValueError):
-        load_dataset("imagenet")
+        load_dataset("no-such-dataset")
 
 
 def test_cli_trains_xnor_resnet_on_cifar(tmp_path):

@@ -215,3 +215,63 @@ def test_hybrid_mesh_indivisible_raises():
 
     with pytest.raises(ValueError):
         make_hybrid_mesh({"data": 3})
+
+
+def test_hybrid_mesh_groups_by_slice_index():
+    """The DCN grouping itself (parallel/mesh._group_devices_by_slice):
+    interleaved slice_index devices must be reordered slice-major so each
+    mesh row is one slice — exercised with stub devices because the CPU
+    simulator exposes a single process and no slice topology."""
+    from distributed_mnist_bnns_tpu.parallel.mesh import (
+        _group_devices_by_slice,
+    )
+
+    class Dev:
+        def __init__(self, i, sl):
+            self.id, self.slice_index = i, sl
+
+        def __repr__(self):
+            return f"d{self.id}s{self.slice_index}"
+
+    # deliberately interleaved: slice of device i = i % 2
+    devs = [Dev(i, i % 2) for i in range(8)]
+    ordered = _group_devices_by_slice(devs, n_slices=2, ici=4)
+    assert [d.slice_index for d in ordered] == [0, 0, 0, 0, 1, 1, 1, 1]
+    # stable within a slice (device order preserved)
+    assert [d.id for d in ordered] == [0, 2, 4, 6, 1, 3, 5, 7]
+
+
+def test_hybrid_mesh_process_index_fallback():
+    """Without slice_index, grouping falls back to process_index (the
+    one-process-per-host layout)."""
+    from distributed_mnist_bnns_tpu.parallel.mesh import (
+        _group_devices_by_slice,
+    )
+
+    class Dev:
+        def __init__(self, i, p):
+            self.id, self.process_index = i, p
+
+    devs = [Dev(i, i % 2) for i in range(4)]
+    ordered = _group_devices_by_slice(devs, n_slices=2, ici=2)
+    assert [d.process_index for d in ordered] == [0, 0, 1, 1]
+
+
+def test_hybrid_mesh_mismatched_topology_falls_back(caplog):
+    """Topology info that cannot fill the requested (n_slices, ici) shape
+    keeps device order and warns (the layout-verification escape hatch)."""
+    import logging
+
+    from distributed_mnist_bnns_tpu.parallel.mesh import (
+        _group_devices_by_slice,
+    )
+
+    class Dev:
+        def __init__(self, i, sl):
+            self.id, self.slice_index = i, sl
+
+    devs = [Dev(i, 0 if i < 3 else 1) for i in range(8)]  # 3/5 split
+    with caplog.at_level(logging.WARNING):
+        ordered = _group_devices_by_slice(devs, n_slices=2, ici=4)
+    assert [d.id for d in ordered] == list(range(8))
+    assert any("falling back" in r.message for r in caplog.records)

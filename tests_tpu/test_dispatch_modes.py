@@ -1,0 +1,126 @@
+"""Round-3 dispatch modes on real TPU hardware (VERDICT r3 item 10):
+scan-fused train steps, device-resident epochs and eval, and the packed
+1-bit inference path — certified on-chip, not only on the CPU mesh.
+
+Numerics policy (tests/README + memory): exact-trajectory comparisons
+(scan vs per-step, device-data vs streaming) hold bit-tight because the
+op order is identical; live-vs-frozen comparisons cross different
+compiled programs, so assertions target exact integer aggregates and
+high prediction agreement instead of logit equality."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _data(n_train=512, n_test=256, seed=0):
+    from distributed_mnist_bnns_tpu.data.common import (
+        ImageClassData,
+        synthetic_blobs,
+    )
+
+    tr_x, tr_y, te_x, te_y = synthetic_blobs(
+        (28, 28, 1), n_train, n_test, seed=seed
+    )
+    return ImageClassData(
+        tr_x.astype(np.float32) / 255.0, tr_y,
+        te_x.astype(np.float32) / 255.0, te_y,
+    )
+
+
+def _trainer(**kw):
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    cfg = dict(
+        model="bnn-mlp-small", model_kwargs={"infl_ratio": 1},
+        epochs=1, batch_size=64, optimizer="adam", learning_rate=0.01,
+        backend="bf16", seed=0,
+    )
+    cfg.update(kw)
+    return Trainer(TrainConfig(**cfg))
+
+
+def test_scan_epoch_matches_per_step_on_chip():
+    """scan_steps>1 fuses the same step body into one program: identical
+    op order, so the on-chip trajectory must match per-step dispatch to
+    float tolerance."""
+    data = _data()
+    t_step = _trainer()
+    t_scan = _trainer(scan_steps=4)
+    h_step = t_step.fit(data)
+    h_scan = t_scan.fit(data)
+    assert np.isfinite(h_scan[0]["train_loss"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+            rtol=2e-5, atol=2e-5,
+        ),
+        t_step.state.params, t_scan.state.params,
+    )
+    assert h_scan[0]["test_acc"] == h_step[0]["test_acc"]
+
+
+def test_device_resident_epoch_and_eval_on_chip():
+    """device_data=True: ONE dispatch per epoch over the resident
+    dataset, and the one-dispatch masked eval; trajectory and exact eval
+    aggregates must match the streaming path."""
+    data = _data()
+    t_stream = _trainer()
+    t_dev = _trainer(device_data=True)
+    h_stream = t_stream.fit(data)
+    h_dev = t_dev.fit(data)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+            rtol=2e-5, atol=2e-5,
+        ),
+        t_stream.state.params, t_dev.state.params,
+    )
+    # correct-count aggregates are integers: exact equality required
+    assert h_dev[0]["test_acc"] == h_stream[0]["test_acc"]
+    assert h_dev[0]["test_acc_top5"] == h_stream[0]["test_acc_top5"]
+
+
+def test_packed_inference_on_chip_latency_and_agreement():
+    """The frozen 1-bit serving path (real Mosaic packed kernel): runs
+    on-chip, agrees with the live model on essentially every prediction
+    (threshold ties across different compiled programs are measure-zero
+    but not impossible — exact logit equality is not the contract), and
+    the bandwidth-bound small-batch latency is recorded."""
+    from distributed_mnist_bnns_tpu.infer import freeze_bnn_mlp
+    from distributed_mnist_bnns_tpu.models.mlp import bnn_mlp_small
+
+    model = bnn_mlp_small(backend="bf16")
+    data = _data()
+    x = jnp.asarray(data.test_images[:128])
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x[:1], train=True,
+    )
+    frozen_fn, info = freeze_bnn_mlp(model, variables)
+    live = np.asarray(
+        model.apply(variables, x, train=False)
+    )
+    packed = np.asarray(frozen_fn(x))
+    assert packed.shape == live.shape
+    assert np.isfinite(packed).all()
+    agreement = float(
+        (packed.argmax(-1) == live.argmax(-1)).mean()
+    )
+    assert agreement >= 0.99, agreement
+    assert info["compression"] > 5
+
+    # latency smoke: small-batch packed inference, host-fetch synced
+    small = x[:8]
+    frozen_fn(small).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        out = frozen_fn(small)
+    float(jnp.sum(out))  # host fetch = true sync through the tunnel
+    dt = (time.perf_counter() - t0) / reps
+    print(f"packed bs=8 latency {dt * 1e3:.3f} ms/call")
+    assert dt < 5.0  # sanity only: tunnel jitter dominates small calls
