@@ -70,8 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="bf16 = mixed precision (AMP O2 parity)")
         sp.add_argument("--scan-steps", type=int, default=1,
                         help="fuse N train steps into one lax.scan dispatch "
-                             "(device-resident inner loop; single-device "
-                             "or --dp-mode gspmd)")
+                             "(device-resident inner loop; single-device, "
+                             "--dp-mode gspmd incl. multi-host, or "
+                             "single-process fsdp)")
         sp.add_argument("--device-data", action="store_true",
                         help="keep the whole dataset on device and run "
                              "each epoch as ONE dispatch (dataset must "
@@ -145,6 +146,26 @@ def build_parser() -> argparse.ArgumentParser:
     common(x)
     x.add_argument("--best", action="store_true")
     x.add_argument("--out", default="model_packed.msgpack")
+    lm = sub.add_parser(
+        "lm",
+        help="train the causal binarized LM (byte-level on --corpus, "
+             "else a synthetic corpus); --ring for sequence-parallel "
+             "attention, --pp for the model-level pipeline",
+    )
+    lm.add_argument("--steps", type=int, default=200)
+    lm.add_argument("--seq-len", type=int, default=32)
+    lm.add_argument("--batch-size", type=int, default=16)
+    lm.add_argument("--depth", type=int, default=2)
+    lm.add_argument("--embed-dim", type=int, default=128)
+    lm.add_argument("--num-heads", type=int, default=4)
+    lm.add_argument("--lr", type=float, default=3e-3)
+    lm.add_argument("--seed", type=int, default=0)
+    lm.add_argument("--attention", default="xla", choices=["xla", "flash"])
+    lm.add_argument("--ring", action="store_true")
+    lm.add_argument("--corpus", default=None)
+    lm.add_argument("--pp", type=int, default=1)
+    lm.add_argument("--log-interval", type=int, default=25)
+    lm.add_argument("--log-file", default="log.txt")
     return p
 
 
@@ -222,6 +243,28 @@ def main(argv=None) -> int:
     repin_failed = _honor_platform_env()
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if args.cmd == "lm":
+        from .utils import setup_logging
+
+        setup_logging(args.log_file)
+        if repin_failed:
+            log.warning(
+                "could not re-pin jax platform to %r (backend already "
+                "initialized)", repin_failed,
+            )
+        from .examples.lm_demo import run as lm_run
+
+        history = lm_run(
+            steps=args.steps, seq_len=args.seq_len, batch=args.batch_size,
+            embed_dim=args.embed_dim, depth=args.depth,
+            num_heads=args.num_heads, lr=args.lr, seed=args.seed,
+            attention=args.attention, ring=args.ring, corpus=args.corpus,
+            pp=args.pp, log_every=args.log_interval,
+        )
+        log.info("lm final next-token loss: %.4f", history[-1])
+        return 0
+
     if args.norm is not None and args.norm not in (
         "half", "none",
         {"mnist": "mnist", "cifar10": "cifar",

@@ -1,13 +1,19 @@
-"""Train the causal binarized LM on a synthetic character corpus.
+"""Train the causal binarized LM — synthetic corpus or a real text file.
 
-Runnable demo of the sequence-modeling family (models/transformer.py
-BinarizedLM): next-token training with lm_loss on a periodic synthetic
-corpus (predictable, so loss falls fast), optionally with the causal
-flash kernel (--attention flash) or sequence-parallel ring attention over
-every local device (--ring).
+Runnable entry for the sequence-modeling family (models/transformer.py
+BinarizedLM): next-token training with lm_loss, optionally with the
+causal flash kernel (--attention flash), sequence-parallel ring
+attention over every local device (--ring), or the GPipe model-level
+pipeline over the block stack (--pp N). Also reachable as
+``python -m distributed_mnist_bnns_tpu.cli lm ...``.
+
+Data: ``--corpus FILE`` trains byte-level (vocab 256) on random windows
+of the file; without it, a periodic synthetic corpus (predictable, so
+loss falls fast) stands in.
 
 Run: python -m distributed_mnist_bnns_tpu.examples.lm_demo \
-        [--steps 200] [--seq-len 32] [--attention xla|flash] [--ring]
+        [--steps 200] [--seq-len 32] [--attention xla|flash] [--ring] \
+        [--corpus file.txt] [--pp 2]
 """
 
 from __future__ import annotations
@@ -17,7 +23,7 @@ import argparse
 
 def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
         num_heads=4, lr=3e-3, seed=0, attention="xla", ring=False,
-        log_every=25):
+        log_every=25, corpus=None, pp=1):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -26,6 +32,12 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
     from ..models import BinarizedLM, latent_clamp_mask, lm_loss
     from ..train import clamp_latent
 
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if ring and pp > 1:
+        # ring attention's shard_map runs over a 'seq' mesh; inside the
+        # pipeline's 'pipe' manual mesh that context clashes.
+        raise ValueError("--ring and --pp are mutually exclusive")
     attention_fn = None
     if ring:
         from jax.sharding import Mesh
@@ -40,30 +52,78 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
         mesh = Mesh(np.array(devices), axis_names=("seq",))
         attention_fn = make_ring_attention(mesh, causal=True)
 
+    rng = np.random.RandomState(seed)
+    if corpus is not None:
+        # Byte-level LM on a real file: vocab 256, random windows drawn
+        # each step (the host sampling is trivially cheap next to the
+        # device step).
+        data = np.frombuffer(open(corpus, "rb").read(), np.uint8)
+        if len(data) <= seq_len:
+            raise ValueError(
+                f"corpus {corpus!r} has {len(data)} bytes; need more "
+                f"than seq_len={seq_len}"
+            )
+        vocab = 256
+
+        def draw_tokens():
+            starts = rng.randint(0, len(data) - seq_len, size=batch)
+            return jnp.asarray(
+                np.stack([data[s : s + seq_len] for s in starts]),
+                jnp.int32,
+            )
+    else:
+        if seq_len < 4:
+            raise ValueError(
+                f"the synthetic corpus needs seq_len >= 4, got {seq_len}"
+            )
+        period = seq_len // 4
+        base = rng.randint(0, vocab, (batch, period))
+        reps = -(-seq_len // period)  # tile up, slice to exact length
+        fixed = jnp.asarray(
+            np.tile(base, (1, reps))[:, :seq_len], jnp.int32
+        )
+
+        def draw_tokens():
+            return fixed
+
     model = BinarizedLM(
         vocab=vocab, max_len=seq_len, embed_dim=embed_dim, depth=depth,
         num_heads=num_heads, attention=attention, attention_fn=attention_fn,
     )
-    rng = np.random.RandomState(seed)
-    period = seq_len // 4
-    base = rng.randint(0, vocab, (batch, period))
-    tokens = jnp.asarray(np.tile(base, (1, seq_len // period)), jnp.int32)
-
+    tokens0 = draw_tokens()
     variables = model.init(
         {"params": jax.random.PRNGKey(seed),
          "dropout": jax.random.PRNGKey(seed + 1)},
-        tokens, train=False,
+        tokens0, train=False,
     )
     params = variables["params"]
+
+    if pp > 1:
+        # Model-level GPipe over the block stack (parallel/pipeline_model)
+        from jax.sharding import Mesh
+
+        from ..parallel import make_pipelined_apply, pipeline_params
+
+        devices = jax.devices()
+        if len(devices) < pp:
+            raise ValueError(f"--pp {pp} needs {pp} devices")
+        pp_mesh = Mesh(np.asarray(devices[:pp]), axis_names=("pipe",))
+        pp_apply = make_pipelined_apply(model, pp_mesh, depth, n_micro=pp)
+        params = pipeline_params(params)
+        forward = lambda p, toks: pp_apply({"params": p}, toks)
+    else:
+        forward = lambda p, toks: model.apply(
+            {"params": p}, toks, train=False
+        )
+
     clamp_mask = latent_clamp_mask(params)
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 
     @jax.jit
-    def step(params, opt_state):
+    def step(params, opt_state, tokens):
         def loss_fn(p):
-            out = model.apply({"params": p}, tokens, train=False)
-            return lm_loss(out, tokens)
+            return lm_loss(forward(p, tokens), tokens)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -75,7 +135,7 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
 
     history = []
     for i in range(steps):
-        params, opt_state, loss = step(params, opt_state)
+        params, opt_state, loss = step(params, opt_state, draw_tokens())
         if i % log_every == 0 or i == steps - 1:
             loss = float(loss)
             history.append(loss)
@@ -105,9 +165,16 @@ def main():
     p.add_argument("--ring", action="store_true",
                    help="sequence-parallel causal ring attention over all "
                         "local devices")
+    p.add_argument("--corpus", default=None,
+                   help="text/bytes file for byte-level LM training "
+                        "(default: synthetic periodic corpus)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline the block stack over N devices "
+                        "(depth %% N == 0)")
     a = p.parse_args()
     run(steps=a.steps, seq_len=a.seq_len, batch=a.batch, depth=a.depth,
-        lr=a.lr, seed=a.seed, attention=a.attention, ring=a.ring)
+        lr=a.lr, seed=a.seed, attention=a.attention, ring=a.ring,
+        corpus=a.corpus, pp=a.pp)
 
 
 if __name__ == "__main__":

@@ -1,5 +1,6 @@
 from .fsdp import (
     fsdp_shardings,
+    fsdp_state_shardings,
     make_fsdp_train_step,
     shard_state_fsdp,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "make_mesh",
     "make_hybrid_mesh",
     "fsdp_shardings",
+    "fsdp_state_shardings",
     "make_fsdp_train_step",
     "shard_state_fsdp",
     "initialize_multihost",

@@ -66,6 +66,23 @@ def shard_state_fsdp(state: TrainState, mesh: Mesh, axis: str = "data"
     )
 
 
+def fsdp_state_shardings(
+    state: TrainState, mesh: Mesh, axis: str = "data"
+) -> TrainState:
+    """The TrainState-of-NamedShardings for an FSDP layout (step counter
+    replicated, everything else per fsdp_spec) — shared by the per-step
+    wrapper below and the multi-step scan dispatch
+    (train/trainer.make_train_scan(state_shardings=...))."""
+    return TrainState(
+        step=NamedSharding(mesh, P()),
+        params=fsdp_shardings(state.params, mesh, axis),
+        batch_stats=fsdp_shardings(state.batch_stats, mesh, axis),
+        opt_state=fsdp_shardings(state.opt_state, mesh, axis),
+        apply_fn=state.apply_fn,
+        tx=state.tx,
+    )
+
+
 def make_fsdp_train_step(
     base_step: Callable,
     mesh: Mesh,
@@ -82,14 +99,7 @@ def make_fsdp_train_step(
     the optimizer update itself runs sharded (ZeRO's key property) rather
     than being all-gathered back.
     """
-    state_sh = TrainState(
-        step=NamedSharding(mesh, P()),
-        params=fsdp_shardings(state.params, mesh, axis),
-        batch_stats=fsdp_shardings(state.batch_stats, mesh, axis),
-        opt_state=fsdp_shardings(state.opt_state, mesh, axis),
-        apply_fn=state.apply_fn,
-        tx=state.tx,
-    )
+    state_sh = fsdp_state_shardings(state, mesh, axis)
     data_sh = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     metrics_sh = repl

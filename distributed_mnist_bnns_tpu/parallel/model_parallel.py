@@ -36,7 +36,10 @@ from ..train.trainer import TrainState
 #   feat    — feature-wise layer (BatchNorm/LayerNorm/bias-only) whose
 #             features follow a column-parallel producer: all P(axis)
 #   repl    — replicated: all P()
-_ROLES = ("col", "row", "feat", "repl")
+#   expert_stack — stacked per-expert params (E, ...): leading dim
+#             sharded (expert parallelism; XLA partitions the dispatch
+#             einsums and inserts the all-to-alls)
+_ROLES = ("col", "row", "feat", "repl", "expert_stack")
 
 
 def tp_rules_by_path(
@@ -91,6 +94,8 @@ def tp_rules_by_path(
             return P(axis, None) if kind == "kernel" else P(None)
         if role == "feat":
             return P(axis)
+        if role == "expert_stack":
+            return P(axis)  # leading (expert) dim; trailing dims whole
         return P()
 
     flat = jax.tree_util.tree_flatten_with_path(params)
@@ -146,6 +151,21 @@ BNN_VIT_TP_TABLE: Dict[str, str] = {
 }
 
 
+# The MoE family: EXPERT parallelism through the same mesh axis — the
+# GShard formulation is sharding annotations on the dispatch einsums, so
+# sharding the stacked expert bank's leading (expert) dim is all it
+# takes; XLA inserts the token all-to-alls. Everything else (router,
+# dense layers, BNs) is small and stays replicated.
+BNN_MOE_TP_TABLE: Dict[str, str] = {
+    "BinarizedExperts_0": "expert_stack",   # leading dim = experts
+    "BinarizedDense_0": "repl",
+    "BinarizedDense_1": "repl",
+    "BatchNorm_0": "repl",
+    "BatchNorm_1": "repl",
+    "router": "repl",
+}
+
+
 def tp_rules_for(model_name: str, params: Any, axis: str = "model") -> Any:
     """The TP layout for a registry model family, by path-name table."""
     if model_name.startswith("qnn"):
@@ -154,11 +174,13 @@ def tp_rules_for(model_name: str, params: Any, axis: str = "model") -> Any:
         return tp_rules_by_path(params, BNN_MLP_TP_TABLE, axis)
     if "vit" in model_name:
         return tp_rules_by_path(params, BNN_VIT_TP_TABLE, axis)
+    if "moe" in model_name:
+        return tp_rules_by_path(params, BNN_MOE_TP_TABLE, axis)
     # fp32-mlp-large deliberately not matched: its all-Dense topology
     # (Dense_0..3) would collide with the head rule and mis-shard.
     raise ValueError(
         f"no TP rule table for model {model_name!r} "
-        "(have: the BNN-MLP/QNN and ViT families)"
+        "(have: the BNN-MLP/QNN, ViT and MoE families)"
     )
 
 

@@ -245,11 +245,18 @@ def make_train_scan(
     grad_accum: int = 1,
     augment: bool = False,
     mesh=None,
+    state_shardings=None,
 ) -> Callable:
     """Multi-step train dispatch: ``lax.scan`` the step body over a stacked
     chunk of minibatches — signature ``(state, images (S,B,...),
     labels (S,B), rng) -> (state, metrics)``, with metrics averaged over
     the S steps.
+
+    ``state_shardings`` (a TrainState of NamedShardings) overrides the
+    replicated-state default under a mesh — pass the FSDP shardings
+    (parallel/fsdp.fsdp_state_shardings) to run the device-resident
+    multi-step loop with ZeRO-sharded params/opt state: GSPMD emits the
+    all-gather/reduce-scatter schedule inside each scan iteration.
 
     TPU-first rationale: the per-step path pays one host->device dispatch
     per batch; on a remote/tunneled or busy host that dispatch latency
@@ -285,10 +292,11 @@ def make_train_scan(
 
     repl = NamedSharding(mesh, P())
     chunk_sh = NamedSharding(mesh, P(None, "data"))
+    st_sh = state_shardings if state_shardings is not None else repl
     return jax.jit(
         train_scan,
-        in_shardings=(repl, chunk_sh, chunk_sh, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(st_sh, chunk_sh, chunk_sh, repl),
+        out_shardings=(st_sh, repl),
         donate_argnums=donate_argnums,
     )
 
@@ -902,17 +910,22 @@ class Trainer:
     # -- multi-step scan dispatch -------------------------------------------
 
     def _effective_scan_steps(self) -> int:
-        """scan_steps, gated to the paths the scan composes with (single
-        device and GSPMD DP; FSDP/shard_map keep the per-step path)."""
+        """scan_steps, gated to the paths the scan composes with: single
+        device, GSPMD DP (incl. multi-host), and single-process FSDP
+        (the scan runs with ZeRO state shardings). TP and multi-process
+        FSDP keep the per-step path."""
         s = max(int(self.config.scan_steps), 1)
         if s > 1 and self.mesh is not None and (
-            self.config.dp_mode != "gspmd"
-            or self.config.tensor_parallel > 1
+            self.config.tensor_parallel > 1
+            or (
+                self.config.dp_mode == "fsdp"
+                and jax.process_count() > 1
+            )
         ):
             log.warning(
-                "scan_steps=%d is only supported single-device or with "
-                "dp_mode='gspmd' (no tensor parallelism); falling back "
-                "to per-step dispatch", s,
+                "scan_steps=%d is supported single-device, with "
+                "dp_mode='gspmd', and single-process FSDP (no tensor "
+                "parallelism); falling back to per-step dispatch", s,
             )
             return 1
         return s
@@ -920,10 +933,16 @@ class Trainer:
     def _get_train_scan(self) -> Callable:
         if self._train_scan is not None:
             return self._train_scan
+        state_shardings = None
+        if self.mesh is not None and self.config.dp_mode == "fsdp":
+            from ..parallel.fsdp import fsdp_state_shardings
+
+            state_shardings = fsdp_state_shardings(self.state, self.mesh)
         scan = make_train_scan(
             self.clamp_mask, loss_fn=self._loss_fn,
             remat=self.config.remat, grad_accum=self.config.grad_accum,
             augment=self.config.augment, mesh=self.mesh,
+            state_shardings=state_shardings,
         )
         if self.mesh is not None:
             from ..parallel import shard_batch

@@ -20,3 +20,31 @@ def test_demo_checkpoint():
 
 def test_demo_model_parallel():
     assert np.isfinite(demo_model_parallel())
+
+
+def test_cli_lm_corpus_and_pp(tmp_path, monkeypatch):
+    """The LM family from the CLI: byte-level training on a real corpus
+    file, and the --pp pipelined variant, both converging on a repetitive
+    corpus."""
+    import jax
+
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_bytes(b"the quick brown fox jumps over the lazy dog. " * 80)
+    rc = main(
+        ["lm", "--steps", "40", "--seq-len", "16", "--batch-size", "8",
+         "--depth", "1", "--embed-dim", "32", "--num-heads", "2",
+         "--corpus", str(corpus),
+         "--log-file", str(tmp_path / "log.txt")]
+    )
+    assert rc == 0
+    if jax.device_count() >= 2:
+        rc = main(
+            ["lm", "--steps", "10", "--seq-len", "16", "--batch-size", "8",
+             "--depth", "2", "--embed-dim", "32", "--num-heads", "2",
+             "--pp", "2", "--corpus", str(corpus),
+             "--log-file", str(tmp_path / "log2.txt")]
+        )
+        assert rc == 0
