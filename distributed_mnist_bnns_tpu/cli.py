@@ -164,6 +164,9 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--ring", action="store_true")
     lm.add_argument("--corpus", default=None)
     lm.add_argument("--pp", type=int, default=1)
+    lm.add_argument("--sample", type=int, default=0,
+                    help="generate N tokens after training")
+    lm.add_argument("--temperature", type=float, default=0.8)
     lm.add_argument("--log-interval", type=int, default=25)
     lm.add_argument("--log-file", default="log.txt")
     return p
@@ -255,12 +258,13 @@ def main(argv=None) -> int:
             )
         from .examples.lm_demo import run as lm_run
 
-        history = lm_run(
+        history, _ = lm_run(
             steps=args.steps, seq_len=args.seq_len, batch=args.batch_size,
             embed_dim=args.embed_dim, depth=args.depth,
             num_heads=args.num_heads, lr=args.lr, seed=args.seed,
             attention=args.attention, ring=args.ring, corpus=args.corpus,
-            pp=args.pp, log_every=args.log_interval,
+            pp=args.pp, log_every=args.log_interval, sample=args.sample,
+            temperature=args.temperature,
         )
         log.info("lm final next-token loss: %.4f", history[-1])
         return 0

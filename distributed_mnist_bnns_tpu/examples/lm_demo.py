@@ -23,7 +23,7 @@ import argparse
 
 def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
         num_heads=4, lr=3e-3, seed=0, attention="xla", ring=False,
-        log_every=25, corpus=None, pp=1):
+        log_every=25, corpus=None, pp=1, sample=0, temperature=0.8):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -141,7 +141,34 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
             history.append(loss)
             print(f"step {i:4d}  next-token loss {loss:.4f} "
                   f"({loss / float(jnp.log(2.0)):.3f} bits/token)")
-    return history
+
+    out: list = []
+    if sample > 0:
+        # Autoregressive sampling with a fixed-size sliding window (one
+        # compiled program: the window shape never changes). The prompt
+        # is one more draw from the data stream — a random corpus window
+        # (the training rng has advanced, so it varies with --steps) or
+        # the fixed synthetic pattern.
+        @jax.jit
+        def next_token(params, window, key):
+            lp = forward(params, window[None])[0, -1]  # (vocab,) log-probs
+            if temperature <= 0:
+                return jnp.argmax(lp)
+            return jax.random.categorical(key, lp / temperature)
+
+        window = draw_tokens()[0]  # (seq_len,)
+        key = jax.random.PRNGKey(seed + 2)
+        for _ in range(sample):
+            key, sub = jax.random.split(key)
+            tok = next_token(params, window, sub)
+            out.append(int(tok))
+            window = jnp.concatenate([window[1:], tok[None]])
+        if corpus is not None:  # byte-level: show as text
+            text = bytes(out).decode("utf-8", errors="replace")
+            print(f"sample ({sample} bytes, T={temperature}): {text!r}")
+        else:
+            print(f"sample ({sample} tokens, T={temperature}): {out}")
+    return history, out
 
 
 def main():
@@ -171,10 +198,16 @@ def main():
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline the block stack over N devices "
                         "(depth %% N == 0)")
+    p.add_argument("--sample", type=int, default=0,
+                   help="generate N tokens after training (sliding-window "
+                        "autoregressive sampling)")
+    p.add_argument("--temperature", type=float, default=0.8,
+                   help="sampling temperature (0 = greedy)")
     a = p.parse_args()
     run(steps=a.steps, seq_len=a.seq_len, batch=a.batch, depth=a.depth,
         lr=a.lr, seed=a.seed, attention=a.attention, ring=a.ring,
-        corpus=a.corpus, pp=a.pp)
+        corpus=a.corpus, pp=a.pp, sample=a.sample,
+        temperature=a.temperature)
 
 
 if __name__ == "__main__":
