@@ -266,8 +266,268 @@ def _fa_fwd(q, k, v, causal, interpret):
 # multiple chunks at small L; 512 matches the forward kernel's block cap.
 _BWD_BLOCK_K = 512
 
+# Block caps of the Pallas backward kernels (same role as the forward's
+# block_q/block_k args). Module-level so tests can force multi-block
+# grids at small L — the sequential reset/accumulate/finalize streaming
+# is the core of both kernels and must be exercised, not just the
+# single-block case.
+_BWD_PALLAS_BLOCK_Q = 512
+_BWD_PALLAS_BLOCK_K = 512
+
+# Backward implementation: "pallas" (on-chip kernels, same blocked
+# streaming as the forward) or "chunked" (lax.scan over K blocks in
+# plain XLA). Both are linear-memory and tested equal to the oracle;
+# pallas is the default hot path, chunked the dependable fallback for a
+# platform that miscompiles the kernels. Selectable via the
+# FLASH_BWD_IMPL env var, read at import — set it BEFORE any training
+# step compiles (the choice is baked into the traced program; flipping
+# the module global later does not invalidate jit caches).
+import os as _os
+
+_BWD_IMPL = _os.environ.get("FLASH_BWD_IMPL", "pallas")
+
+
+def _bwd_masks(
+    s, lse_blk, q_pos, k_pos, *, causal, causal_offset, real_lq, real_lk
+):
+    """p = exp(s - lse) with every invalid (padded q row, padded k col,
+    causally-masked, no-valid-key row) position forced to exactly 0."""
+    invalid = (k_pos >= real_lk) | (q_pos >= real_lq)
+    if causal:
+        invalid = invalid | (k_pos > q_pos + causal_offset)
+    invalid = invalid | (lse_blk < NEG_INF / 2)  # row had no valid keys
+    return jnp.where(invalid, 0.0, jnp.exp(s - lse_blk))
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, coeff_ref, dq_ref, acc_ref,
+    *, scale, causal, n_kblocks, causal_offset, real_lq, real_lk,
+):
+    """dq: grid (BH, q-block, k-block sequential). Streams K/V blocks
+    against a resident q block, accumulating dq = sum_j ds @ k."""
+    from jax.experimental import pallas as pl
+
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _reset():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse_blk = lse_ref[0]      # (bq, 1)
+    coeff = coeff_ref[0]      # (bq, 1) = g_lse - delta
+    bq, bk = q.shape[0], k.shape[0]
+    hi = jax.lax.Precision.HIGHEST
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hi,
+    ) * scale
+    q_pos = pl.program_id(1) * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bk), 0
+    )
+    k_pos = kk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    p = _bwd_masks(
+        s, lse_blk, q_pos, k_pos, causal=causal,
+        causal_offset=causal_offset, real_lq=real_lq, real_lk=real_lk,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hi,
+    )
+    ds = p * (dp + coeff) * scale
+    acc_ref[...] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hi,
+    )
+
+    @pl.when(kk == n_kblocks - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...]
+
+
+def _flash_bwd_dkv_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, coeff_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale, causal, n_qblocks, causal_offset, real_lq, real_lk,
+):
+    """dk/dv: grid (BH, k-block, q-block sequential). Streams Q/dO blocks
+    against a resident K/V block: dv = sum_i p^T do, dk = sum_i ds^T q."""
+    from jax.experimental import pallas as pl
+
+    qq = pl.program_id(2)
+
+    @pl.when(qq == 0)
+    def _reset():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    k, v, q, do = k_ref[0], v_ref[0], q_ref[0], do_ref[0]
+    lse_blk = lse_ref[0]
+    coeff = coeff_ref[0]
+    bq, bk = q.shape[0], k.shape[0]
+    hi = jax.lax.Precision.HIGHEST
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hi,
+    ) * scale
+    q_pos = qq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = pl.program_id(1) * bk + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bk), 1
+    )
+    p = _bwd_masks(
+        s, lse_blk, q_pos, k_pos, causal=causal,
+        causal_offset=causal_offset, real_lq=real_lq, real_lk=real_lk,
+    )
+    # dv += p^T @ do   (contract the q axis of both)
+    dv_acc[...] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hi,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hi,
+    )
+    ds = p * (dp + coeff) * scale
+    dk_acc[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hi,
+    )
+
+    @pl.when(qq == n_qblocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...]
+        dv_ref[0] = dv_acc[...]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_bwd_impl(
+    q3, k3, v3, do3, lse3, coeff3,
+    *, causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    """(BH, L, D) flash backward: two Pallas kernels mirroring the
+    forward's blocking (q rows tile at 8 sublanes, k rows at 128 lanes,
+    head dim padded to 128; padding masked in-kernel)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    scale = d**-0.5
+    bq, lq_p = _pick_block(lq, block_q, 8)
+    bk, lk_p = _pick_block(lk, block_k, 128)
+    d_p = -(-d // 128) * 128
+    if (lq_p, d_p) != (lq, d):
+        q3 = jnp.pad(q3, ((0, 0), (0, lq_p - lq), (0, d_p - d)))
+        do3 = jnp.pad(do3, ((0, 0), (0, lq_p - lq), (0, d_p - d)))
+        lse3 = jnp.pad(lse3, ((0, 0), (0, lq_p - lq), (0, 0)))
+        coeff3 = jnp.pad(coeff3, ((0, 0), (0, lq_p - lq), (0, 0)))
+    if (lk_p, d_p) != (lk, d):
+        k3 = jnp.pad(k3, ((0, 0), (0, lk_p - lk), (0, d_p - d)))
+        v3 = jnp.pad(v3, ((0, 0), (0, lk_p - lk), (0, d_p - d)))
+    n_qblocks, n_kblocks = lq_p // bq, lk_p // bk
+    kw = dict(
+        scale=scale, causal=causal, causal_offset=lk - lq,
+        real_lq=lq, real_lk=lk,
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, n_kblocks=n_kblocks, **kw
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, lq_p, d_p), jnp.float32),
+        grid=(bh, n_qblocks, n_kblocks),
+        in_specs=[
+            pl.BlockSpec((1, bq, d_p), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, bq, d_p), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, kk: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d_p), lambda b, i, kk: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, coeff3)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, n_qblocks=n_qblocks, **kw
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, lk_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lk_p, d_p), jnp.float32),
+        ),
+        grid=(bh, n_kblocks, n_qblocks),
+        in_specs=[
+            pl.BlockSpec((1, bk, d_p), lambda b, j, qq: (b, j, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda b, j, qq: (b, j, 0)),
+            pl.BlockSpec((1, bq, d_p), lambda b, j, qq: (b, qq, 0)),
+            pl.BlockSpec((1, bq, d_p), lambda b, j, qq: (b, qq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, qq: (b, qq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, qq: (b, qq, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, d_p), lambda b, j, qq: (b, j, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda b, j, qq: (b, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, d_p), jnp.float32),
+            pltpu.VMEM((bk, d_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(k3, v3, q3, do3, lse3, coeff3)
+
+    if (lq_p, d_p) != (lq, d):
+        dq = dq[:, :lq, :d]
+    if (lk_p, d_p) != (lk, d):
+        dk, dv = dk[:, :lk, :d], dv[:, :lk, :d]
+    return dq, dk, dv
+
+
+def _fa_bwd_pallas(causal, interpret, res, g):
+    """Pallas-kernel flash backward: same math as the chunked path, on
+    the same blocked streaming schedule the forward uses."""
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    f32 = jnp.float32
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+
+    def to3(x, l):  # (B, L, H, D) -> (BH, L, D) fp32
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d).astype(f32)
+
+    q3, k3, v3 = to3(q, lq), to3(k, lk), to3(v, lk)
+    do3, o3 = to3(g_out, lq), to3(out, lq)
+    lse3 = lse.transpose(0, 2, 1).reshape(b * h, lq, 1).astype(f32)
+    gl3 = g_lse.transpose(0, 2, 1).reshape(b * h, lq, 1).astype(f32)
+    delta3 = jnp.sum(do3 * o3, axis=-1, keepdims=True)
+    coeff3 = gl3 - delta3
+    dq3, dk3, dv3 = _flash_bwd_impl(
+        q3, k3, v3, do3, lse3, coeff3,
+        causal=causal, block_q=_BWD_PALLAS_BLOCK_Q,
+        block_k=_BWD_PALLAS_BLOCK_K, interpret=interpret,
+    )
+
+    def back(x3, l, dtype):
+        return (
+            x3.reshape(b, h, l, d).transpose(0, 2, 1, 3).astype(dtype)
+        )
+
+    return (
+        back(dq3, lq, q.dtype), back(dk3, lk, k.dtype),
+        back(dv3, lk, v.dtype),
+    )
+
 
 def _fa_bwd(causal, interpret, res, g):
+    if _BWD_IMPL == "pallas":
+        return _fa_bwd_pallas(causal, interpret, res, g)
+    return _fa_bwd_chunked(causal, interpret, res, g)
+
+
+def _fa_bwd_chunked(causal, interpret, res, g):
     """Memory-bounded flash backward from the saved (out, lse).
 
     With p_ij = exp(s_ij - lse_i) (softmax probabilities, never

@@ -87,8 +87,22 @@ def test_flash_causal_lq_gt_lk_rejected():
         flash_attention(q, k, v, True, True)
 
 
-class TestChunkedBackward:
-    """The K-chunked backward (ops/flash_attention._fa_bwd): gradient
+@pytest.fixture(params=["pallas", "chunked"])
+def bwd_impl(request, monkeypatch):
+    """Run each backward test against BOTH linear-memory implementations:
+    the Pallas kernel pair (default) and the lax.scan K-chunked fallback
+    (ops/flash_attention._BWD_IMPL)."""
+    import importlib
+
+    fa = importlib.import_module(
+        "distributed_mnist_bnns_tpu.ops.flash_attention"
+    )
+    monkeypatch.setattr(fa, "_BWD_IMPL", request.param)
+    return request.param
+
+
+class TestLinearMemoryBackward:
+    """The flash backward (Pallas kernels / K-chunked scan): gradient
     equality against the oracle VJP with multiple K blocks in flight,
     and the structural no-(Lq,Lk)-intermediate guarantee."""
 
@@ -97,7 +111,9 @@ class TestChunkedBackward:
 
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("lk", [64, 70])  # 70: padded final block
-    def test_multichunk_grads_match_oracle(self, monkeypatch, causal, lk):
+    def test_multichunk_grads_match_oracle(
+        self, monkeypatch, bwd_impl, causal, lk
+    ):
         import importlib
 
         fa = importlib.import_module(
@@ -123,7 +139,7 @@ class TestChunkedBackward:
                 np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
             )
 
-    def test_lse_cotangent_flows(self, monkeypatch):
+    def test_lse_cotangent_flows(self, monkeypatch, bwd_impl):
         """lse is a second differentiable output (the ring merge weights
         depend on it); its cotangent must reach q and k. Oracle: jax.vjp
         through _oracle_with_lse."""
@@ -152,7 +168,7 @@ class TestChunkedBackward:
                 np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
             )
 
-    def test_no_full_score_matrix_in_backward(self, monkeypatch):
+    def test_no_full_score_matrix_in_backward(self, monkeypatch, bwd_impl):
         """Structural check: no intermediate anywhere in the grad jaxpr
         (scan bodies included) carries both the full Lq and the full Lk —
         the backward is O(Lq x block), not O(Lq x Lk)."""
@@ -194,3 +210,38 @@ class TestChunkedBackward:
             s for s in shapes if lq in s and lk in s
         ]
         assert not offenders, f"(Lq, Lk)-sized intermediates: {offenders}"
+
+
+class TestPallasBackwardMultiBlock:
+    """The Pallas backward kernels' sequential accumulation streaming
+    (reset at block 0, accumulate, finalize at the last block) exercised
+    with REAL multi-block grids: block caps forced down so lq=64/lk=256
+    compile to 4 q blocks x 2 k blocks (k blocks cannot go below the
+    128-lane tile)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_multiblock_grads_match_oracle(self, monkeypatch, causal):
+        import importlib
+
+        fa = importlib.import_module(
+            "distributed_mnist_bnns_tpu.ops.flash_attention"
+        )
+        monkeypatch.setattr(fa, "_BWD_IMPL", "pallas")
+        monkeypatch.setattr(fa, "_BWD_PALLAS_BLOCK_Q", 16)
+        monkeypatch.setattr(fa, "_BWD_PALLAS_BLOCK_K", 128)
+        q, k, v = _qkv(jax.random.PRNGKey(6), 1, 64, 2, 8, lk=256)
+
+        def loss_flash(q, k, v):
+            out, lse = fa.flash_attention_with_lse(q, k, v, causal, True)
+            return (out ** 2).sum() + (lse * 0.3).sum()
+
+        def loss_ref(q, k, v):
+            out, lse = fa._oracle_with_lse(q, k, v, causal)
+            return (out ** 2).sum() + (lse * 0.3).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+            )
