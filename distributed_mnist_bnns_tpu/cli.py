@@ -100,6 +100,12 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--async-checkpoint", action="store_true",
                         help="overlap checkpoint serialization/IO with "
                              "training (background writer thread)")
+        sp.add_argument("--checkpoint-backend", default="msgpack",
+                        choices=["msgpack", "orbax"],
+                        help="orbax = sharded per-process writes, "
+                             "restores onto the live shardings (pod "
+                             "scale); msgpack = single-file rank-0 "
+                             "writer (default)")
         sp.add_argument("--native-loader", action="store_true",
                         help="gather batches on C++ worker threads "
                              "(native BatchPool; python fallback if the "
@@ -206,6 +212,7 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         checkpoint_dir=args.checkpoint_dir,
         save_all_epochs=args.save_all,
         async_checkpoint=args.async_checkpoint,
+        checkpoint_backend=args.checkpoint_backend,
         native_loader=args.native_loader,
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
@@ -326,11 +333,7 @@ def main(argv=None) -> int:
         if not args.checkpoint_dir:
             log.error("eval requires --checkpoint-dir")
             return 2
-        from .utils.checkpoint import load_checkpoint
-
-        trainer.state = load_checkpoint(
-            trainer.state, args.checkpoint_dir, best=args.best
-        )
+        trainer.state = trainer.restore(args.checkpoint_dir, best=args.best)
         metrics = trainer.evaluate(data)
         log.info("eval: %s", metrics)
         print(metrics)
@@ -341,11 +344,8 @@ def main(argv=None) -> int:
             log.error("export requires --checkpoint-dir")
             return 2
         from .infer import export_packed
-        from .utils.checkpoint import load_checkpoint
 
-        trainer.state = load_checkpoint(
-            trainer.state, args.checkpoint_dir, best=args.best
-        )
+        trainer.state = trainer.restore(args.checkpoint_dir, best=args.best)
         info = export_packed(
             trainer.model,
             {

@@ -284,7 +284,12 @@ _BWD_PALLAS_BLOCK_K = 512
 # the module global later does not invalidate jit caches).
 import os as _os
 
-_BWD_IMPL = _os.environ.get("FLASH_BWD_IMPL", "pallas")
+_BWD_IMPL = _os.environ.get("FLASH_BWD_IMPL", "pallas").strip().lower()
+if _BWD_IMPL not in ("pallas", "chunked"):
+    raise ValueError(
+        f"FLASH_BWD_IMPL={_BWD_IMPL!r} is not a flash backward "
+        "implementation (have: pallas, chunked)"
+    )
 
 
 def _bwd_masks(

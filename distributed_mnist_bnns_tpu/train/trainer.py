@@ -481,6 +481,10 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     save_all_epochs: bool = False  # keep checkpoint_epoch_N copies
     async_checkpoint: bool = False  # overlap checkpoint IO with training
+    checkpoint_backend: str = "msgpack"  # "msgpack" (single-file, rank-0
+                                   # writer) | "orbax" (sharded per-
+                                   # process writes, restores onto the
+                                   # template's shardings — pod scale)
     native_loader: bool = False    # C++ threaded batch gather (BatchPool)
     resume: bool = False           # restore latest checkpoint before fit
     data_parallel: Optional[object] = None  # None | "auto" | int devices
@@ -625,9 +629,25 @@ class Trainer:
         # closure: (ref, images, labels).
         self._device_dataset = None
         self._device_testset = None
-        self._checkpointer = (
-            AsyncCheckpointer() if config.async_checkpoint else None
-        )
+        if config.checkpoint_backend == "orbax":
+            self._checkpointer = None
+            if config.checkpoint_dir:
+                from ..utils.checkpoint_orbax import OrbaxCheckpointer
+
+                # Natively async; fit() waits after each save unless
+                # async_checkpoint requested the overlap. Only built
+                # when a checkpoint dir exists — eval/export runs need
+                # no background writer.
+                self._checkpointer = OrbaxCheckpointer()
+        elif config.checkpoint_backend == "msgpack":
+            self._checkpointer = (
+                AsyncCheckpointer() if config.async_checkpoint else None
+            )
+        else:
+            raise ValueError(
+                f"unknown checkpoint_backend "
+                f"{config.checkpoint_backend!r} (have: msgpack, orbax)"
+            )
 
     @staticmethod
     def _build_model(name: str, mk: Dict[str, Any]):
@@ -1357,6 +1377,26 @@ class Trainer:
             "test_acc_top5": totals["correct5"] / n * 100.0,
         }
 
+    def restore(self, ckpt_dir: str, *, best: bool = False) -> TrainState:
+        """Restore a checkpoint into this trainer's state template,
+        dispatching on the configured backend. msgpack restores host
+        arrays (re-placed onto the pipe mesh for pp runs); orbax
+        restores directly onto the template's shardings (sharded states
+        come back sharded, per process)."""
+        if self.config.checkpoint_backend == "orbax":
+            from ..utils.checkpoint_orbax import load_checkpoint_orbax
+
+            return load_checkpoint_orbax(self.state, ckpt_dir, best=best)
+        state = load_checkpoint(self.state, ckpt_dir, best=best)
+        if self.config.pipeline_parallel > 1:
+            # msgpack restores host arrays; without this the resumed run
+            # would lose the per-stage placement of block params and
+            # optimizer moments.
+            from ..parallel import place_pipelined_state
+
+            state = place_pipelined_state(state, self._pp_mesh)
+        return state
+
     def try_resume(self) -> int:
         """Restore the latest checkpoint if present; returns start epoch.
 
@@ -1367,16 +1407,16 @@ class Trainer:
         if self._checkpointer is not None:
             self._checkpointer.wait()  # make any in-flight save visible
         ckpt = self.config.checkpoint_dir
-        if not (ckpt and latest_exists(ckpt)):
+        if not ckpt:
             return 0
-        self.state = load_checkpoint(self.state, ckpt)
-        if self.config.pipeline_parallel > 1:
-            # load_checkpoint restores host arrays; without this the
-            # resumed run would lose the per-stage placement of block
-            # params and optimizer moments.
-            from ..parallel import place_pipelined_state
+        if self.config.checkpoint_backend == "orbax":
+            from ..utils.checkpoint_orbax import latest_exists_orbax
 
-            self.state = place_pipelined_state(self.state, self._pp_mesh)
+            if not latest_exists_orbax(ckpt):
+                return 0
+        elif not latest_exists(ckpt):
+            return 0
+        self.state = self.restore(ckpt)
         meta = read_meta(ckpt)
         self.best_acc = float(meta.get("best_acc") or 0.0)
         start = int(meta.get("epoch", -1)) + 1
@@ -1413,6 +1453,13 @@ class Trainer:
                         k: v for k, v in row.items() if isinstance(v, float)
                     }},
                 )
+                if (
+                    self._checkpointer is not None
+                    and not self.config.async_checkpoint
+                ):
+                    # orbax saves are natively async; without the
+                    # --async-checkpoint opt-in, keep blocking semantics.
+                    self._checkpointer.wait()
             if jax.process_index() == 0:
                 log.info(
                     "epoch %d done: %s", epoch,
