@@ -308,6 +308,10 @@ def _probe_device_retry(attempt_timeout_s: float, budget_s: float):
             "probe_s": round(time.monotonic() - t0, 1),
             "alive": ok,
         })
+        # Progress to stderr (stdout stays one JSON line): if the driver
+        # times the whole bench out mid-probe, the retry evidence still
+        # exists in the captured stderr.
+        print(f"bench probe {log[-1]}", file=sys.stderr, flush=True)
         if ok:
             return True, log
         # Stop when another sleep+probe cannot finish inside the budget.

@@ -406,3 +406,90 @@ def test_two_process_hybrid_mesh_dcn_grouping():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert "HYBRID_OK" in out, out
+
+
+_ORBAX_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; ckdir = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+
+import numpy as np
+import jax.numpy as jnp
+from distributed_mnist_bnns_tpu.data.common import ImageClassData
+from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+rng = np.random.RandomState(0)
+data = ImageClassData(
+    train_images=rng.rand(64, 28, 28, 1).astype(np.float32),
+    train_labels=rng.randint(0, 10, 64).astype(np.int32),
+    test_images=rng.rand(16, 28, 28, 1).astype(np.float32),
+    test_labels=rng.randint(0, 10, 16).astype(np.int32),
+)
+
+def make(epochs, resume):
+    return Trainer(TrainConfig(
+        model="bnn-mlp-small", model_kwargs={"infl_ratio": 1},
+        batch_size=16, epochs=epochs, seed=3, backend="xla",
+        data_parallel=8, checkpoint_dir=ckdir,
+        checkpoint_backend="orbax", resume=resume,
+    ))
+
+t1 = make(1, False)
+t1.fit(data)
+# each process wrote only its own shards; restore in a fresh trainer
+t2 = make(2, True)
+h = t2.fit(data)
+assert [r["epoch"] for r in h] == [1], h
+fp = float(jnp.sum(jnp.abs(
+    jax.device_get(t2.state.params)["BinarizedDense_0"]["kernel"]
+)))
+print(f"ORBAX_OK pid={pid} acc={h[-1]['test_acc']:.4f} fp={fp:.6f}", flush=True)
+"""
+
+
+def test_two_process_orbax_checkpoint(tmp_path):
+    """Orbax backend across two real processes: sharded per-process
+    writes during fit, resume in a fresh Trainer, both hosts agreeing on
+    the continued run's params and accuracy."""
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    ck = str(tmp_path / "ck")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _ORBAX_WORKER, str(pid), str(port), ck],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert "ORBAX_OK" in out, out
+    lines = [
+        line for out in outs for line in out.splitlines()
+        if "ORBAX_OK" in line
+    ]
+    fps = [line.split("fp=")[1].split()[0] for line in lines]
+    accs = [line.split("acc=")[1].split()[0] for line in lines]
+    assert fps[0] == fps[1], fps
+    assert accs[0] == accs[1], accs
+    assert os.path.isdir(os.path.join(ck, "orbax_latest"))
