@@ -449,6 +449,79 @@ def _cpu_fallback_extras(args):
     }
 
 
+def _bench_lm(args, deadline):
+    """Long-context stack throughput: tokens/sec of a causal BinarizedLM
+    train step with the flash-attention kernels (fwd + Pallas backward)
+    at a tile-aligned sequence length — the measurable headline for the
+    flash/ring stack (--lm-bench; off by default so the driver's
+    standard run is unchanged)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_mnist_bnns_tpu.models import latent_clamp_mask
+    from distributed_mnist_bnns_tpu.models.transformer import (
+        BinarizedLM,
+        lm_loss,
+    )
+    from distributed_mnist_bnns_tpu.train import clamp_latent
+
+    b, t = args.lm_batch_size, args.lm_seq_len
+    # Real Mosaic lowering on TPU; interpreter elsewhere (CPU smoke runs)
+    attention = (
+        "flash" if jax.default_backend() == "tpu" else "flash_interpret"
+    )
+    model = BinarizedLM(
+        vocab=256, max_len=t, embed_dim=args.lm_embed_dim,
+        depth=args.lm_depth, num_heads=args.lm_heads, attention=attention,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (b, t), 0, 256)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(1),
+         "dropout": jax.random.PRNGKey(2)},
+        tokens, train=False,
+    )
+    params = variables["params"]
+    mask = latent_clamp_mask(params)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, tokens):
+        def loss_fn(p):
+            return lm_loss(
+                model.apply({"params": p}, tokens, train=False), tokens
+            )
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        up, opt = tx.update(g, opt, params)
+        return clamp_latent(optax.apply_updates(params, up), mask), opt, loss
+
+    holder = {}
+
+    def one():
+        nonlocal params, opt
+        params, opt, holder["loss"] = step(params, opt, tokens)
+        return holder["loss"]
+
+    def fetch(loss):
+        holder["lossf"] = float(loss)
+
+    one()
+    fetch(holder["loss"])  # compile + settle
+    dt, _ = _measure(one, fetch, 3, 10, args.reps, deadline)
+    if dt is None:
+        return "below measurement floor"
+    return {
+        "tokens_per_sec": round(b * t / dt, 1),
+        "step_time_ms": round(dt * 1e3, 3),
+        "batch_size": b, "seq_len": t,
+        "depth": args.lm_depth, "embed_dim": args.lm_embed_dim,
+        "attention": f"{attention} (pallas fwd + bwd)",
+        "loss_finite": math.isfinite(holder["lossf"]),
+    }
+
+
 def _bench_device_epoch(args, deadline):
     """Device-resident full-epoch benchmark: a reference-sized (60k-image)
     epoch as ONE dispatched program over the resident dataset
@@ -557,6 +630,14 @@ def main() -> None:
                         "(one dispatch) on the flagship model")
     p.add_argument("--epoch-bench-images", type=int, default=60000,
                    help="epoch size for --epoch-bench (reference: 60k)")
+    p.add_argument("--lm-bench", action="store_true",
+                   help="also bench the causal BinarizedLM train step "
+                        "(flash attention fwd + Pallas bwd, tokens/sec)")
+    p.add_argument("--lm-seq-len", type=int, default=1024)
+    p.add_argument("--lm-batch-size", type=int, default=8)
+    p.add_argument("--lm-depth", type=int, default=4)
+    p.add_argument("--lm-embed-dim", type=int, default=256)
+    p.add_argument("--lm-heads", type=int, default=4)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--probe-timeout", type=float, default=90.0,
                    help="seconds per device-responsiveness probe attempt "
@@ -781,6 +862,12 @@ def main() -> None:
             )
         except Exception as e:  # never let the extra kill the bench line
             result["device_resident_epoch"] = f"failed: {e!r:.300}"
+
+    if args.lm_bench and time.monotonic() < deadline - 60:
+        try:
+            result["lm_flash"] = _bench_lm(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["lm_flash"] = f"failed: {e!r:.300}"
 
     if args.all_backends:
         per_backend = {}
