@@ -456,7 +456,6 @@ def _bench_lm(args, deadline):
     flash/ring stack (--lm-bench; off by default so the driver's
     standard run is unchanged)."""
     import jax
-    import jax.numpy as jnp
     import optax
 
     from distributed_mnist_bnns_tpu.models import latent_clamp_mask
