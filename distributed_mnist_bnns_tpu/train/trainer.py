@@ -1212,14 +1212,9 @@ class Trainer:
         individual steps of a device-resident loop), and metric logging /
         profiling happen at chunk granularity."""
         cfg = self.config
-        self._apply_epoch_regime(epoch)
         if self._device_data_active():
+            self._apply_epoch_regime(epoch)
             return self._train_epoch_device(data, epoch)
-        S = self._effective_scan_steps()
-        scan_step = self._get_train_scan() if S > 1 else None
-        losses, accs = AverageMeter(), AverageMeter()
-        self.batch_meter.reset()
-        batch_times = []
         it_fn = native_batch_iterator if cfg.native_loader else batch_iterator
         it = it_fn(
             data.train_images,
@@ -1230,6 +1225,21 @@ class Trainer:
             host_id=jax.process_index(),
             num_hosts=jax.process_count(),
         )
+        return self._run_train_epoch(it, epoch)
+
+    def _run_train_epoch(self, it, epoch: int) -> Dict[str, float]:
+        """The streaming epoch loop over any (images, labels) batch
+        iterator — shared by the in-memory path (``train_epoch``) and the
+        streaming-dataset path (``fit_stream``). Applies the epoch
+        regime itself (every epoch entry point must; keeping it here
+        means a future caller cannot forget the LR schedule)."""
+        cfg = self.config
+        self._apply_epoch_regime(epoch)
+        S = self._effective_scan_steps()
+        scan_step = self._get_train_scan() if S > 1 else None
+        losses, accs = AverageMeter(), AverageMeter()
+        self.batch_meter.reset()
+        batch_times = []
         if S > 1:
             items = self._scan_chunks(it, S)
             if self.mesh is None:
@@ -1425,14 +1435,53 @@ class Trainer:
         return start
 
     def fit(self, data, eval_every: int = 1) -> list[Dict[str, float]]:
+        return self._fit_loop(
+            lambda epoch: self.train_epoch(data, epoch),
+            lambda: self.evaluate(data),
+            eval_every,
+        )
+
+    def fit_stream(
+        self, stream, eval_data=None, eval_every: int = 1
+    ) -> list[Dict[str, float]]:
+        """fit over a streaming dataset (e.g. data.open_imagenet_stream):
+        each epoch draws this host's DistributedSampler shard from the
+        stream's own ``batches`` iterator — the whole-dataset path for
+        datasets that cannot live in host memory. Scan dispatch, DP/TP
+        meshes, checkpointing and resume all apply unchanged (device_data
+        does not: a streaming dataset by definition doesn't fit).
+        ``eval_data``: an in-memory ImageClassData (e.g. the materialized
+        val subset) for the eval pass; None skips eval — note that
+        best-checkpoint tracking keys on eval accuracy, so without
+        eval_data only the latest (and per-epoch) checkpoints are
+        written, never a 'best' copy."""
+
+        def train(epoch: int) -> Dict[str, float]:
+            it = stream.batches(
+                self.config.batch_size, epoch=epoch, seed=self.config.seed,
+                host_id=jax.process_index(),
+                num_hosts=jax.process_count(),
+            )
+            return self._run_train_epoch(it, epoch)
+
+        return self._fit_loop(
+            train,
+            (lambda: self.evaluate(eval_data))
+            if eval_data is not None else None,
+            eval_every,
+        )
+
+    def _fit_loop(self, train_fn, eval_fn, eval_every) -> list:
         history = []
         self.best_acc = getattr(self, "best_acc", 0.0)
         start_epoch = self.try_resume() if self.config.resume else 0
         for epoch in range(start_epoch, self.config.epochs):
             row: Dict[str, float] = {"epoch": epoch}
-            row.update(self.train_epoch(data, epoch))
-            if eval_every and (epoch + 1) % eval_every == 0:
-                row.update(self.evaluate(data))
+            row.update(train_fn(epoch))
+            if eval_fn is not None and eval_every and (
+                (epoch + 1) % eval_every == 0
+            ):
+                row.update(eval_fn())
             history.append(row)
             if self.config.checkpoint_dir:
                 acc = row.get("test_acc", 0.0)

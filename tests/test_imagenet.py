@@ -259,3 +259,74 @@ class TestTrainEndToEnd:
              "--log-file", str(tmp_path / "log.txt")]
         )
         assert rc == 0
+
+
+class TestStreamingTrainer:
+    def test_fit_stream_folder_layout(self, tmp_path):
+        """Trainer.fit_stream trains on the streaming ImageNet pipeline
+        (decode-per-batch, never materialized) with in-memory val eval —
+        the whole-dataset path for the pod config."""
+        from distributed_mnist_bnns_tpu.data.common import ImageClassData
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        _make_folder_layout(tmp_path, n_per_class=8)
+        stream = open_imagenet_stream(str(tmp_path), "train", image_size=32)
+        assert stream is not None and len(stream) == 24
+        val = open_imagenet_stream(
+            str(tmp_path), "val", image_size=32, wnids=stream.index.wnids
+        )
+        vx, vy = val.materialize(None)
+        eval_data = ImageClassData(
+            np.zeros((1, 32, 32, 3), np.float32), np.zeros(1, np.int32),
+            vx, vy, n_classes=stream.n_classes,
+        )
+        trainer = Trainer(
+            TrainConfig(
+                model="xnor-resnet18",
+                model_kwargs={"num_classes": 3, "stem_features": 16},
+                epochs=2, batch_size=8, optimizer="adam",
+                learning_rate=0.01, backend="xla", seed=0,
+            ),
+            input_shape=(32, 32, 3),
+        )
+        history = trainer.fit_stream(stream, eval_data=eval_data)
+        assert len(history) == 2
+        assert np.isfinite(history[-1]["train_loss"])
+        assert "test_acc" in history[-1]
+        assert int(trainer.state.step) == 6  # 24 imgs / bs 8 x 2 epochs
+
+    def test_fit_stream_scan_dispatch(self, tmp_path):
+        """fit_stream composes with --scan-steps (chunks drawn from the
+        stream) — trajectory equal to per-step dispatch."""
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        _make_folder_layout(tmp_path, n_per_class=8, with_val=False)
+
+        def fit(scan_steps):
+            stream = open_imagenet_stream(
+                str(tmp_path), "train", image_size=32
+            )
+            trainer = Trainer(
+                TrainConfig(
+                    model="bnn-cnn",
+                    model_kwargs={
+                        "num_classes": 3, "widths": (8, 16), "hidden": 32,
+                    },
+                    epochs=1, batch_size=8, optimizer="sgd",
+                    learning_rate=0.05, backend="xla", seed=0,
+                    scan_steps=scan_steps,
+                ),
+                input_shape=(32, 32, 3),
+            )
+            trainer.fit_stream(stream)
+            return trainer
+
+        import jax
+
+        t1, t2 = fit(1), fit(3)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
+            ),
+            jax.device_get(t1.state.params), jax.device_get(t2.state.params),
+        )
