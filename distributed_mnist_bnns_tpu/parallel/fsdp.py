@@ -50,16 +50,37 @@ def fsdp_shardings(tree: Any, mesh: Mesh, axis: str = "data") -> Any:
     )
 
 
+def _place_fsdp_leaf(leaf: Any, sh: NamedSharding, axis: str):
+    """Place one leaf on its FSDP sharding.
+
+    Single-process: device_put. Multi-process: device_put cannot address
+    remote devices; every host holds the identical full value (the DDP
+    same-seed contract), so make_array_from_callback hands each local
+    device exactly the slice the sharding assigns it — correct for any
+    layout, no hand-rolled chunk arithmetic."""
+    del axis
+    if jax.process_count() == 1:
+        return jax.device_put(leaf, sh)
+    leaf = np.asarray(leaf)
+    return jax.make_array_from_callback(
+        leaf.shape, sh, lambda idx: leaf[idx]
+    )
+
+
 def shard_state_fsdp(state: TrainState, mesh: Mesh, axis: str = "data"
                      ) -> TrainState:
     """Place params/opt_state/batch_stats on their FSDP shardings (step
-    counter replicated)."""
+    counter replicated). Works multi-process: each host contributes the
+    slice its devices own from the identically-initialized full state
+    (the DDP same-seed contract, mnist-dist2.py:85-93)."""
     put = lambda tree: jax.tree.map(
-        lambda leaf, sh: jax.device_put(leaf, sh),
+        lambda leaf, sh: _place_fsdp_leaf(leaf, sh, axis),
         tree, fsdp_shardings(tree, mesh, axis),
     )
     return state.replace(
-        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        step=_place_fsdp_leaf(
+            state.step, NamedSharding(mesh, P()), axis
+        ),
         params=put(state.params),
         batch_stats=put(state.batch_stats),
         opt_state=put(state.opt_state),

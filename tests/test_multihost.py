@@ -93,6 +93,40 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_two_workers(worker_src, extra_args=(), timeout=420, marker="OK"):
+    """Shared two-process harness: launch the worker source under two
+    jax.distributed processes, join with a kill-on-timeout, assert both
+    exited 0 and printed ``marker``; returns the two outputs."""
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker_src, str(pid), str(port),
+             *map(str, extra_args)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert marker in out, out
+    return outs
+
+
 def test_two_process_dp_feeding():
     port = _free_port()
     env = {
@@ -493,3 +527,79 @@ def test_two_process_orbax_checkpoint(tmp_path):
     assert fps[0] == fps[1], fps
     assert accs[0] == accs[1], accs
     assert os.path.isdir(os.path.join(ck, "orbax_latest"))
+
+
+_FSDP_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+
+import numpy as np
+import jax.numpy as jnp
+from distributed_mnist_bnns_tpu.data.common import ImageClassData
+from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+rng = np.random.RandomState(0)
+data = ImageClassData(
+    train_images=rng.rand(64, 28, 28, 1).astype(np.float32),
+    train_labels=rng.randint(0, 10, 64).astype(np.int32),
+    test_images=rng.rand(16, 28, 28, 1).astype(np.float32),
+    test_labels=rng.randint(0, 10, 16).astype(np.int32),
+)
+
+def fit(dp_mode):
+    t = Trainer(TrainConfig(
+        model="bnn-mlp-small", model_kwargs={"infl_ratio": 1},
+        batch_size=16, epochs=1, seed=3, backend="xla",
+        # SGD per the repo numerics policy: FSDP's reduce-scatter/
+        # all-gather reassociates the grad sums vs DP's all-reduce, and
+        # Adam's g/sqrt(v) amplifies those ulps into O(lr) diffs.
+        optimizer="sgd", learning_rate=0.05,
+        data_parallel=8, dp_mode=dp_mode,
+    ))
+    h = t.fit(data)
+    return t, h
+
+t_fsdp, h_fsdp = fit("fsdp")
+# params ZeRO-sharded across BOTH processes
+k0 = t_fsdp.state.params["BinarizedDense_0"]["kernel"]
+assert "data" in str(k0.sharding.spec), k0.sharding
+t_dp, h_dp = fit("gspmd")
+# identical batches, same updates -> same trajectory as replicated DP
+# (to BNN tolerance: near-zero latents can flip sign bits on ulp-level
+# reduction-order diffs). FSDP params span both processes: gather them.
+from jax.experimental import multihost_utils
+a = multihost_utils.process_allgather(t_fsdp.state.params, tiled=True)
+b = jax.device_get(t_dp.state.params)
+import jax as _j
+_j.tree.map(
+    lambda x, y: np.testing.assert_allclose(
+        np.asarray(x), np.asarray(y), rtol=1e-3, atol=1e-3
+    ),
+    a, b,
+)
+# accuracy to within one flipped prediction (16 test examples): the
+# same sign-bit tolerance the params comparison above grants
+assert abs(h_fsdp[-1]["test_acc"] - h_dp[-1]["test_acc"]) <= 100.0 / 16 + 1e-6
+fp = float(jnp.sum(jnp.abs(a["BinarizedDense_0"]["kernel"])))
+print(f"FSDP_OK pid={pid} fp={fp:.6f}", flush=True)
+"""
+
+
+def test_two_process_fsdp_trainer():
+    """ZeRO/FSDP across two real processes: the sharded state is
+    assembled per host via make_array_from_callback (no remote
+    device_put), trains through Trainer.fit, and the trajectory matches
+    replicated GSPMD DP to BNN tolerance (identical batches, SGD)."""
+    outs = _run_two_workers(_FSDP_WORKER, marker="FSDP_OK")
+    fps = [
+        line.split("fp=")[1].split()[0]
+        for out in outs for line in out.splitlines() if "FSDP_OK" in line
+    ]
+    assert len(fps) == 2 and fps[0] == fps[1], fps
