@@ -190,9 +190,11 @@ def test_cli_export_cnn(tmp_path, monkeypatch):
     assert np.isfinite(np.asarray(fn(x))).all()
 
 
-def test_cli_infer_subcommand(tmp_path, monkeypatch):
+def test_cli_infer_subcommand(tmp_path, monkeypatch, capsys):
     """train -> export -> infer from the CLI: the packed artifact serves
     the test split with accuracy matching the trained model's eval."""
+    import json
+
     from distributed_mnist_bnns_tpu.cli import main
 
     monkeypatch.chdir(tmp_path)
@@ -209,6 +211,18 @@ def test_cli_infer_subcommand(tmp_path, monkeypatch):
     rc = main(["export", *common, "--out", art,
                "--log-file", str(tmp_path / "l2.txt")])
     assert rc == 0
-    rc = main(["infer", *common, "--artifact", art,
-               "--log-file", str(tmp_path / "l3.txt")])
+    # trained model's own eval accuracy, for the equivalence check
+    rc = main(["eval", *common, "--log-file", str(tmp_path / "l3.txt")])
     assert rc == 0
+    eval_out = capsys.readouterr().out
+    eval_acc = float(eval_out.rsplit("'test_acc':", 1)[1].split(",")[0])
+    rc = main(["infer", *common, "--artifact", art,
+               "--log-file", str(tmp_path / "l4.txt")])
+    assert rc == 0
+    infer_line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(infer_line)
+    assert out["family"] == "bnn-mlp"
+    assert out["n_examples"] == 64
+    # packed serving reproduces the live model's eval accuracy (up to
+    # measure-zero threshold ties)
+    assert abs(out["test_acc"] - eval_acc) <= 100.0 / 64 + 1e-6
