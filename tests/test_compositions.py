@@ -1,0 +1,82 @@
+"""Cross-feature composition smokes: knobs that are individually tested
+must also work together (precision x parallelism x dispatch). Each test
+is a short fit asserting finite loss and the expected placement."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.data.common import ImageClassData
+from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+
+def _data(n=64):
+    rng = np.random.RandomState(0)
+    return ImageClassData(
+        train_images=rng.rand(n, 28, 28, 1).astype(np.float32),
+        train_labels=rng.randint(0, 10, n).astype(np.int32),
+        test_images=rng.rand(16, 28, 28, 1).astype(np.float32),
+        test_labels=rng.randint(0, 10, 16).astype(np.int32),
+    )
+
+
+def _fit(**kw):
+    cfg = dict(
+        model="bnn-mlp-small", model_kwargs={"infl_ratio": 1},
+        epochs=1, batch_size=16, optimizer="adam", learning_rate=0.003,
+        backend="xla", seed=0,
+    )
+    cfg.update(kw)
+    trainer = Trainer(TrainConfig(**cfg))
+    history = trainer.fit(_data())
+    assert np.isfinite(history[0]["train_loss"])
+    return trainer, history
+
+
+def test_bf16_precision_with_tp():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    _fit(precision="bf16", tensor_parallel=2)
+
+
+def test_bf16_precision_with_pp():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    _fit(model="bnn-vit-tiny", model_kwargs={}, precision="bf16",
+         pipeline_parallel=2)
+
+
+def test_bf16_precision_with_fsdp_scan():
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    _fit(precision="bf16", data_parallel=4, dp_mode="fsdp", scan_steps=2)
+
+
+def test_grad_accum_with_tp():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    _fit(tensor_parallel=2, grad_accum=2)
+
+
+def test_remat_with_pp():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    _fit(model="bnn-vit-tiny", model_kwargs={}, remat=True,
+         pipeline_parallel=2)
+
+
+def test_augment_with_device_data_dp():
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    _fit(augment=True, device_data=True, data_parallel=4)
+
+
+def test_label_smoothing_with_moe_tp():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    _fit(
+        model="bnn-moe-mlp",
+        model_kwargs={"hidden": 32, "num_experts": 4,
+                      "expert_features": 32},
+        tensor_parallel=2, label_smoothing=0.1,
+    )
