@@ -1,0 +1,37 @@
+"""The RESULTS.md generator (examples/accuracy_report.py): runs end to
+end at tiny settings, writes the artifact with the learning-curve
+section, and validates its sweep inputs."""
+
+import json
+
+import pytest
+
+
+def test_report_with_sweep_writes_artifact(tmp_path, monkeypatch):
+    from distributed_mnist_bnns_tpu.examples.accuracy_report import run
+
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / "RESULTS_test.md"
+    run(
+        ["bnn-mlp-small"], epochs=1, batch_size=32, lr=0.01,
+        seeds=[0], out_path=str(out), scan_steps=4,
+        sweep_sizes=[64, 256],
+    )
+    text = out.read_text()
+    assert "Train-size learning curve" in text
+    assert "| 64 |" in text and "| 256 |" in text
+    # the trailing json block parses and carries the sweep
+    payload = json.loads(text.rsplit("```json", 1)[1].rsplit("```", 1)[0])
+    assert payload[-1]["train_size_sweep"][0]["train_size"] == 64
+
+
+def test_oversized_sweep_rejected(tmp_path, monkeypatch):
+    from distributed_mnist_bnns_tpu.examples.accuracy_report import run
+
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(ValueError, match="exceed"):
+        run(
+            ["bnn-mlp-small"], epochs=1, batch_size=32, lr=0.01,
+            seeds=[0], out_path=str(tmp_path / "r.md"),
+            sweep_sizes=[10_000_000],
+        )
