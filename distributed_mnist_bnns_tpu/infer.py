@@ -183,7 +183,7 @@ def _freeze_any(model, variables, input_shape=None) -> Dict[str, Any]:
         )
     raise ValueError(
         f"no packed freeze for {type(model).__name__} (freezable: BnnMLP, "
-        "BinarizedCNN, basic-block XnorResNet)"
+        "BinarizedCNN, XnorResNet)"
     )
 
 

@@ -244,13 +244,11 @@ def _build_cnn_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
 def _freeze_resnet_tensors(
     model: XnorResNet, variables: Dict, input_shape
 ) -> Dict[str, Any]:
-    if model.bottleneck:
-        raise ValueError(
-            "freeze supports the basic-block XNOR-ResNets (resnet18); "
-            "bottleneck freezing is not implemented"
-        )
-    if not model.cifar_stem:
-        raise ValueError("freeze supports the CIFAR-stem XNOR-ResNets")
+    """Freeze basic-block (resnet18/CIFAR stem) AND bottleneck
+    (resnet50/ImageNet stem) XNOR-ResNets: per block, each
+    BN->sign->BinarizedConv pair folds to threshold + packed im2col
+    GEMM; the residual stream, the fp32 stem (+maxpool for the ImageNet
+    stem) and projection shortcuts stay full precision."""
     if model.scale:
         raise ValueError(
             "XNOR-Net alpha scaling (scale=True) rescales each conv's "
@@ -260,7 +258,13 @@ def _freeze_resnet_tensors(
         )
     params, stats = variables["params"], variables["batch_stats"]
     h, w, _ = input_shape
-    hw = (h, w)
+    block_name = (
+        "XnorBottleneckBlock_{}" if model.bottleneck else "XnorBasicBlock_{}"
+    )
+    if model.cifar_stem:
+        hw = (h, w)
+    else:  # 7x7/2 stem + 3x3/2 SAME maxpool (models/resnet.py:112-116)
+        hw = _out_hw(_out_hw((h, w), (2, 2)), (2, 2))
     blocks = []
     latent = 0
     packed_bytes = 0
@@ -268,29 +272,33 @@ def _freeze_resnet_tensors(
     for stage, n_blocks in enumerate(model.stage_sizes):
         for b in range(n_blocks):
             strides = 2 if stage > 0 and b == 0 else 1
-            name = f"XnorBasicBlock_{bi}"
+            name = block_name.format(bi)
             bp, bs = params[name], stats[name]
             out_hw = _out_hw(hw, (strides, strides))
-            blk = {
-                "bn0": _bn_pack(bp["BatchNorm_0"], bs["BatchNorm_0"]),
-                "conv1": _freeze_conv(
-                    bp["BinarizedConv_0"]["kernel"],
-                    bp["BinarizedConv_0"]["bias"], hw, (strides, strides),
-                ),
-                "bn1": _bn_pack(bp["BatchNorm_1"], bs["BatchNorm_1"]),
-                "conv2": _freeze_conv(
-                    bp["BinarizedConv_1"]["kernel"],
-                    bp["BinarizedConv_1"]["bias"], out_hw, (1, 1),
-                ),
-                "strides": strides,
-            }
+            # (conv strides, conv input hw) per BN->sign->conv pair:
+            # basic = [3x3 strided, 3x3]; bottleneck = [1x1, 3x3
+            # strided, 1x1] (models/resnet.py:44-51, 76-86).
+            if model.bottleneck:
+                plan = [((1, 1), hw), ((strides, strides), hw),
+                        ((1, 1), out_hw)]
+            else:
+                plan = [((strides, strides), hw), ((1, 1), out_hw)]
+            convs = []
+            for ci, (cs, c_hw) in enumerate(plan):
+                cp = bp[f"BinarizedConv_{ci}"]
+                convs.append({
+                    "bn": _bn_pack(
+                        bp[f"BatchNorm_{ci}"], bs[f"BatchNorm_{ci}"]
+                    ),
+                    "conv": _freeze_conv(
+                        cp["kernel"], cp["bias"], c_hw, cs
+                    ),
+                })
+                latent += int(cp["kernel"].size) * 4
+                packed_bytes += int(convs[-1]["conv"]["wp"].size) * 4
+            blk = {"convs": convs, "strides": strides}
             if "Conv_0" in bp:  # fp32 projection shortcut
                 blk["shortcut_w"] = bp["Conv_0"]["kernel"]
-            for m in ("BinarizedConv_0", "BinarizedConv_1"):
-                latent += int(bp[m]["kernel"].size) * 4
-            packed_bytes += (
-                int(blk["conv1"]["wp"].size) + int(blk["conv2"]["wp"].size)
-            ) * 4
             blocks.append(blk)
             hw = out_hw
             bi += 1
@@ -299,6 +307,7 @@ def _freeze_resnet_tensors(
         "arch": {
             "input_shape": list(input_shape),
             "stage_sizes": list(model.stage_sizes),
+            "cifar_stem": bool(model.cifar_stem),
         },
         "stem_w": params["Conv_0"]["kernel"],  # fp32 stem
         "blocks": blocks,
@@ -306,30 +315,38 @@ def _freeze_resnet_tensors(
         "head_w": params["Dense_0"]["kernel"],
         "head_b": params["Dense_0"]["bias"],
     }
+    n_convs = 3 if model.bottleneck else 2
     frozen["info"] = {
         "family": "xnor-resnet",
         "latent_fp32_weight_bytes": latent,
         "frozen_weight_bytes": packed_bytes,
         "compression": round(latent / max(packed_bytes, 1), 2),
         "packed_layers": [
-            f"XnorBasicBlock_{i}/BinarizedConv_{j}"
-            for i in range(bi) for j in (0, 1)
+            f"{block_name.format(i)}/BinarizedConv_{j}"
+            for i in range(bi) for j in range(n_convs)
         ],
     }
     return frozen
 
 
 def _build_resnet_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
-    ishape = tuple(int(d) for d in frozen["arch"]["input_shape"])
-    stem = _fp32_conv_fn(frozen["stem_w"], None)
+    arch = frozen["arch"]
+    ishape = tuple(int(d) for d in arch["input_shape"])
+    cifar_stem = bool(arch.get("cifar_stem", True))
+    stem = _fp32_conv_fn(
+        frozen["stem_w"], None, (1, 1) if cifar_stem else (2, 2)
+    )
     blocks = []
     for blk in frozen["blocks"]:
         strides = int(blk["strides"])
         blocks.append({
-            "sign0": _bn_sign_fn(blk["bn0"]["params"], blk["bn0"]["stats"]),
-            "conv1": _packed_conv_fn(blk["conv1"], interpret),
-            "sign1": _bn_sign_fn(blk["bn1"]["params"], blk["bn1"]["stats"]),
-            "conv2": _packed_conv_fn(blk["conv2"], interpret),
+            "convs": [
+                (
+                    _bn_sign_fn(c["bn"]["params"], c["bn"]["stats"]),
+                    _packed_conv_fn(c["conv"], interpret),
+                )
+                for c in blk["convs"]
+            ],
             "shortcut": (
                 _fp32_conv_fn(
                     blk["shortcut_w"], None, (strides, strides)
@@ -350,9 +367,15 @@ def _build_resnet_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
                 f"{tuple(x.shape[1:])}"
             )
         x = stem(x)
+        if not cifar_stem:  # ImageNet stem: 3x3/2 SAME max-pool
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max,
+                (1, 3, 3, 1), (1, 2, 2, 1), "SAME",
+            )
         for blk in blocks:
-            y = blk["conv1"](blk["sign0"](x))
-            y = blk["conv2"](blk["sign1"](y))
+            y = x
+            for sign, conv in blk["convs"]:
+                y = conv(sign(y))
             shortcut = x if blk["shortcut"] is None else blk["shortcut"](x)
             x = y + shortcut
         x = jax.nn.relu(affine_final(x)).mean(axis=(1, 2))
@@ -380,7 +403,9 @@ def freeze_xnor_resnet(
     model: XnorResNet, variables: Dict, *,
     input_shape=(32, 32, 3), interpret: bool = False,
 ) -> Tuple[Callable, Dict[str, Any]]:
-    """Freeze a trained basic-block XnorResNet (resnet18 config) into
-    packed inference. Output is raw logits, matching the live model."""
+    """Freeze a trained XnorResNet — basic-block (resnet18, CIFAR stem)
+    or bottleneck (resnet50, ImageNet stem) — into packed inference.
+    Output is raw logits, matching the live model. For resnet50 pass
+    the training resolution (e.g. input_shape=(224, 224, 3))."""
     frozen = _freeze_resnet_tensors(model, variables, input_shape)
     return _build_resnet_apply(frozen, interpret), frozen["info"]

@@ -149,12 +149,36 @@ class TestFrozenResNet:
         with pytest.raises(ValueError, match="expects"):
             frozen_fn(jnp.zeros((1, 64, 64, 3)))
 
-    def test_bottleneck_rejected(self):
+    def test_bottleneck_resnet50_freezes(self, tmp_path):
+        """The BASELINE pod config's model: bottleneck blocks (1x1/3x3
+        strided/1x1, 48 packed convs at resnet50 depth) + the ImageNet
+        7x7/2 stem with max-pool — frozen-vs-live equality and an
+        export/load round-trip. Reduced width/resolution keep CI fast;
+        the structure is the real resnet50."""
         from distributed_mnist_bnns_tpu.models.resnet import xnor_resnet50
 
-        model = xnor_resnet50(backend="xla")
-        with pytest.raises(ValueError, match="basic-block"):
-            freeze_xnor_resnet(model, {"params": {}, "batch_stats": {}})
+        model = xnor_resnet50(backend="xla", stem_features=8)
+        x = jax.random.normal(
+            jax.random.PRNGKey(5), (2, 64, 64, 3), jnp.float32
+        )
+        variables = _trained_variables(model, x, steps=2)
+        live = model.apply(variables, x, train=False)
+        frozen_fn, info = freeze_xnor_resnet(
+            model, variables, input_shape=(64, 64, 3), interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(frozen_fn(x)), np.asarray(live),
+            atol=2e-4, rtol=2e-4,
+        )
+        assert len(info["packed_layers"]) == 48  # 16 blocks x 3 convs
+        path = str(tmp_path / "r50.msgpack")
+        export_packed(model, variables, path, input_shape=(64, 64, 3))
+        loaded_fn, info3 = load_packed(path, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(loaded_fn(x)), np.asarray(frozen_fn(x)),
+            atol=1e-5, rtol=1e-5,
+        )
+        assert info3["family"] == "xnor-resnet"
 
     def test_alpha_scale_rejected(self):
         """scale=True rescales conv outputs by mean|W_latent|; the freeze
