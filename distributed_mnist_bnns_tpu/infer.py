@@ -206,10 +206,11 @@ def export_packed(
     """Write the frozen packed artifact to ``path`` (msgpack). The file
     holds the 1-bit hidden weights, ±1 first layer, raw BN moments and the
     fp32 head — everything ``load_packed`` needs, nothing else (no latent
-    masters, no optimizer state). Covers the MLP, CNN and basic-block
-    XNOR-ResNet families (a ``family`` key dispatches at load); conv
-    artifacts additionally carry their freeze-time input resolution and
-    padding corrections. Returns the size-info dict."""
+    masters, no optimizer state). Covers the MLP, CNN and XNOR-ResNet
+    families — basic-block and bottleneck, CIFAR or ImageNet stem (a
+    ``family`` key dispatches at load); conv artifacts additionally carry
+    their freeze-time input resolution and padding-correction inputs.
+    Returns the size-info dict."""
     from flax import serialization
 
     frozen = _freeze_any(model, variables, input_shape)

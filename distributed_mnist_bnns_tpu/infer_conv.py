@@ -1,5 +1,6 @@
-"""Packed-bitplane serving for the binarized conv families (bnn-cnn and
-xnor-resnet18) — the conv extension of infer.py's MLP freeze.
+"""Packed-bitplane serving for the binarized conv families (bnn-cnn,
+xnor-resnet18 and the bottleneck xnor-resnet50) — the conv extension of
+infer.py's MLP freeze.
 
 Same deployment story (infer.py module doc): after training, the fp32
 latent masters are dead weight; hidden conv kernels pack to 1 bit per
@@ -238,7 +239,7 @@ def _build_cnn_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
 
 
 # ---------------------------------------------------------------------------
-# xnor-resnet (basic blocks)
+# xnor-resnet (basic AND bottleneck blocks, CIFAR or ImageNet stem)
 
 
 def _freeze_resnet_tensors(
@@ -332,12 +333,18 @@ def _freeze_resnet_tensors(
 def _build_resnet_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
     arch = frozen["arch"]
     ishape = tuple(int(d) for d in arch["input_shape"])
-    cifar_stem = bool(arch.get("cifar_stem", True))
+    cifar_stem = bool(arch["cifar_stem"])
     stem = _fp32_conv_fn(
         frozen["stem_w"], None, (1, 1) if cifar_stem else (2, 2)
     )
     blocks = []
     for blk in frozen["blocks"]:
+        if "convs" not in blk:
+            raise ValueError(
+                "stale xnor-resnet artifact schema (pre-bottleneck "
+                "per-block layout); re-export the checkpoint with "
+                "`cli export`"
+            )
         strides = int(blk["strides"])
         blocks.append({
             "convs": [
