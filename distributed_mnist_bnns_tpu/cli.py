@@ -140,6 +140,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     t = sub.add_parser("train", help="train a model")
     common(t)
+    t.add_argument("--stream", action="store_true",
+                   help="imagenet only: train on the streaming pipeline "
+                        "(decode per batch, whole dataset, never "
+                        "materialized; Trainer.fit_stream) with the "
+                        "materialized val subset for eval")
     e = sub.add_parser("eval", help="evaluate latest/best checkpoint")
     common(e)
     e.add_argument("--best", action="store_true")
@@ -320,6 +325,61 @@ def main(argv=None) -> int:
     import jax
 
     from .data import load_dataset
+
+    if getattr(args, "stream", False):
+        if args.dataset != "imagenet":
+            log.error("--stream is for `train --dataset imagenet`")
+            return 2
+        import numpy as np
+
+        from .data import open_imagenet_stream
+        from .data.common import ImageClassData
+
+        norm_kw = {"norm": args.norm} if args.norm else {}
+        stream = open_imagenet_stream(
+            args.data_dir, "train", image_size=args.image_size, **norm_kw
+        )
+        if stream is None:
+            log.error(
+                "--stream needs an on-disk ImageNet layout under %s "
+                "(train/<wnid>/ dirs or <wnid>.tar files)", args.data_dir,
+            )
+            return 2
+        # val subset for the eval pass: the val split indexed against the
+        # TRAIN stream's wnid label space (reusing the index in hand —
+        # no second walk of the train split). Without a val/ split, train
+        # without eval rather than fabricating a degenerate test set.
+        val = open_imagenet_stream(
+            args.data_dir, "val", image_size=args.image_size,
+            wnids=stream.index.wnids, **norm_kw,
+        )
+        if val is None:
+            log.warning(
+                "no val/ split under %s: training without eval (no "
+                "best-checkpoint tracking)", args.data_dir,
+            )
+            eval_data = None
+        else:
+            vx, vy = val.materialize(2048)
+            eval_data = ImageClassData(
+                np.zeros((1, *vx.shape[1:]), np.float32),
+                np.zeros(1, np.int32), vx, vy,
+                source="imagenet", name="imagenet",
+                n_classes=stream.n_classes,
+            )
+        log.info(
+            "streaming imagenet: %d train images (never materialized), "
+            "%s val, %d classes", len(stream),
+            len(eval_data.test_labels) if eval_data is not None else "no",
+            stream.n_classes,
+        )
+        trainer = _make_trainer(
+            args, input_shape=(args.image_size, args.image_size, 3),
+            num_classes=stream.n_classes,
+        )
+        history = trainer.fit_stream(stream, eval_data=eval_data)
+        log.info("final: %s", history[-1] if history else {})
+        return 0
 
     kwargs = {}
     if args.norm is not None:

@@ -330,3 +330,49 @@ class TestStreamingTrainer:
             ),
             jax.device_get(t1.state.params), jax.device_get(t2.state.params),
         )
+
+
+def test_cli_stream_flag(tmp_path, monkeypatch):
+    """cli train --dataset imagenet --stream: whole-dataset streaming
+    training from the CLI (folder layout on disk, val eval)."""
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    _make_folder_layout(tmp_path / "inet", n_per_class=4)
+    rc = main(
+        ["train", "--model", "bnn-cnn",
+         "--dataset", "imagenet", "--stream", "--image-size", "28",
+         "--data-dir", str(tmp_path / "inet"),
+         "--epochs", "1", "--batch-size", "4", "--backend", "xla",
+         "--log-file", str(tmp_path / "log.txt")]
+    )
+    assert rc == 0
+
+
+def test_cli_stream_flag_requires_layout(tmp_path, monkeypatch):
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        ["train", "--dataset", "imagenet", "--stream",
+         "--data-dir", str(tmp_path / "none"),
+         "--log-file", str(tmp_path / "log.txt")]
+    )
+    assert rc == 2
+
+
+def test_cli_stream_without_val_trains_evalless(tmp_path, monkeypatch):
+    """--stream with a train-only layout (e.g. the tar download) trains
+    without eval instead of fabricating a degenerate test set."""
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    _make_tar_layout(tmp_path / "inet", n_per_class=4)
+    rc = main(
+        ["train", "--model", "bnn-cnn",
+         "--dataset", "imagenet", "--stream", "--image-size", "28",
+         "--data-dir", str(tmp_path / "inet"),
+         "--epochs", "1", "--batch-size", "4", "--backend", "xla",
+         "--log-file", str(tmp_path / "log.txt")]
+    )
+    assert rc == 0
