@@ -156,9 +156,18 @@ def test_bnn_vit_flash_forward_on_chip():
         assert np.isfinite(np.asarray(out)).all()
         return np.asarray(caps[0])
 
-    np.testing.assert_allclose(
-        attn_cores(flash), attn_cores(xla), atol=5e-4, rtol=5e-4
-    )
+    got, want = attn_cores(flash), attn_cores(xla)
+    # Tolerance is hardware-scaled, not the fp32-level 5e-4 the interpret
+    # path satisfies: on a real chip BOTH attention paths feed the MXU,
+    # which rounds fp32 operands to bf16 under jax's default matmul
+    # precision, and the two contraction schedules (blockwise online
+    # softmax vs one-shot) accumulate those roundings differently. The
+    # divergence bound is a few bf16 ulps of the tensor scale
+    # (eps_bf16 = 2^-8 ~ 3.9e-3; measured max |diff| ~ 0.07 at scale ~28,
+    # i.e. ~0.6 ulp). atol = 1e-2 * scale keeps the assertion meaningful
+    # (an indexing or masking bug shifts values by O(scale), 100x above).
+    scale = float(np.abs(want).max())
+    np.testing.assert_allclose(got, want, atol=1e-2 * scale, rtol=2e-2)
 
 
 @pytest.mark.parametrize("causal", [False, True])
