@@ -252,6 +252,13 @@ def _gemm_crossover(jax, jnp, deadline: float, reps: int = 3):
     return out
 
 
+def _utc_now(epoch_s: float | None = None) -> str:
+    return time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ",
+        time.gmtime(epoch_s) if epoch_s is not None else time.gmtime(),
+    )
+
+
 def _device_responsive(timeout_s: float) -> bool:
     """Probe the default jax backend in a CHILD process with a hard
     timeout. A degraded remote-TPU tunnel hangs dispatches indefinitely
@@ -655,6 +662,7 @@ def main() -> None:
         if not alive:
             result = {
                 "metric": "train_throughput_mnist_bnn_mlp_large",
+                "ts": _utc_now(),
                 "value": None, "unit": "images/sec", "vs_baseline": None,
                 "note": "device endpoint unresponsive: a 128x128 matmul "
                         f"did not complete in {args.probe_timeout:.0f}s in "
@@ -664,6 +672,54 @@ def main() -> None:
                         "possible",
                 "probe_log": probe_log,
             }
+            # The endpoint comes and goes in windows (ENDPOINT_LOG.md).
+            # If a full hardware measurement was captured during a live
+            # window (the builder saves bench output as
+            # BENCH_LOCAL_r*.json), point at the latest ROUND's record
+            # that holds a real (non-null) measurement so a dead
+            # end-of-round window doesn't erase the hardware evidence.
+            # Ordering is by the round number in the filename (file
+            # mtimes are not preserved by git); captured_at prefers the
+            # record's own "ts" stamp, falling back to mtime only for
+            # records written before the stamp existed.
+            import glob
+            import re
+
+            here = os.path.dirname(os.path.abspath(__file__))
+            for local in sorted(
+                glob.glob(os.path.join(here, "BENCH_LOCAL_r*.json")),
+                key=lambda p: (
+                    int(m.group(1))
+                    if (m := re.search(r"_r(\d+)", os.path.basename(p)))
+                    else -1
+                ),
+                reverse=True,
+            ):
+                try:
+                    with open(local) as f:
+                        rec = json.load(f)
+                except Exception:
+                    continue
+                if rec.get("value") is None:
+                    continue  # a saved dead-window record is not evidence
+                if rec.get("metric") != result["metric"]:
+                    continue  # different benchmark, not this evidence
+                result["last_hardware_measurement"] = {
+                    "source": os.path.basename(local),
+                    "metric": rec.get("metric"),
+                    "captured_at": rec.get("ts") or _utc_now(
+                        os.path.getmtime(local)
+                    ),
+                    "value": rec.get("value"),
+                    "unit": rec.get("unit"),
+                    "vs_baseline": rec.get("vs_baseline"),
+                    "mfu": rec.get("mfu"),
+                    "device": rec.get("device"),
+                    "note": "captured by this same harness during an "
+                            "earlier live endpoint window; full "
+                            "record in the file",
+                }
+                break
             try:
                 result["cpu_fallback"] = _cpu_fallback_extras(args)
             except Exception as e:
@@ -763,6 +819,7 @@ def main() -> None:
     )
     result = {
         "metric": metric_name,
+        "ts": _utc_now(),
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": (

@@ -1,0 +1,61 @@
+"""Background TPU-endpoint availability probe (evidence for ENDPOINT_LOG.md).
+
+Appends one JSON line per probe to the path given as argv[1] (default
+endpoint_probes.jsonl). Each probe reuses bench.py's ``_device_responsive``
+— a subprocess running a 128x128 matmul under a hard timeout — with
+``JAX_PLATFORMS`` forced to the remote-TPU platform (``axon``) so a CPU
+fallback can never be logged as a live endpoint. Run it nohup'd during
+build sessions so chip-availability windows (and outages) are documented
+wall-to-wall; fold the resulting lines into ENDPOINT_LOG.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (the repo-root harness; shares its probe)
+
+
+def probe_once(timeout_s: float) -> dict:
+    t0 = time.time()
+    alive = bench._device_responsive(timeout_s)
+    return {
+        "ts": bench._utc_now(),
+        "alive": alive,
+        "probe_s": round(time.time() - t0, 1),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("out", nargs="?", default="endpoint_probes.jsonl")
+    p.add_argument("--interval-s", type=float, default=600.0)
+    p.add_argument("--timeout", type=float, default=90.0)
+    p.add_argument("--count", type=int, default=0,
+                   help="number of probes (0 = run forever)")
+    p.add_argument("--platform", default="axon",
+                   help="JAX platform the probe subprocess pins (the "
+                        "remote-TPU plugin registers as 'axon')")
+    args = p.parse_args()
+    # _device_responsive's child honors JAX_PLATFORMS via pin_platform;
+    # force it here so the probe answers "is the TPU endpoint up", not
+    # "does any backend work".
+    os.environ["JAX_PLATFORMS"] = args.platform
+    n = 0
+    while True:
+        rec = probe_once(args.timeout)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        n += 1
+        if args.count and n >= args.count:
+            break
+        time.sleep(max(0.0, args.interval_s - rec["probe_s"]))
+
+
+if __name__ == "__main__":
+    main()
