@@ -352,6 +352,30 @@ def _chip_peak_bf16(device) -> float | None:
     return None
 
 
+# int8 MXU peak relative to bf16: 2x on v5e/v5p/v6 (the generations with
+# a doubled int8 pipeline), 1x on v4 and earlier.
+_INT8_MULT = (
+    ("v5", 2.0), ("v6", 2.0), ("trillium", 2.0),
+    ("v4", 1.0), ("v3", 1.0), ("v2", 1.0),
+)
+
+
+def _chip_peak(device, backend: str) -> tuple[float | None, str]:
+    """Precision-matched MXU peak for MFU accounting: the int8 pipeline's
+    peak for the int8 backend, the dense bf16 peak for everything else
+    (the xnor/pallas_xnor backends run on the VPU but are still scored
+    against the bf16 MXU peak — that IS the machine's dense capability
+    the kernel is competing with)."""
+    peak = _chip_peak_bf16(device)
+    if peak is None:
+        return None, "unknown"
+    if backend == "int8":
+        kind = (getattr(device, "device_kind", "") or str(device)).lower()
+        mult = next((m for sub, m in _INT8_MULT if sub in kind), 1.0)
+        return peak * mult, "int8"
+    return peak, "bf16"
+
+
 def _dense_macs_per_image(params) -> int:
     """Analytic per-image MAC count of every Dense kernel in the model
     (rank-2 (in, out) kernels contribute in*out MACs per image). Exact
@@ -594,7 +618,7 @@ def _bench_device_epoch(args, deadline):
         "vs_reference_epoch_s": 8.25,
         "mfu": _mfu(
             flops_info[0] if flops_info else None, dt,
-            _chip_peak_bf16(jax.devices()[0]),
+            _chip_peak(jax.devices()[0], args.backend)[0],
         ),
     }
 
@@ -611,6 +635,10 @@ def main() -> None:
                         "measurement (0 = per-step dispatch only)")
     from distributed_mnist_bnns_tpu.ops.xnor_gemm import BACKENDS
 
+    # bf16 is the measured-fastest headline backend for TRAINING: the
+    # backward GEMMs (gradients are not +-1) must run bf16 regardless,
+    # and an interleaved on-chip A/B (PERF.md, round 4) shows the pure
+    # bf16 step beats the mixed int8-forward step by ~12%.
     p.add_argument("--backend", default="bf16", choices=list(BACKENDS))
     p.add_argument("--model", default="bnn-mlp-large")
     p.add_argument("--input-shape", type=int, nargs=3, default=None,
@@ -838,8 +866,10 @@ def main() -> None:
         # measurement floor); >0 = device-resident scan of that length.
         "scan_steps": scan_used,
     }
-    # MFU: achieved model FLOPs/s over the chip's dense bf16 peak.
-    chip_peak = _chip_peak_bf16(jax.devices()[0])
+    # MFU: achieved model FLOPs/s over the chip's precision-matched MXU
+    # peak (int8 pipeline peak for the int8 backend, dense bf16 peak
+    # otherwise).
+    chip_peak, peak_precision = _chip_peak(jax.devices()[0], args.backend)
     flops_info = _step_flops(headline_trainer, args.batch_size)
     if flops_info is not None:
         step_flops, flops_method = flops_info
@@ -848,11 +878,12 @@ def main() -> None:
             "step_flops": step_flops,
             "flops_method": flops_method,
             "model_tflops_per_sec": round(step_flops / step_time / 1e12, 2),
-            "chip_peak_bf16_tflops": (
+            "chip_peak_tflops": (
                 round(chip_peak / 1e12, 1) if chip_peak else None
             ),
-            "note": "MFU vs dense bf16 MXU peak; the int8 backend's "
-                    "precision-matched peak is 2x, halve its MFU reading",
+            "peak_precision": peak_precision,
+            "note": "MFU vs the precision-matched MXU peak for the "
+                    "headline backend",
         }
     if probe_log is not None:
         result["probe_attempts"] = len(probe_log)
@@ -941,12 +972,13 @@ def main() -> None:
                 per_backend[b] = "below measurement floor"
                 continue
             b_flops = _step_flops(b_trainer, args.batch_size)
+            b_peak, _ = _chip_peak(jax.devices()[0], b)
             per_backend[b] = {
                 "images_per_sec": round(args.batch_size / dt, 1),
                 "step_time_ms": round(dt * 1e3, 3),
                 "scan_steps": b_scan,
                 "mfu": _mfu(
-                    b_flops[0] if b_flops else None, dt, chip_peak
+                    b_flops[0] if b_flops else None, dt, b_peak
                 ),
             }
         result["train_step_per_backend"] = per_backend

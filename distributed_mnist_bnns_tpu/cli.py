@@ -150,9 +150,9 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--best", action="store_true")
     x = sub.add_parser(
         "export",
-        help="freeze a trained BNN checkpoint (bnn-mlp, bnn-cnn or "
-             "xnor-resnet18) into the packed 1-bit serving artifact "
-             "(infer.load_packed)",
+        help="freeze a trained BNN checkpoint (bnn-mlp, bnn-cnn, "
+             "xnor-resnet or bnn-vit) into the packed 1-bit serving "
+             "artifact (infer.load_packed)",
     )
     common(x)
     x.add_argument("--best", action="store_true")

@@ -168,8 +168,10 @@ def freeze_bnn_mlp(
 def _freeze_any(model, variables, input_shape=None) -> Dict[str, Any]:
     """Family dispatch: frozen-tensor dict for every freezable model."""
     from .infer_conv import _freeze_cnn_tensors, _freeze_resnet_tensors
+    from .infer_transformer import _freeze_lm_tensors, _freeze_vit_tensors
     from .models.bnn_cnn import BinarizedCNN
     from .models.resnet import XnorResNet
+    from .models.transformer import BinarizedLM, BinarizedTransformer
 
     if isinstance(model, BnnMLP):
         return _freeze_tensors(model, variables)
@@ -181,9 +183,13 @@ def _freeze_any(model, variables, input_shape=None) -> Dict[str, Any]:
         return _freeze_resnet_tensors(
             model, variables, input_shape or (32, 32, 3)
         )
+    if isinstance(model, BinarizedTransformer):
+        return _freeze_vit_tensors(model, variables)
+    if isinstance(model, BinarizedLM):
+        return _freeze_lm_tensors(model, variables)
     raise ValueError(
         f"no packed freeze for {type(model).__name__} (freezable: BnnMLP, "
-        "BinarizedCNN, XnorResNet)"
+        "BinarizedCNN, XnorResNet, BinarizedTransformer, BinarizedLM)"
     )
 
 
@@ -197,6 +203,10 @@ def _build_any(frozen: Dict[str, Any], interpret: bool) -> Callable:
         return _build_cnn_apply(frozen, interpret)
     if family == "xnor-resnet":
         return _build_resnet_apply(frozen, interpret)
+    if family == "bnn-transformer":
+        from .infer_transformer import _build_transformer_apply
+
+        return _build_transformer_apply(frozen, interpret)
     raise ValueError(f"unknown packed-artifact family {family!r}")
 
 
@@ -206,11 +216,12 @@ def export_packed(
     """Write the frozen packed artifact to ``path`` (msgpack). The file
     holds the 1-bit hidden weights, ±1 first layer, raw BN moments and the
     fp32 head — everything ``load_packed`` needs, nothing else (no latent
-    masters, no optimizer state). Covers the MLP, CNN and XNOR-ResNet
-    families — basic-block and bottleneck, CIFAR or ImageNet stem (a
-    ``family`` key dispatches at load); conv artifacts additionally carry
-    their freeze-time input resolution and padding-correction inputs.
-    Returns the size-info dict."""
+    masters, no optimizer state). Covers the MLP, CNN, XNOR-ResNet
+    (basic-block and bottleneck, CIFAR or ImageNet stem) and transformer
+    (vit + causal LM) families — a ``family`` key dispatches at load;
+    conv artifacts additionally carry their freeze-time input resolution
+    and padding-correction inputs, transformer artifacts their LN/embed
+    fp32 tensors. Returns the size-info dict."""
     from flax import serialization
 
     frozen = _freeze_any(model, variables, input_shape)
@@ -223,6 +234,8 @@ def export_packed(
         frozen["w1"] = frozen["w1"].astype(np.int8)
     if "conv1_w" in frozen:
         frozen["conv1_w"] = frozen["conv1_w"].astype(np.int8)
+    if "w_embed" in frozen:
+        frozen["w_embed"] = frozen["w_embed"].astype(np.int8)
     with open(path, "wb") as f:
         f.write(serialization.msgpack_serialize(frozen))
     return frozen["info"]

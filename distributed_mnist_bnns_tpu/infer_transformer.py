@@ -1,0 +1,331 @@
+"""Packed 1-bit serving for the transformer families (BinarizedTransformer
+vit + BinarizedLM) — completing frozen-inference coverage of the model zoo
+(infer.py: MLP; infer_conv.py: CNN/ResNet; here: attention models).
+
+No reference counterpart (the reference stops at MLP/CNN training scripts
+— SURVEY §2.2). What freezes and what stays fp32 follows the family's own
+numerics contract (models/transformer.py): every Binarized projection
+(patch/q/k/v/out/mlp) drops its fp32 latent master and keeps only ±1
+weights — hidden projections pre-packed to 1-bit bitplanes
+(ops.prepack_weights, 32x smaller than fp32) and run on the packed XNOR
+kernel, which is the bandwidth-bound small-batch serving win (PERF.md §3)
+— while LayerNorm, the softmax attention core, residuals, embeddings and
+the head stay fp32 exactly as they do in the live eval forward.
+
+Unlike the MLP/conv families there is no BN→threshold folding here:
+LayerNorm statistics are data-dependent at inference (they normalize over
+the feature axis per token, not over a frozen batch population), so the
+frozen graph keeps real LNs and binarizes activations on the fly with the
+same deterministic sign the live eval path uses
+(models/layers._binarize_activations with no rng).
+
+The attention core always runs the exact-softmax oracle
+(models/transformer._attend_xla) — bit-identical to live models built with
+attention="xla" (the family default). Freezing a flash-attention-trained
+model serves fine but can differ by sign flips on few-ulp-boundary
+activations (the repo's attn_core numerics policy); freeze/compare against
+an attention="xla" twin if exact equality matters. The same caveat covers
+the bf16 backend's patch embedding: it casts raw pixels to bf16 (an
+AMP-style trade, models/layers._layer_backend) while the frozen graph dots
+them in fp32 — equality tests pin backend="xla".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .models.transformer import (
+    BinarizedLM,
+    BinarizedTransformer,
+    _attend_xla,
+)
+from .ops.binarize import binarize_ste
+from .ops.xnor_gemm import prepack_weights, xnor_matmul_packed
+
+
+def _freeze_dense(params: Dict, scale: bool) -> Dict[str, Any]:
+    """One hidden BinarizedDense -> packed bitplanes + fp32 bias (+ the
+    XNOR-Net alpha, precomputed from the latent master it replaces)."""
+    kernel = params["kernel"]
+    wp, k, n = prepack_weights(binarize_ste(kernel))
+    out = {"wp": wp, "k": k, "n": n, "bias": params["bias"]}
+    if scale:
+        out["alpha"] = jnp.abs(kernel).mean(axis=0)
+    return out
+
+
+def _packed_dense_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
+    """sign(x) @ packed-W (+ alpha) + b over any leading shape."""
+    wp = jnp.asarray(layer["wp"])
+    k, n = int(layer["k"]), int(layer["n"])
+    bias = jnp.asarray(layer["bias"], jnp.float32)
+    alpha = (
+        jnp.asarray(layer["alpha"], jnp.float32)
+        if layer.get("alpha") is not None else None
+    )
+
+    def fn(x: jnp.ndarray) -> jnp.ndarray:
+        bits = binarize_ste(x)
+        lead = bits.shape[:-1]
+        y = xnor_matmul_packed(
+            bits.reshape(-1, k), wp, k, n, interpret=interpret
+        )
+        y = y.reshape(*lead, n)
+        if alpha is not None:
+            y = y * alpha
+        return y + bias
+
+    return fn
+
+
+def _ln_fn(params: Dict) -> Callable:
+    """The real flax LayerNorm over frozen scale/bias — applied as a
+    module so the frozen graph's normalization is the live graph's."""
+    ln = nn.LayerNorm()
+    variables = {"params": {
+        "scale": jnp.asarray(params["scale"], jnp.float32),
+        "bias": jnp.asarray(params["bias"], jnp.float32),
+    }}
+    return lambda y: ln.apply(variables, y)
+
+
+def _check_freezable(model) -> None:
+    if model.stochastic:
+        raise ValueError(
+            "stochastic activation binarization is a train-time feature; "
+            "freeze the deterministic eval path"
+        )
+    if model.attention_fn is not None:
+        raise ValueError(
+            "attention_fn (ring/SP) is a training-topology override; "
+            "freeze the plain single-device model"
+        )
+
+
+def _freeze_blocks(params: Dict, depth: int, scale: bool) -> list:
+    """Frozen tensors for TransformerBlock_0..depth-1 (flax auto-names:
+    attention projections BinarizedSelfAttention_0/BinarizedDense_0..3 in
+    q,k,v,out order; MLP projections BinarizedDense_0..1 at block level —
+    models/transformer.py:153-186)."""
+    blocks = []
+    for i in range(depth):
+        bp = params[f"TransformerBlock_{i}"]
+        attn = bp["BinarizedSelfAttention_0"]
+        blocks.append({
+            "ln_attn": dict(bp["ln_attn"]),
+            "q": _freeze_dense(attn["BinarizedDense_0"], scale),
+            "k": _freeze_dense(attn["BinarizedDense_1"], scale),
+            "v": _freeze_dense(attn["BinarizedDense_2"], scale),
+            "out": _freeze_dense(attn["BinarizedDense_3"], scale),
+            "ln_mlp": dict(bp["ln_mlp"]),
+            "mlp1": _freeze_dense(bp["BinarizedDense_0"], scale),
+            "mlp2": _freeze_dense(bp["BinarizedDense_1"], scale),
+        })
+    return blocks
+
+
+def _binarized_kernel_bytes(params: Dict) -> int:
+    """fp32 bytes of every Binarized* latent kernel in the tree — the
+    masters the frozen artifact drops."""
+    total = 0
+    for name, sub in params.items():
+        if not isinstance(sub, dict):
+            continue
+        if name.startswith("Binarized") and "kernel" in sub:
+            total += int(jnp.asarray(sub["kernel"]).size) * 4
+        else:
+            total += _binarized_kernel_bytes(sub)
+    return total
+
+
+def _packed_bytes(frozen_blocks: list, embed_w=None) -> int:
+    per_block = sum(
+        int(jnp.asarray(b[key]["wp"]).size) * 4
+        for b in frozen_blocks
+        for key in ("q", "k", "v", "out", "mlp1", "mlp2")
+    )
+    if embed_w is not None:
+        per_block += int(jnp.asarray(embed_w).size) * 4
+    return per_block
+
+
+def _freeze_vit_tensors(
+    model: BinarizedTransformer, variables: Dict
+) -> Dict[str, Any]:
+    _check_freezable(model)
+    params = variables["params"]
+    blocks = _freeze_blocks(params, model.depth, model.scale)
+    # Patch embedding: binarized weights, raw-pixel input (first-layer
+    # passthrough) — ±1 fp32 in memory, int8 on disk (export_packed).
+    embed = params["BinarizedDense_0"]
+    w_embed = binarize_ste(embed["kernel"])
+    frozen: Dict[str, Any] = {
+        "family": "bnn-transformer",
+        "kind": "vit",
+        "patch_size": model.patch_size,
+        "num_heads": model.num_heads,
+        "causal": False,
+        "w_embed": w_embed,
+        "b_embed": embed["bias"],
+        "pos_embed": params["pos_embed"],
+        "blocks": blocks,
+        "ln_head": dict(params["ln_head"]),
+        "head_w": params["head"]["kernel"],
+        "head_b": params["head"]["bias"],
+    }
+    latent = _binarized_kernel_bytes(params)
+    packed = _packed_bytes(blocks, w_embed)
+    frozen["info"] = {
+        "family": "bnn-transformer",
+        "kind": "vit",
+        "latent_fp32_weight_bytes": latent,
+        "frozen_weight_bytes": packed,
+        "compression": round(latent / packed, 2),
+        "packed_layers": [
+            f"TransformerBlock_{i}.{k}"
+            for i in range(model.depth)
+            for k in ("q", "k", "v", "out", "mlp1", "mlp2")
+        ],
+    }
+    return frozen
+
+
+def _freeze_lm_tensors(model: BinarizedLM, variables: Dict) -> Dict[str, Any]:
+    _check_freezable(model)
+    params = variables["params"]
+    blocks = _freeze_blocks(params, model.depth, model.scale)
+    frozen: Dict[str, Any] = {
+        "family": "bnn-transformer",
+        "kind": "lm",
+        "num_heads": model.num_heads,
+        "causal": True,
+        "tok_embed": params["tok_embed"]["embedding"],
+        "pos_embed": params["pos_embed"],
+        "blocks": blocks,
+        "ln_head": dict(params["ln_head"]),
+        "head_w": params["head"]["kernel"],
+        "head_b": params["head"]["bias"],
+    }
+    latent = _binarized_kernel_bytes(params)
+    packed = _packed_bytes(blocks)
+    frozen["info"] = {
+        "family": "bnn-transformer",
+        "kind": "lm",
+        "latent_fp32_weight_bytes": latent,
+        "frozen_weight_bytes": packed,
+        "compression": round(latent / packed, 2),
+        "packed_layers": [
+            f"TransformerBlock_{i}.{k}"
+            for i in range(model.depth)
+            for k in ("q", "k", "v", "out", "mlp1", "mlp2")
+        ],
+    }
+    return frozen
+
+
+def _block_fn(blk: Dict[str, Any], num_heads: int, causal: bool,
+              interpret: bool) -> Callable:
+    ln_attn = _ln_fn(blk["ln_attn"])
+    ln_mlp = _ln_fn(blk["ln_mlp"])
+    q_fn = _packed_dense_fn(blk["q"], interpret)
+    k_fn = _packed_dense_fn(blk["k"], interpret)
+    v_fn = _packed_dense_fn(blk["v"], interpret)
+    out_fn = _packed_dense_fn(blk["out"], interpret)
+    mlp1 = _packed_dense_fn(blk["mlp1"], interpret)
+    mlp2 = _packed_dense_fn(blk["mlp2"], interpret)
+
+    def fn(x: jnp.ndarray) -> jnp.ndarray:
+        b, t, e = x.shape
+        d = e // num_heads
+        y = ln_attn(x)
+        q = q_fn(y).reshape(b, t, num_heads, d)
+        k = k_fn(y).reshape(b, t, num_heads, d)
+        v = v_fn(y).reshape(b, t, num_heads, d)
+        core = _attend_xla(q, k, v, causal=causal)
+        x = x + out_fn(core.reshape(b, t, e))
+        y = ln_mlp(x)
+        y = nn.hard_tanh(mlp1(y))
+        return x + mlp2(y)
+
+    return fn
+
+
+def _build_transformer_apply(
+    frozen: Dict[str, Any], interpret: bool
+) -> Callable:
+    """Jittable frozen forward from a ``bnn-transformer`` artifact
+    (in-memory or msgpack-restored)."""
+    kind = frozen.get("kind", "vit")
+    num_heads = int(frozen["num_heads"])
+    causal = bool(frozen["causal"])
+    blocks = [
+        _block_fn(blk, num_heads, causal, interpret)
+        for blk in frozen["blocks"]
+    ]
+    ln_head = _ln_fn(frozen["ln_head"])
+    head_w = jnp.asarray(frozen["head_w"], jnp.float32)
+    head_b = jnp.asarray(frozen["head_b"], jnp.float32)
+    pos = jnp.asarray(frozen["pos_embed"], jnp.float32)
+
+    if kind == "lm":
+        tok = jnp.asarray(frozen["tok_embed"], jnp.float32)
+        max_len = int(pos.shape[1])
+
+        def apply_fn(tokens: jnp.ndarray) -> jnp.ndarray:
+            t = tokens.shape[1]
+            if t > max_len:  # static shape: raises at trace time, like
+                raise ValueError(  # the live model (transformer.py:285)
+                    f"sequence length {t} > max_len {max_len}"
+                )
+            x = tok[tokens] + pos[:, :t]
+            for blk in blocks:
+                x = blk(x)
+            x = ln_head(x)
+            return nn.log_softmax(x @ head_w + head_b)
+
+        return jax.jit(apply_fn)
+
+    patch = int(frozen["patch_size"])
+    # NOTE: no alpha on the patch embedding — the live model never passes
+    # ``scale`` to it (models/transformer.py:224-230), only to the
+    # attention/MLP projections.
+    w_embed = jnp.asarray(frozen["w_embed"], jnp.float32)  # disk: int8 ±1
+    b_embed = jnp.asarray(frozen["b_embed"], jnp.float32)
+
+    def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, c = images.shape
+        nh, nw = h // patch, w // patch
+        x = images.reshape(b, nh, patch, nw, patch, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, -1)
+        x = x.astype(jnp.float32) @ w_embed
+        x = x + b_embed + pos
+        for blk in blocks:
+            x = blk(x)
+        x = ln_head(x).mean(axis=1)
+        return nn.log_softmax(x @ head_w + head_b)
+
+    return jax.jit(apply_fn)
+
+
+def freeze_bnn_vit(
+    model: BinarizedTransformer, variables: Dict, *, interpret: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained binarized vit into packed inference; matches
+    ``model.apply(variables, x, train=False)`` for attention="xla"
+    models (see module docstring for the flash caveat)."""
+    frozen = _freeze_vit_tensors(model, variables)
+    return _build_transformer_apply(frozen, interpret), frozen["info"]
+
+
+def freeze_bnn_lm(
+    model: BinarizedLM, variables: Dict, *, interpret: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained BinarizedLM into packed next-token inference:
+    ``fn(tokens) -> (B, T, vocab)`` log-probs, a drop-in predictor for
+    autoregressive sampling (the --sample loop in examples/lm_demo.run)."""
+    frozen = _freeze_lm_tensors(model, variables)
+    return _build_transformer_apply(frozen, interpret), frozen["info"]

@@ -1,0 +1,208 @@
+"""Packed serving for the transformer families (infer_transformer.py):
+frozen vit/LM must match their live eval forward, and the packed artifact
+must round-trip through export/load — completing frozen-inference coverage
+of the model zoo (MLP: test_infer.py, conv: test_infer_conv.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.infer import export_packed, load_packed
+from distributed_mnist_bnns_tpu.infer_transformer import (
+    freeze_bnn_lm,
+    freeze_bnn_vit,
+)
+from distributed_mnist_bnns_tpu.models.transformer import (
+    BinarizedLM,
+    bnn_vit_tiny,
+)
+
+
+def _train(model, variables, batch, loss_of_output, steps=3, seed=0):
+    """A few real clamped train steps so latents/LN params are non-trivial
+    (fresh inits can mask freeze bugs behind symmetric values)."""
+    import optax
+
+    from distributed_mnist_bnns_tpu.models import latent_clamp_mask
+    from distributed_mnist_bnns_tpu.train import clamp_latent
+
+    params = variables["params"]
+    mask = latent_clamp_mask(params)
+    tx = optax.adam(0.01)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            out = model.apply({"params": p}, batch, train=True)
+            return loss_of_output(out)
+
+        g = jax.grad(loss_fn)(params)
+        up, opt = tx.update(g, opt, params)
+        return clamp_latent(optax.apply_updates(params, up), mask), opt
+
+    for _ in range(steps):
+        params, opt = step(params, opt)
+    return {"params": params}
+
+
+class TestFrozenViT:
+    def _setup(self, **kw):
+        # backend="xla": the fp32 GEMM path, exact on the raw-pixel patch
+        # embedding — the global bf16 default casts raw pixels to bf16
+        # while the frozen graph dots them in fp32, and that ulp-level
+        # gap flips downstream sign bits (same pinning as TestFrozenCNN).
+        model = bnn_vit_tiny(attention="xla", backend="xla", **kw)
+        x = jax.random.normal(
+            jax.random.PRNGKey(3), (4, 28, 28, 1), jnp.float32
+        )
+        labels = jax.random.randint(jax.random.PRNGKey(4), (4,), 0, 10)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0)}, x, train=True
+        )
+
+        def loss(out):
+            return -jnp.take_along_axis(
+                out, labels[:, None], axis=-1
+            ).mean()
+
+        variables = _train(model, variables, x, loss)
+        return model, variables, x
+
+    def test_frozen_vit_matches_live_eval(self):
+        model, variables, x = self._setup()
+        live = model.apply(variables, x, train=False)
+        frozen_fn, info = freeze_bnn_vit(model, variables, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(frozen_fn(x)), np.asarray(live),
+            atol=1e-4, rtol=1e-4,
+        )
+        # 6 packed projections per block dominate; patch embed stays ±1
+        # fp32 in memory, so compression is the hidden/embed ratio.
+        assert info["compression"] > 5
+        assert info["kind"] == "vit"
+
+    def test_alpha_scale_supported(self):
+        model, variables, x = self._setup(scale=True)
+        live = model.apply(variables, x, train=False)
+        frozen_fn, _ = freeze_bnn_vit(model, variables, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(frozen_fn(x)), np.asarray(live),
+            atol=1e-4, rtol=1e-4,
+        )
+
+    def test_export_load_roundtrip(self, tmp_path):
+        model, variables, x = self._setup()
+        live = model.apply(variables, x, train=False)
+        path = str(tmp_path / "vit.packed")
+        info = export_packed(model, variables, path)
+        assert info["family"] == "bnn-transformer"
+        fn, info2 = load_packed(path, interpret=True)
+        assert info2["compression"] == info["compression"]
+        np.testing.assert_allclose(
+            np.asarray(fn(x)), np.asarray(live), atol=1e-4, rtol=1e-4
+        )
+
+    def test_stochastic_rejected(self):
+        model = bnn_vit_tiny(attention="xla", stochastic=True)
+        x = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0), "binarize": jax.random.PRNGKey(1)},
+            x, train=True,
+        )
+        with pytest.raises(ValueError, match="stochastic"):
+            freeze_bnn_vit(model, variables)
+
+    def test_ring_attention_fn_rejected(self):
+        model = bnn_vit_tiny(attention_fn=lambda q, k, v: q)
+        x = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+        with pytest.raises(ValueError, match="attention_fn"):
+            freeze_bnn_vit(model, variables)
+
+
+class TestFrozenLM:
+    def _setup(self):
+        from distributed_mnist_bnns_tpu.models import lm_loss
+
+        model = BinarizedLM(
+            vocab=64, max_len=32, embed_dim=64, depth=2, num_heads=2,
+            attention="xla", backend="xla",
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(5), (4, 32), 0, 64
+        )
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0)}, tokens, train=True
+        )
+        variables = _train(
+            model, variables, tokens, lambda out: lm_loss(out, tokens)
+        )
+        return model, variables, tokens
+
+    def test_frozen_lm_matches_live_eval(self):
+        model, variables, tokens = self._setup()
+        live = model.apply(variables, tokens, train=False)
+        frozen_fn, info = freeze_bnn_lm(model, variables, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(frozen_fn(tokens)), np.asarray(live),
+            atol=1e-4, rtol=1e-4,
+        )
+        assert info["kind"] == "lm"
+        assert info["compression"] > 5
+
+    def test_export_load_roundtrip(self, tmp_path):
+        model, variables, tokens = self._setup()
+        live = model.apply(variables, tokens, train=False)
+        path = str(tmp_path / "lm.packed")
+        info = export_packed(model, variables, path)
+        fn, info2 = load_packed(path, interpret=True)
+        assert info2["kind"] == "lm"
+        assert info2["compression"] == info["compression"]
+        np.testing.assert_allclose(
+            np.asarray(fn(tokens)), np.asarray(live), atol=1e-4, rtol=1e-4
+        )
+
+    def test_frozen_lm_generates(self):
+        """The frozen predictor drives autoregressive sampling end to
+        end (greedy over the last position, growing window)."""
+        model, variables, _ = self._setup()
+        frozen_fn, _ = freeze_bnn_lm(model, variables, interpret=True)
+        window = jnp.array([[1, 2, 3]], jnp.int32)
+        for _ in range(5):
+            lp = frozen_fn(window)
+            nxt = jnp.argmax(lp[:, -1], axis=-1).astype(jnp.int32)
+            window = jnp.concatenate([window, nxt[:, None]], axis=1)
+        assert window.shape == (1, 8)
+        assert ((window >= 0) & (window < 64)).all()
+
+
+def test_cli_export_vit(tmp_path, monkeypatch):
+    """CLI export subcommand freezes a CLI-trained bnn-vit-tiny end to
+    end (the transformer families ride the same train->export->serve
+    path as the MLP/conv families)."""
+    import numpy as np
+
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    common = [
+        "--model", "bnn-vit-tiny", "--epochs", "1", "--batch-size", "32",
+        "--backend", "xla", "--data-dir", "/nonexistent_use_synth",
+        "--synthetic-sizes", "128", "32",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    rc = main(["train", *common, "--log-file", str(tmp_path / "l1.txt")])
+    assert rc == 0
+    out = str(tmp_path / "vit.msgpack")
+    rc = main(
+        ["export", *common, "--out", out,
+         "--log-file", str(tmp_path / "l2.txt")]
+    )
+    assert rc == 0
+    fn, info = load_packed(out, interpret=True)
+    assert info["family"] == "bnn-transformer"
+    assert info["kind"] == "vit"
+    x = np.random.RandomState(0).rand(4, 28, 28, 1).astype(np.float32)
+    assert np.isfinite(np.asarray(fn(x))).all()
