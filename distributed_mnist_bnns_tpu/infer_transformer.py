@@ -153,6 +153,25 @@ def _packed_bytes(frozen_blocks: list, embed_w=None) -> int:
     return per_block
 
 
+def _freeze_info(params: Dict, blocks: list, kind: str, depth: int,
+                 embed_w=None) -> Dict[str, Any]:
+    """The artifact's size-accounting dict, shared by both freezers."""
+    latent = _binarized_kernel_bytes(params)
+    packed = _packed_bytes(blocks, embed_w)
+    return {
+        "family": "bnn-transformer",
+        "kind": kind,
+        "latent_fp32_weight_bytes": latent,
+        "frozen_weight_bytes": packed,
+        "compression": round(latent / packed, 2),
+        "packed_layers": [
+            f"TransformerBlock_{i}.{k}"
+            for i in range(depth)
+            for k in ("q", "k", "v", "out", "mlp1", "mlp2")
+        ],
+    }
+
+
 def _freeze_vit_tensors(
     model: BinarizedTransformer, variables: Dict
 ) -> Dict[str, Any]:
@@ -177,20 +196,8 @@ def _freeze_vit_tensors(
         "head_w": params["head"]["kernel"],
         "head_b": params["head"]["bias"],
     }
-    latent = _binarized_kernel_bytes(params)
-    packed = _packed_bytes(blocks, w_embed)
-    frozen["info"] = {
-        "family": "bnn-transformer",
-        "kind": "vit",
-        "latent_fp32_weight_bytes": latent,
-        "frozen_weight_bytes": packed,
-        "compression": round(latent / packed, 2),
-        "packed_layers": [
-            f"TransformerBlock_{i}.{k}"
-            for i in range(model.depth)
-            for k in ("q", "k", "v", "out", "mlp1", "mlp2")
-        ],
-    }
+    frozen["info"] = _freeze_info(params, blocks, "vit", model.depth,
+                                  embed_w=w_embed)
     return frozen
 
 
@@ -210,20 +217,7 @@ def _freeze_lm_tensors(model: BinarizedLM, variables: Dict) -> Dict[str, Any]:
         "head_w": params["head"]["kernel"],
         "head_b": params["head"]["bias"],
     }
-    latent = _binarized_kernel_bytes(params)
-    packed = _packed_bytes(blocks)
-    frozen["info"] = {
-        "family": "bnn-transformer",
-        "kind": "lm",
-        "latent_fp32_weight_bytes": latent,
-        "frozen_weight_bytes": packed,
-        "compression": round(latent / packed, 2),
-        "packed_layers": [
-            f"TransformerBlock_{i}.{k}"
-            for i in range(model.depth)
-            for k in ("q", "k", "v", "out", "mlp1", "mlp2")
-        ],
-    }
+    frozen["info"] = _freeze_info(params, blocks, "lm", model.depth)
     return frozen
 
 

@@ -1,0 +1,67 @@
+"""Shared few-step training fixture for the frozen-inference tests
+(test_infer_conv.py, test_infer_transformer.py): real clamped train steps
+so latents/BN-or-LN state are non-trivial — fresh inits have degenerate
+values that mask freeze bugs."""
+
+import jax
+
+
+def trained_variables(model, batch, loss_of_output, *, steps=3, seed=0,
+                      init_rngs=None):
+    """Run ``steps`` clamped adam steps of ``model`` on ``batch``.
+
+    ``loss_of_output`` maps the model output to a scalar loss. Handles
+    both stateful models (BN: mutable batch_stats threaded through) and
+    stateless ones (LN-only transformers). Returns the trained variables
+    dict ({"params": ...} plus "batch_stats" when the model has them).
+    """
+    import optax
+
+    from distributed_mnist_bnns_tpu.models import latent_clamp_mask
+    from distributed_mnist_bnns_tpu.train import clamp_latent
+
+    rngs = init_rngs or {
+        "params": jax.random.PRNGKey(seed),
+        "dropout": jax.random.PRNGKey(seed + 1),
+    }
+    variables = model.init(rngs, batch, train=True)
+    params = variables["params"]
+    stats = variables.get("batch_stats")
+    mask = latent_clamp_mask(params)
+    tx = optax.adam(0.01)
+    opt = tx.init(params)
+
+    if stats is not None:
+        @jax.jit
+        def step(params, stats, opt):
+            def loss_fn(p):
+                out, mut = model.apply(
+                    {"params": p, "batch_stats": stats}, batch, train=True,
+                    mutable=["batch_stats"],
+                )
+                return loss_of_output(out), mut["batch_stats"]
+
+            (_, new_stats), g = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            up, opt = tx.update(g, opt, params)
+            params = clamp_latent(optax.apply_updates(params, up), mask)
+            return params, new_stats, opt
+
+        for _ in range(steps):
+            params, stats, opt = step(params, stats, opt)
+        return {"params": params, "batch_stats": stats}
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            out = model.apply({"params": p}, batch, train=True)
+            return loss_of_output(out)
+
+        g = jax.grad(loss_fn)(params)
+        up, opt = tx.update(g, opt, params)
+        return clamp_latent(optax.apply_updates(params, up), mask), opt
+
+    for _ in range(steps):
+        params, opt = step(params, opt)
+    return {"params": params}

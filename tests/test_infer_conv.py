@@ -17,42 +17,17 @@ from distributed_mnist_bnns_tpu.models.resnet import xnor_resnet18
 
 
 def _trained_variables(model, x, steps=3, seed=0):
-    """A few real train steps so BN stats/latents are non-trivial (fresh
-    inits have degenerate stats that mask folding bugs)."""
-    import optax
+    """Few real train steps (shared fixture: tests/infer_train_util.py)."""
+    import jax
 
-    from distributed_mnist_bnns_tpu.models import latent_clamp_mask
     from distributed_mnist_bnns_tpu.ops.losses import cross_entropy_loss
-    from distributed_mnist_bnns_tpu.train import clamp_latent
+    from tests.infer_train_util import trained_variables
 
-    rng = jax.random.PRNGKey(seed)
-    variables = model.init(
-        {"params": rng, "dropout": jax.random.PRNGKey(seed + 1)},
-        x, train=True,
-    )
-    params, stats = variables["params"], variables["batch_stats"]
-    mask = latent_clamp_mask(params)
     labels = jax.random.randint(jax.random.PRNGKey(2), (x.shape[0],), 0, 10)
-    tx = optax.adam(0.01)
-    opt = tx.init(params)
-
-    @jax.jit
-    def step(params, stats, opt):
-        def loss_fn(p):
-            out, mut = model.apply(
-                {"params": p, "batch_stats": stats}, x, train=True,
-                mutable=["batch_stats"],
-            )
-            return cross_entropy_loss(out, labels), mut["batch_stats"]
-
-        (_, new_stats), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        up, opt = tx.update(g, opt, params)
-        params = clamp_latent(optax.apply_updates(params, up), mask)
-        return params, new_stats, opt
-
-    for _ in range(steps):
-        params, stats, opt = step(params, stats, opt)
-    return {"params": params, "batch_stats": stats}
+    return trained_variables(
+        model, x, lambda out: cross_entropy_loss(out, labels),
+        steps=steps, seed=seed,
+    )
 
 
 class TestFrozenCNN:

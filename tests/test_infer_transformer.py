@@ -17,34 +17,7 @@ from distributed_mnist_bnns_tpu.models.transformer import (
     BinarizedLM,
     bnn_vit_tiny,
 )
-
-
-def _train(model, variables, batch, loss_of_output, steps=3, seed=0):
-    """A few real clamped train steps so latents/LN params are non-trivial
-    (fresh inits can mask freeze bugs behind symmetric values)."""
-    import optax
-
-    from distributed_mnist_bnns_tpu.models import latent_clamp_mask
-    from distributed_mnist_bnns_tpu.train import clamp_latent
-
-    params = variables["params"]
-    mask = latent_clamp_mask(params)
-    tx = optax.adam(0.01)
-    opt = tx.init(params)
-
-    @jax.jit
-    def step(params, opt):
-        def loss_fn(p):
-            out = model.apply({"params": p}, batch, train=True)
-            return loss_of_output(out)
-
-        g = jax.grad(loss_fn)(params)
-        up, opt = tx.update(g, opt, params)
-        return clamp_latent(optax.apply_updates(params, up), mask), opt
-
-    for _ in range(steps):
-        params, opt = step(params, opt)
-    return {"params": params}
+from tests.infer_train_util import trained_variables
 
 
 class TestFrozenViT:
@@ -58,16 +31,14 @@ class TestFrozenViT:
             jax.random.PRNGKey(3), (4, 28, 28, 1), jnp.float32
         )
         labels = jax.random.randint(jax.random.PRNGKey(4), (4,), 0, 10)
-        variables = model.init(
-            {"params": jax.random.PRNGKey(0)}, x, train=True
-        )
-
         def loss(out):
             return -jnp.take_along_axis(
                 out, labels[:, None], axis=-1
             ).mean()
 
-        variables = _train(model, variables, x, loss)
+        variables = trained_variables(
+            model, x, loss, init_rngs={"params": jax.random.PRNGKey(0)}
+        )
         return model, variables, x
 
     def test_frozen_vit_matches_live_eval(self):
@@ -133,11 +104,9 @@ class TestFrozenLM:
         tokens = jax.random.randint(
             jax.random.PRNGKey(5), (4, 32), 0, 64
         )
-        variables = model.init(
-            {"params": jax.random.PRNGKey(0)}, tokens, train=True
-        )
-        variables = _train(
-            model, variables, tokens, lambda out: lm_loss(out, tokens)
+        variables = trained_variables(
+            model, tokens, lambda out: lm_loss(out, tokens),
+            init_rngs={"params": jax.random.PRNGKey(0)},
         )
         return model, variables, tokens
 
