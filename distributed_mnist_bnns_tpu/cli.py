@@ -192,6 +192,10 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--sample", type=int, default=0,
                     help="generate N tokens after training")
     lm.add_argument("--temperature", type=float, default=0.8)
+    lm.add_argument("--export", default=None, metavar="PATH",
+                    help="freeze the trained LM to a packed 1-bit "
+                         "serving artifact (KV-cache decoding: "
+                         "infer_transformer.make_lm_decoder)")
     lm.add_argument("--log-interval", type=int, default=25)
     lm.add_argument("--log-file", default="log.txt")
     return p
@@ -290,7 +294,7 @@ def main(argv=None) -> int:
             num_heads=args.num_heads, lr=args.lr, seed=args.seed,
             attention=args.attention, ring=args.ring, corpus=args.corpus,
             pp=args.pp, log_every=args.log_interval, sample=args.sample,
-            temperature=args.temperature,
+            temperature=args.temperature, export=args.export,
         )
         log.info("lm final next-token loss: %.4f", history[-1])
         return 0

@@ -23,7 +23,8 @@ import argparse
 
 def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
         num_heads=4, lr=3e-3, seed=0, attention="xla", ring=False,
-        log_every=25, corpus=None, pp=1, sample=0, temperature=0.8):
+        log_every=25, corpus=None, pp=1, sample=0, temperature=0.8,
+        export=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -34,6 +35,11 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
 
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    if export and (ring or pp > 1):
+        # ring installs an attention_fn (rejected by the freezer) and the
+        # pipeline re-lays params out stage-major; export the plain model.
+        raise ValueError("--export requires the plain model "
+                         "(no --ring / --pp)")
     if ring and pp > 1:
         # ring attention's shard_map runs over a 'seq' mesh; inside the
         # pipeline's 'pipe' manual mesh that context clashes.
@@ -168,6 +174,19 @@ def run(steps=200, seq_len=32, batch=16, vocab=64, embed_dim=128, depth=2,
             print(f"sample ({sample} bytes, T={temperature}): {text!r}")
         else:
             print(f"sample ({sample} tokens, T={temperature}): {out}")
+
+    if export:
+        # Freeze to the packed 1-bit serving artifact; serve it with
+        # infer.load_packed (full-window) or
+        # infer_transformer.make_lm_decoder (KV-cache incremental).
+        from ..infer import export_packed
+
+        info = export_packed(model, {"params": params}, export)
+        print(
+            f"packed artifact -> {export}: {info['compression']}x over "
+            f"the fp32 latents ({info['frozen_weight_bytes']} packed "
+            "bytes)"
+        )
     return history, out
 
 

@@ -175,3 +175,136 @@ def test_cli_export_vit(tmp_path, monkeypatch):
     assert info["kind"] == "vit"
     x = np.random.RandomState(0).rand(4, 28, 28, 1).astype(np.float32)
     assert np.isfinite(np.asarray(fn(x))).all()
+
+
+class TestLMDecoder:
+    def _frozen(self):
+        from distributed_mnist_bnns_tpu.infer_transformer import (
+            _freeze_lm_tensors,
+        )
+        from distributed_mnist_bnns_tpu.models import lm_loss
+
+        model = BinarizedLM(
+            vocab=64, max_len=16, embed_dim=64, depth=2, num_heads=2,
+            attention="xla", backend="xla",
+        )
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, 64)
+        variables = trained_variables(
+            model, tokens, lambda out: lm_loss(out, tokens),
+            init_rngs={"params": jax.random.PRNGKey(0)},
+        )
+        return _freeze_lm_tensors(model, variables), tokens
+
+    def test_incremental_matches_full_forward(self):
+        """Teacher-forced KV-cache decoding reproduces the full-window
+        forward's per-position log-probs (the masked-softmax cache path
+        is mathematically identical; exp(-inf)=0 kills the zero tail)."""
+        from distributed_mnist_bnns_tpu.infer_transformer import (
+            _build_transformer_apply,
+            make_lm_decoder,
+        )
+
+        frozen, tokens = self._frozen()
+        full = _build_transformer_apply(frozen, True)(tokens)
+        init, step = make_lm_decoder(frozen, interpret=True)
+        caches = init(tokens.shape[0])
+        for t in range(tokens.shape[1]):
+            caches, lp = step(caches, tokens[:, t], t)
+            np.testing.assert_allclose(
+                np.asarray(lp), np.asarray(full[:, t]),
+                atol=1e-4, rtol=1e-4,
+            )
+
+    def test_greedy_generation(self):
+        """Prompt -> greedy continuation, one single-position step per
+        emitted token."""
+        from distributed_mnist_bnns_tpu.infer_transformer import (
+            make_lm_decoder,
+        )
+
+        frozen, _ = self._frozen()
+        init, step = make_lm_decoder(frozen, interpret=True)
+        prompt = jnp.array([[3, 1, 4]], jnp.int32)
+        caches = init(1)
+        lp = None
+        for t in range(prompt.shape[1]):
+            caches, lp = step(caches, prompt[:, t], t)
+        out = [prompt]
+        for t in range(prompt.shape[1], prompt.shape[1] + 5):
+            nxt = jnp.argmax(lp, axis=-1).astype(jnp.int32)
+            out.append(nxt[:, None])
+            caches, lp = step(caches, nxt, t)
+        toks = jnp.concatenate(out, axis=1)
+        assert toks.shape == (1, 8)
+        assert ((toks >= 0) & (toks < 64)).all()
+
+    def test_rejects_vit_artifact(self):
+        from distributed_mnist_bnns_tpu.infer_transformer import (
+            _freeze_vit_tensors,
+            make_lm_decoder,
+        )
+
+        model = bnn_vit_tiny(attention="xla", backend="xla")
+        x = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+        frozen = _freeze_vit_tensors(model, variables)
+        with pytest.raises(ValueError, match="lm"):
+            make_lm_decoder(frozen)
+
+    def test_rejects_overlong_cache(self):
+        from distributed_mnist_bnns_tpu.infer_transformer import (
+            make_lm_decoder,
+        )
+
+        frozen, _ = self._frozen()
+        with pytest.raises(ValueError, match="max_len"):
+            make_lm_decoder(frozen, max_len=64)
+
+
+def test_cli_lm_export_then_decode(tmp_path, monkeypatch):
+    """cli lm --export end to end: train a tiny LM, freeze it from the
+    CLI, then serve the artifact through the KV-cache decoder."""
+    from distributed_mnist_bnns_tpu.cli import main
+    from distributed_mnist_bnns_tpu.infer_transformer import make_lm_decoder
+
+    monkeypatch.chdir(tmp_path)
+    art = str(tmp_path / "lm.msgpack")
+    rc = main([
+        "lm", "--steps", "3", "--seq-len", "16", "--batch-size", "4",
+        "--embed-dim", "32", "--depth", "1", "--num-heads", "2",
+        "--export", art, "--log-file", str(tmp_path / "l.txt"),
+    ])
+    assert rc == 0
+    from flax import serialization
+
+    with open(art, "rb") as f:
+        frozen = serialization.msgpack_restore(f.read())
+    assert frozen["info"]["kind"] == "lm"
+    init, step = make_lm_decoder(frozen, interpret=True)
+    caches = init(1)
+    caches, lp = step(caches, jnp.array([1], jnp.int32), 0)
+    assert np.isfinite(np.asarray(lp)).all()
+
+
+def test_decoder_position_bounds():
+    """Out-of-range decode positions fail loudly instead of silently
+    clamping the cache write (XLA dynamic_update_slice semantics)."""
+    from distributed_mnist_bnns_tpu.infer_transformer import (
+        _freeze_lm_tensors,
+        make_lm_decoder,
+    )
+
+    model = BinarizedLM(
+        vocab=16, max_len=8, embed_dim=32, depth=1, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    frozen = _freeze_lm_tensors(model, variables)
+    init, step = make_lm_decoder(frozen, interpret=True, max_len=4)
+    caches = init(1)
+    with pytest.raises(ValueError, match="decode position"):
+        step(caches, jnp.array([0], jnp.int32), 4)
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="max_len"):
+            make_lm_decoder(frozen, max_len=bad)
