@@ -22,8 +22,10 @@ utils     : logging, meters, results CSV/HTML, (async) checkpointing,
             recovery, profiling, accuracy.
 native    : C++ data runtime (idx/CIFAR decode, bitpack, threaded
             BatchPool) via ctypes.
-infer     : frozen packed-weight serving (XNOR-net BN-threshold folding,
-            export/load artifacts).
+infer     : frozen packed-weight serving — MLP/conv (XNOR-net
+            BN-threshold folding) and transformer families (vit + causal
+            LM with KV-cache incremental decoding); export/load
+            artifacts (infer.py, infer_conv.py, infer_transformer.py).
 
 The reference's semantics that this framework preserves (see SURVEY.md):
   * fp32 latent "master" weights binarized on every forward

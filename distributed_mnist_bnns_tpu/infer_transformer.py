@@ -452,3 +452,60 @@ def make_lm_decoder(
         return jitted(caches, tokens, pos)
 
     return init_caches, step
+
+
+def generate(
+    frozen: Dict[str, Any], prompt, n_tokens: int, *,
+    temperature: float = 0.0, rng=None, interpret: bool = False,
+    decoder: Tuple[Callable, Callable] | None = None,
+) -> jnp.ndarray:
+    """Autoregressive generation from a frozen LM artifact via the
+    KV-cache decoder: feed the prompt one position at a time (teacher
+    forcing), then sample ``n_tokens`` continuations — greedy at
+    ``temperature=0``, else categorical with ``rng``.
+
+    ``prompt``: (B, P) int tokens. Returns (B, P + n_tokens). The serving
+    loop is host-driven (one jitted single-position step per token), so
+    total length must fit the artifact's trained ``max_len``.
+
+    A serving loop calling this per request should build the decoder once
+    and pass it as ``decoder=make_lm_decoder(frozen)`` — otherwise every
+    call constructs fresh jitted closures and repays XLA compilation,
+    which dominates single-position decode cost.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2 or prompt.shape[1] < 1:
+        raise ValueError(f"prompt must be (B, P>=1), got {prompt.shape}")
+    if n_tokens < 0:
+        raise ValueError(f"n_tokens must be >= 0, got {n_tokens}")
+    if temperature < 0:
+        raise ValueError(
+            f"temperature must be >= 0 (0 = greedy), got {temperature}"
+        )
+    total = prompt.shape[1] + n_tokens
+    cache_len = int(jnp.asarray(frozen["pos_embed"]).shape[1])
+    if total > cache_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + n_tokens {n_tokens} = {total} "
+            f"exceeds the artifact's trained max_len {cache_len}"
+        )
+    init, step = decoder or make_lm_decoder(frozen, interpret=interpret)
+    caches = init(prompt.shape[0])
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+
+    lp = None
+    for t in range(prompt.shape[1]):           # prefill
+        caches, lp = step(caches, prompt[:, t], t)
+    out = [prompt]
+    for t in range(prompt.shape[1], total):    # decode
+        if temperature > 0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, lp / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(lp, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        out.append(nxt[:, None])
+        if t < total - 1:
+            caches, lp = step(caches, nxt, t)
+    return jnp.concatenate(out, axis=1)

@@ -1,0 +1,70 @@
+"""Unit tests for bench.py's MFU accounting — the precision-matched peak
+table and the analytic step-FLOPs estimate that produce the published
+`mfu` field (BENCH_LOCAL_r*.json, PERF.md)."""
+
+import types
+
+import bench
+
+
+class _Dev:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+class TestChipPeak:
+    def test_bf16_peaks(self):
+        assert bench._chip_peak(_Dev("TPU v5 lite"), "bf16") == (197e12, "bf16")
+        assert bench._chip_peak(_Dev("TPU v4"), "bf16") == (275e12, "bf16")
+
+    def test_int8_doubles_on_v5e(self):
+        peak, prec = bench._chip_peak(_Dev("TPU v5 lite"), "int8")
+        assert peak == 2 * 197e12 and prec == "int8"
+
+    def test_int8_flat_on_v4(self):
+        peak, prec = bench._chip_peak(_Dev("TPU v4"), "int8")
+        assert peak == 275e12 and prec == "int8"
+
+    def test_non_int8_backends_score_against_bf16_peak(self):
+        for b in ("xla", "bf16", "xnor", "pallas_xnor"):
+            assert bench._chip_peak(_Dev("TPU v5p"), b) == (459e12, "bf16")
+
+    def test_unknown_device(self):
+        assert bench._chip_peak(_Dev("GPU H100"), "bf16") == (None, "unknown")
+
+
+class TestMfu:
+    def test_formula(self):
+        # 100 GF step in 1 ms on a 200 TF chip = 0.5 MFU
+        assert bench._mfu(100e9, 1e-3, 200e12) == 0.5
+
+    def test_degenerate_inputs_are_none(self):
+        assert bench._mfu(None, 1e-3, 200e12) is None
+        assert bench._mfu(100e9, None, 200e12) is None
+        assert bench._mfu(100e9, 1e-3, None) is None
+        assert bench._mfu(100e9, 0.0, 200e12) is None
+
+
+class TestStepFlops:
+    def _trainer(self, model, params):
+        return types.SimpleNamespace(
+            config=types.SimpleNamespace(model=model),
+            state=types.SimpleNamespace(params=params),
+        )
+
+    def test_dense_model_counts_3x_forward(self):
+        import numpy as np
+
+        params = {"l1": {"kernel": np.zeros((784, 100))},
+                  "l2": {"kernel": np.zeros((100, 10))}}
+        flops, method = bench._step_flops(
+            self._trainer("bnn-mlp-large", params), batch_size=2
+        )
+        macs = 784 * 100 + 100 * 10
+        assert flops == 3.0 * 2.0 * macs * 2
+        assert method == "analytic_3x_dense_gemms"
+
+    def test_conv_model_makes_no_claim(self):
+        assert bench._step_flops(
+            self._trainer("bnn-cnn", {}), batch_size=2
+        ) is None

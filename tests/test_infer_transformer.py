@@ -175,6 +175,13 @@ def test_cli_export_vit(tmp_path, monkeypatch):
     assert info["kind"] == "vit"
     x = np.random.RandomState(0).rand(4, 28, 28, 1).astype(np.float32)
     assert np.isfinite(np.asarray(fn(x))).all()
+    # and the artifact serves through the infer subcommand (accuracy on
+    # the synthetic test split + latency report)
+    rc = main(
+        ["infer", *common, "--artifact", out,
+         "--log-file", str(tmp_path / "l3.txt")]
+    )
+    assert rc == 0
 
 
 class TestLMDecoder:
@@ -308,3 +315,86 @@ def test_decoder_position_bounds():
     for bad in (0, -1):
         with pytest.raises(ValueError, match="max_len"):
             make_lm_decoder(frozen, max_len=bad)
+
+
+def test_generate_matches_manual_greedy():
+    """generate() (prefill + KV-cache decode) reproduces the manual
+    full-window greedy loop token for token."""
+    from distributed_mnist_bnns_tpu.infer_transformer import (
+        _build_transformer_apply,
+        _freeze_lm_tensors,
+        generate,
+    )
+    from distributed_mnist_bnns_tpu.models import lm_loss
+
+    model = BinarizedLM(
+        vocab=64, max_len=16, embed_dim=64, depth=2, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, 64)
+    variables = trained_variables(
+        model, tokens, lambda out: lm_loss(out, tokens),
+        init_rngs={"params": jax.random.PRNGKey(0)},
+    )
+    frozen = _freeze_lm_tensors(model, variables)
+
+    prompt = tokens[:, :4]
+    out = generate(frozen, prompt, 6, interpret=True)
+    assert out.shape == (2, 10)
+
+    full = _build_transformer_apply(frozen, True)
+    window = prompt
+    for _ in range(6):
+        nxt = jnp.argmax(full(window)[:, -1], axis=-1).astype(jnp.int32)
+        window = jnp.concatenate([window, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(window))
+
+
+def test_generate_temperature_needs_rng():
+    from distributed_mnist_bnns_tpu.infer_transformer import (
+        _freeze_lm_tensors,
+        generate,
+    )
+
+    model = BinarizedLM(
+        vocab=16, max_len=8, embed_dim=32, depth=1, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    frozen = _freeze_lm_tensors(model, variables)
+    with pytest.raises(ValueError, match="rng"):
+        generate(frozen, tokens[:, :2], 2, temperature=0.5)
+    out = generate(
+        frozen, tokens[:, :2], 3, temperature=0.5,
+        rng=jax.random.PRNGKey(1), interpret=True,
+    )
+    assert out.shape == (1, 5)
+
+
+def test_generate_input_validation():
+    """Overlong requests and invalid knobs fail upfront, before any
+    decode compute."""
+    from distributed_mnist_bnns_tpu.infer_transformer import (
+        _freeze_lm_tensors,
+        generate,
+        make_lm_decoder,
+    )
+
+    model = BinarizedLM(
+        vocab=16, max_len=8, embed_dim=32, depth=1, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    frozen = _freeze_lm_tensors(model, variables)
+    with pytest.raises(ValueError, match="max_len"):
+        generate(frozen, tokens[:, :4], 20)
+    with pytest.raises(ValueError, match="n_tokens"):
+        generate(frozen, tokens[:, :4], -3)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(frozen, tokens[:, :4], 2, temperature=-0.5)
+    # prebuilt decoder reuse (the serving-loop path)
+    dec = make_lm_decoder(frozen, interpret=True)
+    out = generate(frozen, tokens[:, :2], 2, decoder=dec)
+    assert out.shape == (1, 4)
