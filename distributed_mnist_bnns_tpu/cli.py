@@ -196,6 +196,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="freeze the trained LM to a packed 1-bit "
                          "serving artifact (KV-cache decoding: "
                          "infer_transformer.make_lm_decoder)")
+    lm.add_argument("--load", default=None, metavar="PATH",
+                    help="skip training: load a packed artifact (from "
+                         "--export) and generate --sample tokens via "
+                         "the KV-cache decoder")
+    lm.add_argument("--prompt", default=None,
+                    help="with --load: text prompt (byte tokens; "
+                         "default a newline)")
+    lm.add_argument("--interpret", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="with --load: force the Pallas interpret path "
+                         "(default: interpret off-TPU, kernels on)")
     lm.add_argument("--log-interval", type=int, default=25)
     lm.add_argument("--log-file", default="log.txt")
     return p
@@ -286,6 +297,60 @@ def main(argv=None) -> int:
                 "could not re-pin jax platform to %r (backend already "
                 "initialized)", repin_failed,
             )
+        if args.load:
+            # Serve a packed artifact: KV-cache decode, no training.
+            import jax as _jax
+            import jax.numpy as _jnp
+            from flax import serialization
+
+            from .infer_transformer import generate
+
+            with open(args.load, "rb") as f:
+                frozen = serialization.msgpack_restore(f.read())
+            if frozen.get("info", {}).get("kind") != "lm":
+                log.error("%s is not a packed LM artifact", args.load)
+                return 2
+            # --sample keeps its training-mode default of 0 ("none"), so
+            # an unset value means "a reasonable demo length" here; an
+            # explicit negative is an input error, reported cleanly.
+            if args.sample < 0:
+                log.error("--sample must be >= 0, got %d", args.sample)
+                return 2
+            n = args.sample if args.sample > 0 else 64
+            prompt_bytes = (args.prompt or "\n").encode("utf-8")
+            vocab = int(frozen["tok_embed"].shape[0])
+            prompt = _jnp.asarray(
+                [[b % vocab for b in prompt_bytes]], _jnp.int32
+            )
+            max_len = int(frozen["pos_embed"].shape[1])
+            if prompt.shape[1] >= max_len:
+                log.error(
+                    "prompt (%d tokens) fills the artifact's trained "
+                    "window (max_len %d)", prompt.shape[1], max_len,
+                )
+                return 2
+            if prompt.shape[1] + n > max_len:
+                n = max_len - prompt.shape[1]
+                log.warning(
+                    "clamped --sample to %d: the artifact's fixed "
+                    "positional window is max_len=%d", n, max_len,
+                )
+            interpret = (
+                _jax.default_backend() != "tpu"
+                if args.interpret is None else args.interpret
+            )
+            toks = generate(
+                frozen, prompt, n, temperature=args.temperature,
+                rng=_jax.random.PRNGKey(args.seed), interpret=interpret,
+            )
+            out = [int(t) for t in toks[0, prompt.shape[1]:]]
+            if vocab == 256:  # byte-level: show as text
+                text = bytes(out).decode("utf-8", errors="replace")
+                print(f"sample ({n} bytes, T={args.temperature}): {text!r}")
+            else:
+                print(f"sample ({n} tokens, T={args.temperature}): {out}")
+            return 0
+
         from .examples.lm_demo import run as lm_run
 
         history, _ = lm_run(

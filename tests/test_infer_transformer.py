@@ -291,6 +291,13 @@ def test_cli_lm_export_then_decode(tmp_path, monkeypatch):
     caches = init(1)
     caches, lp = step(caches, jnp.array([1], jnp.int32), 0)
     assert np.isfinite(np.asarray(lp)).all()
+    # and cli lm --load serves the artifact (clamps overlong --sample to
+    # the artifact's trained window instead of failing)
+    rc = main([
+        "lm", "--load", art, "--sample", "100", "--temperature", "0",
+        "--log-file", str(tmp_path / "l2.txt"),
+    ])
+    assert rc == 0
 
 
 def test_decoder_position_bounds():
