@@ -151,8 +151,8 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser(
         "export",
         help="freeze a trained BNN checkpoint (bnn-mlp, bnn-cnn, "
-             "xnor-resnet or bnn-vit) into the packed 1-bit serving "
-             "artifact (infer.load_packed)",
+             "xnor-resnet, bnn-vit or bnn-moe-mlp) into the packed "
+             "1-bit serving artifact (infer.load_packed)",
     )
     common(x)
     x.add_argument("--best", action="store_true")

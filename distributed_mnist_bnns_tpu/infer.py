@@ -187,9 +187,15 @@ def _freeze_any(model, variables, input_shape=None) -> Dict[str, Any]:
         return _freeze_vit_tensors(model, variables)
     if isinstance(model, BinarizedLM):
         return _freeze_lm_tensors(model, variables)
+    from .infer_moe import _freeze_moe_tensors
+    from .models.moe import BnnMoEMLP
+
+    if isinstance(model, BnnMoEMLP):
+        return _freeze_moe_tensors(model, variables)
     raise ValueError(
         f"no packed freeze for {type(model).__name__} (freezable: BnnMLP, "
-        "BinarizedCNN, XnorResNet, BinarizedTransformer, BinarizedLM)"
+        "BinarizedCNN, XnorResNet, BinarizedTransformer, BinarizedLM, "
+        "BnnMoEMLP)"
     )
 
 
@@ -207,6 +213,10 @@ def _build_any(frozen: Dict[str, Any], interpret: bool) -> Callable:
         from .infer_transformer import _build_transformer_apply
 
         return _build_transformer_apply(frozen, interpret)
+    if family == "bnn-moe-mlp":
+        from .infer_moe import _build_moe_apply
+
+        return _build_moe_apply(frozen, interpret)
     raise ValueError(f"unknown packed-artifact family {family!r}")
 
 
@@ -217,11 +227,12 @@ def export_packed(
     holds the 1-bit hidden weights, ±1 first layer, raw BN moments and the
     fp32 head — everything ``load_packed`` needs, nothing else (no latent
     masters, no optimizer state). Covers the MLP, CNN, XNOR-ResNet
-    (basic-block and bottleneck, CIFAR or ImageNet stem) and transformer
-    (vit + causal LM) families — a ``family`` key dispatches at load;
-    conv artifacts additionally carry their freeze-time input resolution
-    and padding-correction inputs, transformer artifacts their LN/embed
-    fp32 tensors. Returns the size-info dict."""
+    (basic-block and bottleneck, CIFAR or ImageNet stem), transformer
+    (vit + causal LM) and MoE families — a ``family`` key dispatches at
+    load; conv artifacts additionally carry their freeze-time input
+    resolution and padding-correction inputs, transformer artifacts
+    their LN/embed fp32 tensors, MoE artifacts the fp32 router and
+    routing hyperparameters. Returns the size-info dict."""
     from flax import serialization
 
     frozen = _freeze_any(model, variables, input_shape)

@@ -1,0 +1,142 @@
+"""Packed 1-bit serving for the MoE family (BnnMoEMLP) — the last
+binarized family without a freeze path (infer.py: MLP, infer_conv.py:
+conv, infer_transformer.py: attention; here: routed experts).
+
+No reference counterpart (the reference has no MoE — SURVEY §2.2). What
+folds and what stays live follows the family's own routing contract
+(models/moe.py):
+
+  * first BinarizedDense: ±1 weights on raw pixels (first-layer
+    passthrough), then **BN as an eval-time affine, NOT a threshold** —
+    the fp32 router consumes the continuous hardtanh stream, so the
+    classic binarize∘BN folding is unavailable for this BN;
+  * router: plain fp32 Dense + softmax + the SAME ``topk_dispatch`` the
+    live model routes with (identical tie-breaking, capacity math);
+  * experts: per-expert (D, Do) latents → stacked pre-packed bitplanes,
+    one packed GEMM per expert (E is small and static: the loop unrolls
+    under jit);
+  * the path into the fp32 head IS foldable: binarize(hardtanh(BN(y)))
+    collapses to the per-channel threshold compare (infer._bn_sign_fn)
+    because nothing else reads that stream — integer GEMM → threshold →
+    ±1 bits → packed head GEMM, no BN/activation tensors materialized;
+  * the load-balance aux loss is train-only (a sow) and drops out of the
+    frozen graph entirely.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .infer import _bn_affine_fn, _bn_sign_fn
+from .models.moe import BnnMoEMLP
+from .ops.binarize import binarize_ste
+from .ops.routing import topk_dispatch
+from .ops.xnor_gemm import prepack_weights, xnor_matmul_packed
+
+
+def _freeze_moe_tensors(model: BnnMoEMLP, variables: Dict) -> Dict[str, Any]:
+    params = variables["params"]
+    stats = variables["batch_stats"]
+    expert_w = params["BinarizedExperts_0"]["w"]      # (E, D, Do)
+    packed = [prepack_weights(binarize_ste(w)) for w in expert_w]
+    wp = jnp.stack([p[0] for p in packed])            # (E, KWp, Np)
+    head_wp, head_k, head_n = prepack_weights(
+        binarize_ste(params["BinarizedDense_1"]["kernel"])
+    )
+    frozen: Dict[str, Any] = {
+        "family": "bnn-moe-mlp",
+        "num_experts": model.num_experts,
+        "router_k": model.router_k,
+        "capacity_factor": model.capacity_factor,
+        "w1": binarize_ste(params["BinarizedDense_0"]["kernel"]),
+        "b1": params["BinarizedDense_0"]["bias"],
+        "bn0": {"params": dict(params["BatchNorm_0"]),
+                "stats": dict(stats["BatchNorm_0"])},
+        "router_w": params["router"]["kernel"],
+        "router_b": params["router"]["bias"],
+        "experts_wp": wp,
+        "experts_k": packed[0][1],
+        "experts_n": packed[0][2],
+        "experts_b": params["BinarizedExperts_0"]["b"],
+        "bn1": {"params": dict(params["BatchNorm_1"]),
+                "stats": dict(stats["BatchNorm_1"])},
+        "head_wp": head_wp,
+        "head_k": head_k,
+        "head_n": head_n,
+        "head_b": params["BinarizedDense_1"]["bias"],
+    }
+    latent = (
+        int(params["BinarizedDense_0"]["kernel"].size)
+        + int(expert_w.size)
+        + int(params["BinarizedDense_1"]["kernel"].size)
+    ) * 4
+    packed_bytes = (
+        int(frozen["w1"].size) + int(wp.size) + int(head_wp.size)
+    ) * 4
+    frozen["info"] = {
+        "family": "bnn-moe-mlp",
+        "latent_fp32_weight_bytes": latent,
+        "frozen_weight_bytes": packed_bytes,
+        "compression": round(latent / packed_bytes, 2),
+        "packed_layers": ["BinarizedExperts_0", "BinarizedDense_1"],
+    }
+    return frozen
+
+
+def _build_moe_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
+    num_experts = int(frozen["num_experts"])
+    router_k = int(frozen["router_k"])
+    capacity_factor = float(frozen["capacity_factor"])
+    w1 = jnp.asarray(frozen["w1"], jnp.float32)       # disk: int8 ±1
+    b1 = jnp.asarray(frozen["b1"], jnp.float32)
+    bn0 = _bn_affine_fn(frozen["bn0"]["params"], frozen["bn0"]["stats"])
+    router_w = jnp.asarray(frozen["router_w"], jnp.float32)
+    router_b = jnp.asarray(frozen["router_b"], jnp.float32)
+    experts_wp = jnp.asarray(frozen["experts_wp"])
+    ek, en = int(frozen["experts_k"]), int(frozen["experts_n"])
+    experts_b = jnp.asarray(frozen["experts_b"], jnp.float32)
+    bn1_sign = _bn_sign_fn(frozen["bn1"]["params"], frozen["bn1"]["stats"])
+    head_wp = jnp.asarray(frozen["head_wp"])
+    hk, hn = int(frozen["head_k"]), int(frozen["head_n"])
+    head_b = jnp.asarray(frozen["head_b"], jnp.float32)
+
+    def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
+        x = images.reshape(images.shape[0], -1).astype(jnp.float32)
+        x = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
+        x = jax.nn.hard_tanh(bn0(x))                  # affine BN, hardtanh
+        gates = jax.nn.softmax(x @ router_w + router_b)
+        t = x.shape[0]
+        capacity = max(
+            1, math.ceil(capacity_factor * t * router_k / num_experts)
+        )
+        dispatch, combine = topk_dispatch(gates, capacity, router_k)
+        ex_in = jnp.einsum("tec,td->ecd", dispatch, x)
+        xb = binarize_ste(ex_in)                      # (E, C, D)
+        ex_out = jnp.stack([
+            xnor_matmul_packed(
+                xb[e], experts_wp[e], ek, en, interpret=interpret
+            ) + experts_b[e]
+            for e in range(num_experts)
+        ])
+        y = jnp.einsum("tec,ecd->td", combine, ex_out)
+        bits = bn1_sign(y)                            # BN+hardtanh+sign
+        logits = xnor_matmul_packed(
+            bits, head_wp, hk, hn, interpret=interpret
+        ) + head_b
+        return jax.nn.log_softmax(logits)
+
+    return jax.jit(apply_fn)
+
+
+def freeze_bnn_moe(
+    model: BnnMoEMLP, variables: Dict, *, interpret: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained BnnMoEMLP into packed routed inference; matches
+    ``model.apply(variables, x, train=False)`` (backend="xla" models —
+    the exactness caveats of the other families apply)."""
+    frozen = _freeze_moe_tensors(model, variables)
+    return _build_moe_apply(frozen, interpret), frozen["info"]

@@ -1,0 +1,90 @@
+"""Packed serving for the MoE family (infer_moe.py): frozen BnnMoEMLP
+must match its live eval forward (routing included), and the artifact
+must round-trip through export/load — completing frozen-inference
+coverage of every binarized family."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_mnist_bnns_tpu.infer import export_packed, load_packed
+from distributed_mnist_bnns_tpu.infer_moe import freeze_bnn_moe
+from distributed_mnist_bnns_tpu.models.moe import BnnMoEMLP
+from distributed_mnist_bnns_tpu.ops.losses import cross_entropy_loss
+from tests.infer_train_util import trained_variables
+
+
+def _setup(seed=0):
+    model = BnnMoEMLP(
+        hidden=64, num_experts=4, expert_features=64, backend="xla"
+    )
+    x = jax.random.normal(
+        jax.random.PRNGKey(3), (16, 28, 28, 1), jnp.float32
+    )
+    labels = jax.random.randint(jax.random.PRNGKey(4), (16,), 0, 10)
+    variables = trained_variables(
+        model, x, lambda out: cross_entropy_loss(out, labels), seed=seed,
+    )
+    return model, variables, x
+
+
+def test_frozen_moe_matches_live_eval():
+    model, variables, x = _setup()
+    live = model.apply(variables, x, train=False)
+    frozen_fn, info = freeze_bnn_moe(model, variables, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(frozen_fn(x)), np.asarray(live), atol=1e-4, rtol=1e-4,
+    )
+    assert info["family"] == "bnn-moe-mlp"
+    # whole-artifact ratio is first-layer-dominated at this tiny config
+    # (784x64 fp32 passthrough vs 4 64x64 experts) — same effect as
+    # bnn-mlp-small (tests/test_infer.py); production-sized expert banks
+    # dominate and land near 32x.
+    assert info["compression"] > 1.2
+
+
+def test_routing_survives_freeze():
+    """The frozen path routes with the same topk_dispatch: a batch where
+    different tokens pick different experts still matches (the einsum
+    dispatch/combine is part of the frozen graph, not an approximation)."""
+    model, variables, x = _setup(seed=7)
+    live = model.apply(variables, x, train=False)
+    frozen_fn, _ = freeze_bnn_moe(model, variables, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(frozen_fn(x)), np.asarray(live), atol=1e-4, rtol=1e-4,
+    )
+
+
+def test_export_load_roundtrip(tmp_path):
+    model, variables, x = _setup()
+    live = model.apply(variables, x, train=False)
+    path = str(tmp_path / "moe.packed")
+    info = export_packed(model, variables, path)
+    assert info["family"] == "bnn-moe-mlp"
+    fn, info2 = load_packed(path, interpret=True)
+    assert info2["compression"] == info["compression"]
+    np.testing.assert_allclose(
+        np.asarray(fn(x)), np.asarray(live), atol=1e-4, rtol=1e-4,
+    )
+
+
+def test_cli_export_moe(tmp_path, monkeypatch):
+    """CLI train -> export -> infer for the MoE family."""
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    common = [
+        "--model", "bnn-moe-mlp", "--epochs", "1", "--batch-size", "32",
+        "--backend", "xla", "--data-dir", "/nonexistent_use_synth",
+        "--synthetic-sizes", "128", "32",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    rc = main(["train", *common, "--log-file", str(tmp_path / "l1.txt")])
+    assert rc == 0
+    out = str(tmp_path / "moe.msgpack")
+    rc = main(["export", *common, "--out", out,
+               "--log-file", str(tmp_path / "l2.txt")])
+    assert rc == 0
+    rc = main(["infer", *common, "--artifact", out,
+               "--log-file", str(tmp_path / "l3.txt")])
+    assert rc == 0
