@@ -150,9 +150,9 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--best", action="store_true")
     x = sub.add_parser(
         "export",
-        help="freeze a trained BNN checkpoint (bnn-mlp, bnn-cnn, "
-             "xnor-resnet, bnn-vit or bnn-moe-mlp) into the packed "
-             "1-bit serving artifact (infer.load_packed)",
+        help="freeze a trained checkpoint (bnn-mlp, bnn-cnn, xnor-resnet, "
+             "bnn-vit, bnn-moe-mlp or qnn-mlp) into the packed/int8 "
+             "serving artifact (infer.load_packed)",
     )
     common(x)
     x.add_argument("--best", action="store_true")

@@ -192,10 +192,15 @@ def _freeze_any(model, variables, input_shape=None) -> Dict[str, Any]:
 
     if isinstance(model, BnnMoEMLP):
         return _freeze_moe_tensors(model, variables)
+    from .infer_qnn import _freeze_qnn_tensors
+    from .models.mlp import QnnMLP
+
+    if isinstance(model, QnnMLP):
+        return _freeze_qnn_tensors(model, variables)
     raise ValueError(
         f"no packed freeze for {type(model).__name__} (freezable: BnnMLP, "
         "BinarizedCNN, XnorResNet, BinarizedTransformer, BinarizedLM, "
-        "BnnMoEMLP)"
+        "BnnMoEMLP, QnnMLP)"
     )
 
 
@@ -217,6 +222,10 @@ def _build_any(frozen: Dict[str, Any], interpret: bool) -> Callable:
         from .infer_moe import _build_moe_apply
 
         return _build_moe_apply(frozen, interpret)
+    if family == "qnn-mlp":
+        from .infer_qnn import _build_qnn_apply
+
+        return _build_qnn_apply(frozen, interpret)
     raise ValueError(f"unknown packed-artifact family {family!r}")
 
 

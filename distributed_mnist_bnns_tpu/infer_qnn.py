@@ -1,0 +1,141 @@
+"""Integer serving for the k-bit QNN family (QnnMLP) — the quantized
+counterpart of the 1-bit packed paths (infer.py / infer_conv.py /
+infer_transformer.py / infer_moe.py).
+
+No reference counterpart (the reference's ``Quantize`` op was dead code —
+models/binarized_modules.py:56-63; this repo made it a trainable family,
+models/mlp.py::QnnMLP). The deployment transform: ``quantize`` maps every
+value onto the signed 2^(b-1) grid, so for num_bits <= 8 the quantized
+weights ARE int8 integers (w_int = w_q * 2^(b-1), exactly representable)
+and a hidden layer's GEMM becomes
+
+    y = (x_int @ w_int) / 2^(2(b-1)) + bias
+
+with int8 x int8 -> int32 accumulation — exact integer arithmetic (no
+fp32 summation rounding, K * 127^2 << 2^31) that lands on the TPU MXU's
+int8 pipeline at 2x the bf16 rate (PERF.md crossover, bench's
+precision-matched MFU accounting). Weights ship as int8: 4x smaller than
+the fp32 latents (1 byte/param).
+
+BN between layers stays an eval-time affine (the quantizer is not a sign,
+so there is no threshold fold here — the VPU elementwise chain
+affine -> hardtanh -> quantize is cheap next to the GEMMs); the first
+layer takes raw fp32 pixels against the quantized weights, and the head
+is the model's plain fp32 Dense.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .infer import _bn_affine_fn
+from .models.mlp import QnnMLP
+from .ops.binarize import quantize
+
+
+def _w_int(kernel: jnp.ndarray, num_bits: int) -> jnp.ndarray:
+    """The quantized weight's exact integer representation (int8)."""
+    scale = 2.0 ** (num_bits - 1)
+    return jnp.round(quantize(kernel, "det", num_bits) * scale).astype(
+        jnp.int8
+    )
+
+
+def _freeze_qnn_tensors(model: QnnMLP, variables: Dict) -> Dict[str, Any]:
+    if model.num_bits > 8:
+        raise ValueError(
+            f"int8 serving covers num_bits <= 8, got {model.num_bits}"
+        )
+    if model.stochastic:
+        raise ValueError(
+            "stochastic rounding is a train-time feature; freeze the "
+            "deterministic eval path"
+        )
+    params = variables["params"]
+    stats = variables["batch_stats"]
+    frozen: Dict[str, Any] = {
+        "family": "qnn-mlp",
+        "num_bits": model.num_bits,
+        "layers": [
+            {
+                "w_int": _w_int(
+                    params[f"QuantizedDense_{i}"]["kernel"], model.num_bits
+                ),
+                "bias": params[f"QuantizedDense_{i}"]["bias"],
+            }
+            for i in range(3)
+        ],
+        "bns": [
+            {"params": dict(params[f"BatchNorm_{i}"]),
+             "stats": dict(stats[f"BatchNorm_{i}"])}
+            for i in range(3)
+        ],
+        "head_w": params["Dense_0"]["kernel"],
+        "head_b": params["Dense_0"]["bias"],
+    }
+    latent = sum(
+        int(params[f"QuantizedDense_{i}"]["kernel"].size) for i in range(3)
+    ) * 4
+    int8_bytes = sum(int(l["w_int"].size) for l in frozen["layers"])
+    frozen["info"] = {
+        "family": "qnn-mlp",
+        "latent_fp32_weight_bytes": latent,
+        "frozen_weight_bytes": int8_bytes,
+        "compression": round(latent / int8_bytes, 2),
+        "packed_layers": [f"QuantizedDense_{i}" for i in range(3)],
+    }
+    return frozen
+
+
+def _build_qnn_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
+    """Jitted int8 predictor. ``interpret`` is accepted for load_packed
+    API uniformity; this family has no Pallas kernel to interpret —
+    XLA's native int8 dot IS the serving path."""
+    del interpret
+    num_bits = int(frozen["num_bits"])
+    scale = 2.0 ** (num_bits - 1)
+    layers = [
+        (jnp.asarray(l["w_int"], jnp.int8),
+         jnp.asarray(l["bias"], jnp.float32))
+        for l in frozen["layers"]
+    ]
+    bns = [
+        _bn_affine_fn(b["params"], b["stats"]) for b in frozen["bns"]
+    ]
+    head_w = jnp.asarray(frozen["head_w"], jnp.float32)
+    head_b = jnp.asarray(frozen["head_b"], jnp.float32)
+
+    def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
+        x = images.reshape(images.shape[0], -1).astype(jnp.float32)
+        # first layer: raw fp32 pixels @ quantized weights
+        w0, b0 = layers[0]
+        y = jnp.dot(x, w0.astype(jnp.float32),
+                    preferred_element_type=jnp.float32) / scale + b0
+        for (w, b), bn in zip(layers[1:], bns[:2]):
+            h = jax.nn.hard_tanh(bn(y))
+            # the live path's own quantize(), lifted to its exact int
+            # representation, then integer GEMM (int32 accumulate)
+            xi = (quantize(h, "det", num_bits) * scale).astype(jnp.int8)
+            acc = jnp.dot(xi, w, preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) / (scale * scale) + b
+        # final block: dropout is eval-identity; BN affine + hardtanh
+        # feed the fp32 head (dropout-before-bn3 quirk preserved upstream)
+        h = jax.nn.hard_tanh(bns[2](y))
+        return jax.nn.log_softmax(
+            jnp.dot(h, head_w, preferred_element_type=jnp.float32) + head_b
+        )
+
+    return jax.jit(apply_fn)
+
+
+def freeze_qnn_mlp(
+    model: QnnMLP, variables: Dict, *, interpret: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Freeze a trained QnnMLP into int8 inference; matches
+    ``model.apply(variables, x, train=False)`` up to fp32-summation
+    noise (the frozen GEMMs accumulate exactly in int32)."""
+    frozen = _freeze_qnn_tensors(model, variables)
+    return _build_qnn_apply(frozen, interpret), frozen["info"]

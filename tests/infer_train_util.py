@@ -31,13 +31,15 @@ def trained_variables(model, batch, loss_of_output, *, steps=3, seed=0,
     tx = optax.adam(0.01)
     opt = tx.init(params)
 
+    drop_rng = {"dropout": jax.random.PRNGKey(seed + 2)}
+
     if stats is not None:
         @jax.jit
         def step(params, stats, opt):
             def loss_fn(p):
                 out, mut = model.apply(
                     {"params": p, "batch_stats": stats}, batch, train=True,
-                    mutable=["batch_stats"],
+                    mutable=["batch_stats"], rngs=drop_rng,
                 )
                 return loss_of_output(out), mut["batch_stats"]
 
@@ -55,7 +57,9 @@ def trained_variables(model, batch, loss_of_output, *, steps=3, seed=0,
     @jax.jit
     def step(params, opt):
         def loss_fn(p):
-            out = model.apply({"params": p}, batch, train=True)
+            out = model.apply(
+                {"params": p}, batch, train=True, rngs=drop_rng
+            )
             return loss_of_output(out)
 
         g = jax.grad(loss_fn)(params)

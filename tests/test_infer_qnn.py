@@ -1,0 +1,83 @@
+"""int8 serving for the k-bit QNN family (infer_qnn.py): the frozen
+integer path must match the live fp32 eval forward, and the artifact
+must round-trip through export/load."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.infer import export_packed, load_packed
+from distributed_mnist_bnns_tpu.infer_qnn import freeze_qnn_mlp
+from distributed_mnist_bnns_tpu.models.mlp import QnnMLP
+from distributed_mnist_bnns_tpu.ops.losses import cross_entropy_loss
+from tests.infer_train_util import trained_variables
+
+
+def _setup(num_bits=8):
+    model = QnnMLP(hidden=(96, 64, 48), num_bits=num_bits)
+    x = jax.random.normal(
+        jax.random.PRNGKey(3), (8, 28, 28, 1), jnp.float32
+    )
+    labels = jax.random.randint(jax.random.PRNGKey(4), (8,), 0, 10)
+    variables = trained_variables(
+        model, x, lambda out: cross_entropy_loss(out, labels)
+    )
+    return model, variables, x
+
+
+@pytest.mark.parametrize("num_bits", [8, 4])
+def test_frozen_qnn_matches_live_eval(num_bits):
+    """Exact-integer serving vs the live fp32 forward, at 8 and 4 bits
+    (both int8-representable grids)."""
+    model, variables, x = _setup(num_bits)
+    live = model.apply(variables, x, train=False)
+    frozen_fn, info = freeze_qnn_mlp(model, variables)
+    np.testing.assert_allclose(
+        np.asarray(frozen_fn(x)), np.asarray(live), atol=1e-4, rtol=1e-4,
+    )
+    assert info["family"] == "qnn-mlp"
+    assert info["compression"] == 4.0  # fp32 latents -> int8 weights
+
+
+def test_export_load_roundtrip(tmp_path):
+    model, variables, x = _setup()
+    live = model.apply(variables, x, train=False)
+    path = str(tmp_path / "qnn.packed")
+    info = export_packed(model, variables, path)
+    assert info["family"] == "qnn-mlp"
+    fn, info2 = load_packed(path)
+    assert info2["compression"] == info["compression"]
+    np.testing.assert_allclose(
+        np.asarray(fn(x)), np.asarray(live), atol=1e-4, rtol=1e-4,
+    )
+
+
+def test_wide_bits_rejected():
+    model, variables, _ = _setup()
+    wide = QnnMLP(hidden=(96, 64, 48), num_bits=12)
+    with pytest.raises(ValueError, match="num_bits"):
+        freeze_qnn_mlp(wide, variables)
+
+
+def test_cli_export_qnn(tmp_path, monkeypatch):
+    """CLI train -> export -> infer for the QNN family."""
+    from distributed_mnist_bnns_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    common = [
+        "--model", "qnn-mlp-large", "--infl-ratio", "1",
+        "--epochs", "1", "--batch-size", "32",
+        "--data-dir", "/nonexistent_use_synth",
+        "--synthetic-sizes", "128", "32",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    rc = main(["train", *common, "--log-file", str(tmp_path / "l1.txt")])
+    assert rc == 0
+    out = str(tmp_path / "qnn.msgpack")
+    rc = main(["export", *common, "--out", out,
+               "--log-file", str(tmp_path / "l2.txt")])
+    assert rc == 0
+    rc = main(["infer", *common, "--artifact", out,
+               "--log-file", str(tmp_path / "l3.txt")])
+    assert rc == 0
