@@ -270,3 +270,44 @@ def load_packed(
     with open(path, "rb") as f:
         frozen = serialization.msgpack_restore(f.read())
     return _build_any(frozen, interpret), dict(frozen["info"])
+
+
+def make_sharded_predictor(
+    frozen: Dict[str, Any], mesh, *, axis: str = "data",
+    interpret: bool = False,
+) -> Callable:
+    """Batch-shard a frozen predictor over a device mesh — offline /
+    high-throughput serving as explicit SPMD.
+
+    Each device runs the family's packed kernels on its batch shard with
+    the frozen weights broadcast (shard_map closure constants are
+    replicated), so the Pallas bitplane calls partition correctly —
+    GSPMD cannot auto-partition a ``pallas_call``, which is why this is
+    a ``shard_map`` and not a sharding-annotated jit. No collectives:
+    inference is embarrassingly data-parallel.
+
+    ``fn(images) -> log-probs`` with the global batch divisible by the
+    mesh's ``axis`` size. Accepts the in-memory frozen dict or anything
+    ``load_packed`` produced it from.
+
+    Equal to the single-device frozen forward for every family EXCEPT
+    ``bnn-moe-mlp``: MoE expert capacity is computed from the batch the
+    router sees (infer_moe.py), which under shard_map is the per-device
+    shard — the expert-parallel deployment semantic. Sharded MoE output
+    therefore equals the per-shard single-device forwards concatenated
+    (tested), not the global-batch routing.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    # shard_map the UN-jitted body (the builders return jit(apply_fn));
+    # one outer jit, same as the repo's other shard_map wrappers.
+    local_fn = _build_any(frozen, interpret)
+    local_fn = getattr(local_fn, "__wrapped__", local_fn)
+    shmapped = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis),),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(shmapped)

@@ -1,0 +1,98 @@
+"""Mesh-sharded frozen inference (infer.make_sharded_predictor): the
+shard_map data-parallel predictor must equal the single-device frozen
+forward on the 8-device CPU mesh, across artifact families."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from distributed_mnist_bnns_tpu.infer import (
+    _build_any,
+    _freeze_any,
+    make_sharded_predictor,
+)
+from distributed_mnist_bnns_tpu.ops.losses import cross_entropy_loss
+from tests.infer_train_util import trained_variables
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), axis_names=("data",))
+
+
+def _frozen_mlp():
+    from distributed_mnist_bnns_tpu.models.mlp import bnn_mlp_small
+
+    model = bnn_mlp_small(backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(3), (32, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(4), (32,), 0, 10)
+    variables = trained_variables(
+        model, x, lambda out: cross_entropy_loss(out, labels)
+    )
+    return _freeze_any(model, variables), x
+
+
+def test_sharded_matches_single_device():
+    frozen, x = _frozen_mlp()
+    single = _build_any(frozen, True)(x)
+    fn = make_sharded_predictor(frozen, _mesh(), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(fn(x)), np.asarray(single), atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_sharded_vit():
+    from distributed_mnist_bnns_tpu.models.transformer import bnn_vit_tiny
+
+    model = bnn_vit_tiny(attention="xla", backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(3), (16, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(4), (16,), 0, 10)
+    variables = trained_variables(
+        model, x,
+        lambda out: -jnp.take_along_axis(
+            out, labels[:, None], axis=-1
+        ).mean(),
+        init_rngs={"params": jax.random.PRNGKey(0)},
+    )
+    frozen = _freeze_any(model, variables)
+    single = _build_any(frozen, True)(x)
+    fn = make_sharded_predictor(frozen, _mesh(), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(fn(x)), np.asarray(single), atol=1e-4, rtol=1e-4,
+    )
+
+
+def test_indivisible_batch_raises():
+    frozen, x = _frozen_mlp()
+    fn = make_sharded_predictor(frozen, _mesh(), interpret=True)
+    with pytest.raises(ValueError):
+        fn(x[:30])  # 30 % 8 != 0
+
+
+def test_sharded_moe_equals_per_shard_oracle():
+    """MoE routes per shard under shard_map (capacity from the local
+    batch — the EP deployment semantic): the sharded output equals the
+    per-shard single-device forwards, concatenated."""
+    from distributed_mnist_bnns_tpu.models.moe import BnnMoEMLP
+
+    model = BnnMoEMLP(
+        hidden=64, num_experts=4, expert_features=64, backend="xla"
+    )
+    x = jax.random.normal(jax.random.PRNGKey(3), (32, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(4), (32,), 0, 10)
+    variables = trained_variables(
+        model, x, lambda out: cross_entropy_loss(out, labels)
+    )
+    frozen = _freeze_any(model, variables)
+    mesh = _mesh()
+    fn = make_sharded_predictor(frozen, mesh, interpret=True)
+    local = _build_any(frozen, True)
+    n = len(mesh.devices)
+    shard = x.shape[0] // n
+    oracle = jnp.concatenate(
+        [local(x[i * shard:(i + 1) * shard]) for i in range(n)]
+    )
+    np.testing.assert_allclose(
+        np.asarray(fn(x)), np.asarray(oracle), atol=1e-4, rtol=1e-4,
+    )
