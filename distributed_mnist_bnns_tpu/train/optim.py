@@ -68,6 +68,11 @@ OPTIMIZER_REGISTRY: Dict[str, Callable[..., optax.GradientTransformation]] = {
     "adadelta": optax.adadelta,
     "rprop": optax.rprop,
     "rmsprop": optax.rmsprop,
+    # Large-batch optimizers (beyond reference parity): layerwise trust
+    # ratios keep the bench's batch-4096 regime trainable at reference
+    # accuracy recipes scaled up — the standard TPU large-batch choices.
+    "lars": optax.lars,
+    "lamb": optax.lamb,
 }
 
 # Hyperparameter keys accepted per optimizer (anything else in a regime
