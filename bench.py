@@ -168,6 +168,7 @@ def _gemm_crossover(jax, jnp, deadline: float, reps: int = 3):
         prepack_weights,
         xnor_matmul,
         xnor_matmul_packed,
+        xnor_matmul_packed_sign,
     )
 
     def pm1(key, shape):
@@ -211,6 +212,22 @@ def _gemm_crossover(jax, jnp, deadline: float, reps: int = 3):
         packed = jax.jit(
             lambda x, wp=wp, k=k, n=n: xnor_matmul_packed(x, wp, k, n)
         )
+        # fused serving layer: packed GEMM + bias + BN-threshold-sign in
+        # one kernel (the frozen hidden-layer op, infer._build_apply) vs
+        # the unfused pair — measures the saved (M, N) fp32 round trip
+        av = jnp.ones((n,), jnp.float32)
+        tv = jnp.zeros((n,), jnp.float32)
+        bv = jnp.zeros((n,), jnp.float32)
+        fused_sign = jax.jit(
+            lambda x, wp=wp, k=k, n=n: xnor_matmul_packed_sign(
+                x, wp, k, n, av, tv, bv
+            )
+        )
+        unfused_sign = jax.jit(
+            lambda x, wp=wp, k=k, n=n: jnp.where(
+                xnor_matmul_packed(x, wp, k, n) + bv >= tv, 1.0, -1.0
+            )
+        )
         tops = 2.0 * m * k * n
         row = {}
         for bname, fn in (
@@ -219,6 +236,8 @@ def _gemm_crossover(jax, jnp, deadline: float, reps: int = 3):
             ("int8_cast", lambda x: int8(x, w)),
             ("pallas_xnor", lambda x: pallas(x, w)),
             ("pallas_xnor_prepacked_w", packed),
+            ("packed_sign_fused", fused_sign),
+            ("packed_sign_unfused", unfused_sign),
         ):
             if time.monotonic() > deadline:
                 row[bname] = "skipped (bench deadline; see PERF.md)"

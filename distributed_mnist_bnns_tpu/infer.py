@@ -35,23 +35,32 @@ from .ops.xnor_gemm import prepack_weights, xnor_matmul_packed
 _BN_EPS = 1e-5  # matches BnnMLP's BatchNorm epsilon
 
 
-def _bn_sign_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
-    """binarize(hardtanh(BN(y))) as a threshold compare returning ±1."""
+def _bn_sign_epilogue(
+    bn_params: Dict, bn_stats: Dict
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``binarize(hardtanh(BN(y)))`` as an (a, t) threshold encoding:
+    out = where(a*y >= t, +1, -1) with a=+1/t=theta (g>0: y >= theta),
+    a=-1/t=-theta (g<0: y <= theta), a=0/t=-c (g==0: the constant sign
+    of the BN bias — 0 >= -c picks c). theta = mu - b*sqrt(var+eps)/g.
+    Single source of the folding math for both the elementwise compare
+    (``_bn_sign_fn``) and the fused kernel epilogue
+    (ops.xnor_matmul_packed_sign)."""
     g = bn_params["scale"]
     b = bn_params["bias"]
     mu = bn_stats["mean"]
     s = jnp.sqrt(bn_stats["var"] + _BN_EPS)
     theta = mu - b * s / jnp.where(g == 0.0, 1.0, g)
+    a = jnp.sign(g)
+    c = jnp.where(b >= 0.0, 1.0, -1.0)
+    t = jnp.where(g > 0.0, theta, jnp.where(g < 0.0, -theta, -c))
+    return a.astype(jnp.float32), t.astype(jnp.float32)
 
-    def fn(y: jnp.ndarray) -> jnp.ndarray:
-        pos = jnp.where(
-            g > 0.0,
-            y >= theta,
-            jnp.where(g < 0.0, y <= theta, b >= 0.0),
-        )
-        return jnp.where(pos, 1.0, -1.0).astype(jnp.float32)
 
-    return fn
+def _bn_sign_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
+    """binarize(hardtanh(BN(y))) as a threshold compare returning ±1 —
+    the elementwise form of ``_bn_sign_epilogue``'s encoding."""
+    a, t = _bn_sign_epilogue(bn_params, bn_stats)
+    return lambda y: jnp.where(a * y >= t, 1.0, -1.0).astype(jnp.float32)
 
 
 def _bn_affine_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
@@ -119,6 +128,8 @@ def _freeze_tensors(model: BnnMLP, variables: Dict) -> Dict[str, Any]:
 def _build_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
     """Packed inference function from a frozen artifact (in-memory or
     restored from disk)."""
+    from .ops.xnor_gemm import xnor_matmul_packed_sign
+
     w1 = jnp.asarray(frozen["w1"], jnp.float32)  # disk artifact: int8 ±1
     b1 = jnp.asarray(frozen["b1"])
     sign1 = _bn_sign_fn(frozen["bn0"]["params"], frozen["bn0"]["stats"])
@@ -127,7 +138,11 @@ def _build_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
          jnp.asarray(l["bias"]))
         for l in frozen["layers"]
     ]
-    sign2 = _bn_sign_fn(frozen["bn1"]["params"], frozen["bn1"]["stats"])
+    # middle layer's GEMM + bias + BN-threshold fused in one kernel: the
+    # (M, N) fp32 pre-activation never round-trips HBM
+    a_mid, t_mid = _bn_sign_epilogue(
+        frozen["bn1"]["params"], frozen["bn1"]["stats"]
+    )
     affine3 = _bn_affine_fn(frozen["bn2"]["params"], frozen["bn2"]["stats"])
     wh = jnp.asarray(frozen["head_w"])
     bh = jnp.asarray(frozen["head_b"])
@@ -137,8 +152,9 @@ def _build_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
         y = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
         bits = sign1(y)
         wp, k, n, b2 = packed[0]
-        y = xnor_matmul_packed(bits, wp, k, n, interpret=interpret) + b2
-        bits = sign2(y)
+        bits = xnor_matmul_packed_sign(
+            bits, wp, k, n, a_mid, t_mid, b2, interpret=interpret
+        )
         wp, k, n, b3 = packed[1]
         y = xnor_matmul_packed(bits, wp, k, n, interpret=interpret) + b3
         # dropout is identity at eval; final block feeds the fp32 head with

@@ -121,6 +121,138 @@ def _xnor_kernel(x_ref, wt_ref, o_ref, *, real_k: int):
     o_ref[...] -= (2 * mism).astype(jnp.float32)
 
 
+def _xnor_sign_kernel(
+    x_ref, wt_ref, a_ref, t_ref, b_ref, o_ref, *, real_k: int, k_steps: int
+):
+    """``_xnor_kernel`` with the BN→threshold→sign epilogue fused in: after
+    the last K chunk's accumulation the tile becomes
+    ``where(a * (y + bias) >= t, +1, -1)`` — the frozen serving path's
+    ``binarize(hardtanh(BN(y + bias)))`` (infer._bn_sign_fn) without ever
+    writing the (M, N) fp32 pre-activation to HBM.
+
+    Per-column encoding (built by infer._bn_sign_epilogue):
+      g > 0:  a = +1, t = theta        (y >= theta)
+      g < 0:  a = -1, t = -theta       (y <= theta)
+      g == 0: a =  0, t = -sign-const  (0 >= -c picks the constant ±1)
+    """
+    from jax.experimental import pallas as pl
+
+    _xnor_kernel(x_ref, wt_ref, o_ref, real_k=real_k)
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _epilogue():
+        y = o_ref[...] + b_ref[...]
+        pos = a_ref[...] * y >= t_ref[...]
+        o_ref[...] = jnp.where(pos, 1.0, -1.0)
+
+
+class _PackedLayout:
+    """Block/grid layout shared by the packed-kernel entry points."""
+
+    def __init__(self, m, n, bm, bn, mp, np_, kc, k_steps):
+        self.m, self.n = m, n
+        self.bm, self.bn = bm, bn
+        self.mp, self.np_ = mp, np_
+        self.kc, self.k_steps = kc, k_steps
+
+
+def _prep_packed_operands(x_pm1, w_packed, k, n, block_m, block_n):
+    """Shared operand prep for ``xnor_matmul_packed`` /
+    ``xnor_matmul_packed_sign``: pack the activations, pad both operands
+    to the kernel's block layout, and compute the grid.
+
+    The packed-K axis becomes the innermost (sequential) grid dimension.
+    Mosaic requires the last block dim to be 128-divisible or equal to
+    the whole array dim, so: one chunk of the full packed-K when it is
+    small, otherwise 128-word (4096-bit) chunks. Zero words pad *both*
+    operands (equal bits -> zero extra mismatches -> the popcount formula
+    stays exact), and the K grid covers the PADDED extent (``kw_p``, not
+    ``kw`` — a partial final chunk, e.g. K=4160 -> 130 words, must still
+    be visited; zero-padding keeps it exact)."""
+    m, k2 = x_pm1.shape
+    assert k == k2, (x_pm1.shape, k)
+
+    bm = min(block_m, max(8, m))
+    bn = min(block_n, max(128, n))
+    mp = -(-m // bm) * bm
+
+    xp = pack_bits(x_pm1)                     # (M, KW)
+    wtp = w_packed                            # (KW_p, N_p)  K-major
+    kw = xp.shape[-1]
+    kc = kw if kw <= 128 else 128
+    # Padded dims: at least the kernel layout, and at least whatever
+    # layout the weights were prepacked with (a larger block_n at prepack
+    # time is fine — the extra zero columns are sliced off by callers).
+    kw_p = -(-max(kw, wtp.shape[0]) // kc) * kc
+    np_ = -(-max(n, wtp.shape[1]) // bn) * bn
+    if kw_p != kw:
+        xp = jnp.pad(xp, ((0, 0), (0, kw_p - kw)))
+    if mp != m:
+        xp = jnp.pad(xp, ((0, mp - m), (0, 0)))
+    if (kw_p, np_) != wtp.shape:  # unpadded/legacy layout: pad per call
+        wtp = jnp.pad(
+            wtp,
+            ((0, kw_p - wtp.shape[0]), (0, np_ - wtp.shape[1])),
+        )
+    return xp, wtp, _PackedLayout(m, n, bm, bn, mp, np_, kc, kw_p // kc)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n", "block_m", "block_n", "interpret")
+)
+def xnor_matmul_packed_sign(
+    x_pm1: jnp.ndarray,
+    w_packed: jnp.ndarray,
+    k: int,
+    n: int,
+    avec: jnp.ndarray,
+    tvec: jnp.ndarray,
+    bias: jnp.ndarray,
+    *,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(M, K) ±1 @ pre-packed weights with the threshold-sign epilogue
+    fused: returns ±1 activations ready for the next packed layer. Saves
+    the unfused path's full (M, N) fp32 round trip through HBM — the
+    dominant extra traffic of bandwidth-bound frozen serving at large
+    offline batches. ``avec``/``tvec``/``bias`` are (N,) per-output-column
+    epilogue params (see ``_xnor_sign_kernel``)."""
+    from jax.experimental import pallas as pl
+
+    xp, wtp, lay = _prep_packed_operands(
+        x_pm1, w_packed, k, n, block_m, block_n
+    )
+    # Padding columns: a=0, t=+1 -> "0 >= 1" false -> -1, sliced off.
+    pad = lay.np_ - n
+    a2 = jnp.pad(
+        avec.astype(jnp.float32), (0, pad)
+    ).reshape(1, lay.np_)
+    t2 = jnp.pad(
+        tvec.astype(jnp.float32), (0, pad), constant_values=1.0
+    ).reshape(1, lay.np_)
+    b2 = jnp.pad(bias.astype(jnp.float32), (0, pad)).reshape(1, lay.np_)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _xnor_sign_kernel, real_k=k, k_steps=lay.k_steps
+        ),
+        out_shape=jax.ShapeDtypeStruct((lay.mp, lay.np_), jnp.float32),
+        grid=(lay.mp // lay.bm, lay.np_ // lay.bn, lay.k_steps),
+        in_specs=[
+            pl.BlockSpec((lay.bm, lay.kc), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((lay.kc, lay.bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, lay.bn), lambda i, j, kk: (0, j)),
+            pl.BlockSpec((1, lay.bn), lambda i, j, kk: (0, j)),
+            pl.BlockSpec((1, lay.bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((lay.bm, lay.bn), lambda i, j, kk: (i, j)),
+        interpret=interpret,
+    )(xp, wtp, a2, t2, b2)
+    return out[: x_pm1.shape[0], :n]
+
+
 def prepack_weights(
     w_pm1: jnp.ndarray, block_n: int = 256
 ) -> tuple[jnp.ndarray, int, int]:
@@ -160,50 +292,21 @@ def xnor_matmul_packed(
     """(M, K) ±1 activations @ pre-packed weights (see prepack_weights)."""
     from jax.experimental import pallas as pl
 
-    m, k2 = x_pm1.shape
-    assert k == k2, (x_pm1.shape, k)
-
-    bm = min(block_m, max(8, m))
-    bn = min(block_n, max(128, n))
-    mp = -(-m // bm) * bm
-
-    # The packed-K axis becomes the innermost (sequential) grid dimension.
-    # Mosaic requires the last block dim to be 128-divisible or equal to the
-    # whole array dim, so: one chunk of the full packed-K when it is small,
-    # otherwise 128-word (4096-bit) chunks. Zero words pad *both* operands
-    # (equal bits -> zero extra mismatches -> the popcount formula stays
-    # exact).
-    xp = pack_bits(x_pm1)                     # (M, KW)
-    wtp = w_packed                            # (KW_p, N_p)  K-major
-    kw = xp.shape[-1]
-    kc = kw if kw <= 128 else 128
-    # Padded dims: at least the kernel layout, and at least whatever layout
-    # the weights were prepacked with (a larger block_n at prepack time is
-    # fine — the extra zero columns are sliced off below).
-    kw_p = -(-max(kw, wtp.shape[0]) // kc) * kc
-    np_ = -(-max(n, wtp.shape[1]) // bn) * bn
-    if kw_p != kw:
-        xp = jnp.pad(xp, ((0, 0), (0, kw_p - kw)))
-    if mp != m:
-        xp = jnp.pad(xp, ((0, mp - m), (0, 0)))
-    if (kw_p, np_) != wtp.shape:  # unpadded/legacy layout: pad per call
-        wtp = jnp.pad(
-            wtp,
-            ((0, kw_p - wtp.shape[0]), (0, np_ - wtp.shape[1])),
-        )
-
+    xp, wtp, lay = _prep_packed_operands(
+        x_pm1, w_packed, k, n, block_m, block_n
+    )
     out = pl.pallas_call(
         functools.partial(_xnor_kernel, real_k=k),
-        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
-        grid=(mp // bm, np_ // bn, kw // kc),
+        out_shape=jax.ShapeDtypeStruct((lay.mp, lay.np_), jnp.float32),
+        grid=(lay.mp // lay.bm, lay.np_ // lay.bn, lay.k_steps),
         in_specs=[
-            pl.BlockSpec((bm, kc), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((kc, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((lay.bm, lay.kc), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((lay.kc, lay.bn), lambda i, j, kk: (kk, j)),
         ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_specs=pl.BlockSpec((lay.bm, lay.bn), lambda i, j, kk: (i, j)),
         interpret=interpret,
     )(xp, wtp)
-    return out[:m, :n]
+    return out[: x_pm1.shape[0], :n]
 
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))

@@ -218,3 +218,113 @@ def test_prepacked_weights_matmul_matches():
     wp, k, n = prepack_weights(w)
     out = xnor_matmul_packed(x, wp, k, n, interpret=True)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.dot(x, w)))
+
+
+class TestFusedSignEpilogue:
+    """xnor_matmul_packed_sign: GEMM + bias + BN-threshold-sign in one
+    kernel — must equal sign-fn(unfused GEMM + bias) exactly, including
+    the g<0 flipped compare, the g==0 constant column, and threshold
+    ties (>= boundary semantics)."""
+
+    def _oracle(self, x, w, bias, bn_params, bn_stats):
+        from distributed_mnist_bnns_tpu.infer import _bn_sign_fn
+        from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+            prepack_weights,
+            xnor_matmul_packed,
+        )
+
+        wp, k, n = prepack_weights(w)
+        y = xnor_matmul_packed(x, wp, k, n, interpret=True) + bias
+        return _bn_sign_fn(bn_params, bn_stats)(y)
+
+    def _fused(self, x, w, bias, bn_params, bn_stats):
+        from distributed_mnist_bnns_tpu.infer import _bn_sign_epilogue
+        from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+            prepack_weights,
+            xnor_matmul_packed_sign,
+        )
+
+        wp, k, n = prepack_weights(w)
+        a, t = _bn_sign_epilogue(bn_params, bn_stats)
+        return xnor_matmul_packed_sign(
+            x, wp, k, n, a, t, bias, interpret=True
+        )
+
+    def test_matches_unfused_including_sign_edge_cases(self):
+        import jax
+
+        from distributed_mnist_bnns_tpu.ops.binarize import binarize_ste
+
+        m, k, n = 24, 96, 160
+        x = binarize_ste(jax.random.normal(jax.random.PRNGKey(0), (m, k)))
+        w = binarize_ste(jax.random.normal(jax.random.PRNGKey(1), (k, n)))
+        bias = jax.random.normal(jax.random.PRNGKey(2), (n,))
+        # scale crosses zero: negative, zero and positive gammas all live
+        g = jnp.linspace(-1.0, 1.0, n)
+        g = g.at[n // 2].set(0.0)
+        bn_params = {
+            "scale": g,
+            "bias": jax.random.normal(jax.random.PRNGKey(3), (n,)),
+        }
+        bn_stats = {
+            "mean": jax.random.normal(jax.random.PRNGKey(4), (n,)) * 4,
+            "var": jnp.abs(
+                jax.random.normal(jax.random.PRNGKey(5), (n,))
+            ) + 0.5,
+        }
+        np.testing.assert_array_equal(
+            np.asarray(self._fused(x, w, bias, bn_params, bn_stats)),
+            np.asarray(self._oracle(x, w, bias, bn_params, bn_stats)),
+        )
+
+    def test_threshold_tie_hits_ge_semantics(self):
+        """Engineer an exact tie: y + bias == theta must give +1 for
+        g > 0 (the live model's binarize(0) = +1 via sign >= 0)."""
+        m, k, n = 8, 32, 128
+        x = jnp.ones((m, k), jnp.float32)
+        w = jnp.ones((k, n), jnp.float32)  # y = K exactly
+        # theta = mu - b*sqrt(var+eps)/g; choose mu=K+bias, b=0 -> tie
+        bias = jnp.zeros((n,))
+        bn_params = {"scale": jnp.ones((n,)), "bias": jnp.zeros((n,))}
+        bn_stats = {
+            "mean": jnp.full((n,), float(k)),
+            "var": jnp.ones((n,)),
+        }
+        out = self._fused(x, w, bias, bn_params, bn_stats)
+        assert (np.asarray(out) == 1.0).all()
+        oracle = self._oracle(x, w, bias, bn_params, bn_stats)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(oracle))
+
+
+def test_packed_kernel_partial_final_k_chunk():
+    """Regression: K whose packed word count exceeds 128 and is not a
+    multiple of 128 (e.g. K=4160 -> 130 words) must still visit the
+    final partial chunk — the grid covers the PADDED K extent. This was
+    silently wrong before round 4 (grid used kw // kc)."""
+    import jax
+
+    from distributed_mnist_bnns_tpu.ops.binarize import binarize_ste
+    from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+        prepack_weights,
+        xnor_matmul_packed,
+        xnor_matmul_packed_sign,
+    )
+
+    for k in (4160, 4608):
+        x = binarize_ste(jax.random.normal(jax.random.PRNGKey(0), (8, k)))
+        w = binarize_ste(
+            jax.random.normal(jax.random.PRNGKey(1), (k, 128))
+        )
+        wp, kk, n = prepack_weights(w)
+        y = xnor_matmul_packed(x, wp, kk, n, interpret=True)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x @ w))
+        # fused variant over the same padded-K grid (trivial epilogue:
+        # a=1, t=0, bias=0 -> sign of the exact GEMM)
+        s = xnor_matmul_packed_sign(
+            x, wp, kk, n,
+            jnp.ones((n,)), jnp.zeros((n,)), jnp.zeros((n,)),
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s), np.asarray(jnp.where(x @ w >= 0, 1.0, -1.0))
+        )
