@@ -328,3 +328,46 @@ def test_packed_kernel_partial_final_k_chunk():
         np.testing.assert_array_equal(
             np.asarray(s), np.asarray(jnp.where(x @ w >= 0, 1.0, -1.0))
         )
+
+
+def test_packed_kernel_shape_sweep_vs_oracle():
+    """Property sweep: the packed kernel (and its fused-sign variant)
+    must be exact against the fp32 oracle across awkward shapes — odd
+    M, non-multiple-of-32 K (partial pack words), K word counts just
+    above/below the 128-word chunk boundary, and non-multiple-of-block
+    N. The K=4160 truncation bug (fixed round 4) lived exactly in this
+    space."""
+    from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+        prepack_weights,
+        xnor_matmul_packed,
+        xnor_matmul_packed_sign,
+    )
+
+    shapes = [
+        (1, 32, 128),     # single row
+        (7, 63, 130),     # odd everything, partial pack word
+        (9, 100, 257),    # N one past a block_n=256 block boundary
+        (16, 4095, 128),  # K one under the 128-word boundary*32
+        (16, 4097, 128),  # K one over
+        (3, 8193, 140),   # 2 chunks + 1 word, odd N
+        (33, 256, 384),
+    ]
+    for i, (m, k, n) in enumerate(shapes):
+        x = _pm1(jax.random.PRNGKey(2 * i), (m, k))
+        w = _pm1(jax.random.PRNGKey(2 * i + 1), (k, n))
+        wp, kk, nn_ = prepack_weights(w)
+        y = xnor_matmul_packed(x, wp, kk, nn_, interpret=True)
+        exact = x @ w
+        np.testing.assert_array_equal(
+            np.asarray(y), np.asarray(exact), err_msg=f"shape {(m, k, n)}"
+        )
+        s = xnor_matmul_packed_sign(
+            x, wp, kk, nn_,
+            jnp.ones((n,)), jnp.zeros((n,)), jnp.zeros((n,)),
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s),
+            np.asarray(jnp.where(exact >= 0, 1.0, -1.0)),
+            err_msg=f"fused shape {(m, k, n)}",
+        )
