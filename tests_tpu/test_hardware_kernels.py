@@ -118,6 +118,56 @@ def test_prepacked_xnor_matmul_on_chip():
     np.testing.assert_array_equal(got, want)
 
 
+def test_fused_sign_epilogue_on_chip():
+    """xnor_matmul_packed_sign un-interpreted: the GEMM + bias +
+    BN-threshold-sign epilogue must lower through Mosaic and stay exact
+    vs the unfused pair, including a partial final K chunk (K=4160 —
+    the round-4 grid-truncation regression) and g<0 / g==0 columns."""
+    from distributed_mnist_bnns_tpu.infer import (
+        _bn_sign_epilogue,
+        _bn_sign_fn,
+    )
+    from distributed_mnist_bnns_tpu.ops import prepack_weights
+    from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+        xnor_matmul_packed,
+        xnor_matmul_packed_sign,
+    )
+
+    for m, k, n in ((8, 3072, 1536), (8, 4160, 256)):
+        x = _pm1(5, (m, k))
+        w = _pm1(6, (k, n))
+        wp, kk, nn_ = prepack_weights(w)
+        bias = np.random.RandomState(7).randn(n).astype(np.float32)
+        g = np.linspace(-1.0, 1.0, n).astype(np.float32)
+        g[n // 2] = 0.0
+        bn_params = {
+            "scale": jnp.asarray(g),
+            "bias": jnp.asarray(
+                np.random.RandomState(8).randn(n).astype(np.float32)
+            ),
+        }
+        bn_stats = {
+            "mean": jnp.asarray(
+                np.random.RandomState(9).randn(n).astype(np.float32) * 8
+            ),
+            "var": jnp.asarray(
+                np.abs(np.random.RandomState(10).randn(n)).astype(
+                    np.float32
+                ) + 0.5
+            ),
+        }
+        a, t = _bn_sign_epilogue(bn_params, bn_stats)
+        got = np.asarray(
+            xnor_matmul_packed_sign(x, wp, kk, nn_, a, t, bias)
+        )
+        want = np.asarray(
+            _bn_sign_fn(bn_params, bn_stats)(
+                xnor_matmul_packed(x, wp, kk, nn_) + bias
+            )
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"{(m, k, n)}")
+
+
 def test_bnn_vit_flash_forward_on_chip():
     """BinarizedTransformer with attention='flash' (real Mosaic lowering)
     matches its attention='xla' twin on identical params — the model-level
