@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .infer import _bn_affine_fn, _bn_sign_fn
+from .infer import _bn_affine_fn, _bn_sign_epilogue, _bn_sign_fn
 from .models.bnn_cnn import BinarizedCNN
 from .models.resnet import XnorResNet
 from .ops.binarize import binarize_ste
@@ -49,6 +49,7 @@ from .ops.xnor_gemm import (
     conv_patch_weight,
     prepack_weights,
     xnor_matmul_packed,
+    xnor_matmul_packed_sign,
 )
 
 _HI = jax.lax.Precision.HIGHEST
@@ -109,6 +110,35 @@ def _packed_conv_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
             patches.reshape(-1, k), wp, k, n, interpret=interpret
         ).reshape(nb, ho, wo, n)
         return y + corr + bias
+
+    return fn
+
+
+def _packed_conv1x1_sign_fn(
+    layer: Dict[str, Any], avec, tvec, interpret: bool
+) -> Callable:
+    """Fused 1x1/stride-1 conv + next-BN threshold: a 1x1 SAME conv has
+    no padding taps (corr == 0) and its im2col patches ARE the input, so
+    the whole BN->sign->NEXT-layer handoff collapses into the packed
+    GEMM's sign epilogue (ops.xnor_matmul_packed_sign) — the (B, H, W, F)
+    fp32 pre-activation never round-trips HBM. Only built when the
+    conv's sole consumer is the next pair's sign (block interiors)."""
+    wp = jnp.asarray(layer["wp"])
+    bias = jnp.asarray(layer["bias"])
+    k, n = int(layer["k"]), int(layer["n"])
+    in_hw = tuple(int(d) for d in layer["in_hw"])
+
+    def fn(bits: jnp.ndarray) -> jnp.ndarray:
+        if tuple(bits.shape[1:3]) != in_hw:
+            raise ValueError(
+                f"frozen conv was packed for {in_hw} inputs, got "
+                f"{tuple(bits.shape[1:3])} (re-freeze for this size)"
+            )
+        nb, ho, wo, _ = bits.shape
+        return xnor_matmul_packed_sign(
+            bits.reshape(-1, k), wp, k, n, avec, tvec, bias,
+            interpret=interpret,
+        ).reshape(nb, ho, wo, n)
 
     return fn
 
@@ -330,6 +360,39 @@ def _freeze_resnet_tensors(
     return frozen
 
 
+def _resnet_block_pairs(convs: list, interpret: bool) -> list:
+    """(sign_fn | None, conv_fn) pairs for one block's BN->sign->conv
+    chain. Fuses a block-interior 1x1/stride-1 conv with the NEXT pair's
+    BN threshold: its output's only consumer is that sign, and a 1x1
+    SAME conv has corr == 0, so the packed GEMM emits the next layer's
+    ±1 bits directly (bottleneck blocks: conv0; basic blocks have no
+    1x1). A ``None`` sign marks a pair whose input bits already carry
+    the threshold (the previous conv fused it)."""
+    pairs = []
+    skip_sign = False
+    for idx, c in enumerate(convs):
+        sign = (
+            None if skip_sign
+            else _bn_sign_fn(c["bn"]["params"], c["bn"]["stats"])
+        )
+        skip_sign = False
+        layer = c["conv"]
+        if (
+            idx + 1 < len(convs)
+            and int(layer["kh"]) == 1 and int(layer["kw"]) == 1
+            and tuple(int(x) for x in layer["strides"]) == (1, 1)
+        ):
+            nxt = convs[idx + 1]["bn"]
+            a, t = _bn_sign_epilogue(nxt["params"], nxt["stats"])
+            pairs.append(
+                (sign, _packed_conv1x1_sign_fn(layer, a, t, interpret))
+            )
+            skip_sign = True
+        else:
+            pairs.append((sign, _packed_conv_fn(layer, interpret)))
+    return pairs
+
+
 def _build_resnet_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
     arch = frozen["arch"]
     ishape = tuple(int(d) for d in arch["input_shape"])
@@ -347,13 +410,7 @@ def _build_resnet_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
             )
         strides = int(blk["strides"])
         blocks.append({
-            "convs": [
-                (
-                    _bn_sign_fn(c["bn"]["params"], c["bn"]["stats"]),
-                    _packed_conv_fn(c["conv"], interpret),
-                )
-                for c in blk["convs"]
-            ],
+            "convs": _resnet_block_pairs(blk["convs"], interpret),
             "shortcut": (
                 _fp32_conv_fn(
                     blk["shortcut_w"], None, (strides, strides)
@@ -382,7 +439,7 @@ def _build_resnet_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
         for blk in blocks:
             y = x
             for sign, conv in blk["convs"]:
-                y = conv(sign(y))
+                y = conv(sign(y) if sign is not None else y)
             shortcut = x if blk["shortcut"] is None else blk["shortcut"](x)
             x = y + shortcut
         x = jax.nn.relu(affine_final(x)).mean(axis=(1, 2))

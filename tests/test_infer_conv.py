@@ -225,3 +225,44 @@ def test_cli_infer_subcommand(tmp_path, monkeypatch, capsys):
     # packed serving reproduces the live model's eval accuracy (up to
     # measure-zero threshold ties)
     assert abs(out["test_acc"] - eval_acc) <= 100.0 / 64 + 1e-6
+
+
+def test_bottleneck_fusion_actually_constructed():
+    """Guard the 1x1 fusion gate: bottleneck blocks must build their
+    conv0 as the FUSED form (next pair's sign is None — the threshold
+    rides the GEMM epilogue), while basic blocks (no 1x1) fuse nothing.
+    Without this, a broken gate silently degrades to the unfused path
+    with the equality tests still green."""
+    from distributed_mnist_bnns_tpu.infer_conv import (
+        _freeze_resnet_tensors,
+        _resnet_block_pairs,
+    )
+    from distributed_mnist_bnns_tpu.models.resnet import XnorResNet
+    import jax
+
+    def frozen_blocks(model, shape):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, *shape))
+        variables = model.init(
+            {"params": jax.random.PRNGKey(1)}, x, train=True
+        )
+        return _freeze_resnet_tensors(model, variables, shape)["blocks"]
+
+    # bottleneck: [1x1, 3x3, 1x1] -> conv0 fuses (pair 1's sign is None)
+    blocks50 = frozen_blocks(
+        XnorResNet(stage_sizes=(1, 1), bottleneck=True,
+                   stem_features=16),
+        (32, 32, 3),
+    )
+    for blk in blocks50:
+        pairs = _resnet_block_pairs(blk["convs"], interpret=True)
+        assert pairs[0][0] is not None
+        assert pairs[1][0] is None, "conv0's fusion did not fire"
+        assert pairs[2][0] is not None  # conv2 feeds the residual add
+
+    # basic: [3x3, 3x3] -> nothing fuses
+    blocks18 = frozen_blocks(
+        XnorResNet(stage_sizes=(1, 1), stem_features=16), (32, 32, 3)
+    )
+    for blk in blocks18:
+        pairs = _resnet_block_pairs(blk["convs"], interpret=True)
+        assert all(sign is not None for sign, _ in pairs)
