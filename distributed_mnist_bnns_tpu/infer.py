@@ -30,7 +30,11 @@ import numpy as np
 
 from .models.mlp import BnnMLP
 from .ops.binarize import binarize_ste
-from .ops.xnor_gemm import prepack_weights, xnor_matmul_packed
+from .ops.xnor_gemm import (
+    prepack_weights,
+    xnor_matmul_packed_affine,
+    xnor_matmul_packed_sign,
+)
 
 _BN_EPS = 1e-5  # matches BnnMLP's BatchNorm epsilon
 
@@ -63,14 +67,22 @@ def _bn_sign_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
     return lambda y: jnp.where(a * y >= t, 1.0, -1.0).astype(jnp.float32)
 
 
-def _bn_affine_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
-    """Eval-time BN as a precomputed per-channel affine: a*y + c."""
+def _bn_affine_params(
+    bn_params: Dict, bn_stats: Dict
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Eval-time BN as per-channel (a, c): BN(y) = a*y + c. Shared by the
+    elementwise form (``_bn_affine_fn``) and the fused kernel epilogue
+    (ops.xnor_matmul_packed_affine)."""
     g = bn_params["scale"]
     b = bn_params["bias"]
     mu = bn_stats["mean"]
     s = jnp.sqrt(bn_stats["var"] + _BN_EPS)
-    a = g / s
-    c = b - g * mu / s
+    return g / s, b - g * mu / s
+
+
+def _bn_affine_fn(bn_params: Dict, bn_stats: Dict) -> Callable:
+    """Eval-time BN as a precomputed per-channel affine: a*y + c."""
+    a, c = _bn_affine_params(bn_params, bn_stats)
     return lambda y: a * y + c
 
 
@@ -128,8 +140,6 @@ def _freeze_tensors(model: BnnMLP, variables: Dict) -> Dict[str, Any]:
 def _build_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
     """Packed inference function from a frozen artifact (in-memory or
     restored from disk)."""
-    from .ops.xnor_gemm import xnor_matmul_packed_sign
-
     w1 = jnp.asarray(frozen["w1"], jnp.float32)  # disk artifact: int8 ±1
     b1 = jnp.asarray(frozen["b1"])
     sign1 = _bn_sign_fn(frozen["bn0"]["params"], frozen["bn0"]["stats"])
@@ -138,12 +148,17 @@ def _build_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
          jnp.asarray(l["bias"]))
         for l in frozen["layers"]
     ]
-    # middle layer's GEMM + bias + BN-threshold fused in one kernel: the
-    # (M, N) fp32 pre-activation never round-trips HBM
+    # hidden layers fuse their epilogues into the packed GEMM kernels —
+    # the (M, N) fp32 pre-activations never round-trip HBM: the middle
+    # layer emits the next layer's ±1 bits (BN-threshold-sign epilogue),
+    # the final packed layer emits the head's hardtanh values (eval-BN
+    # affine + clip epilogue; dropout is identity at eval).
     a_mid, t_mid = _bn_sign_epilogue(
         frozen["bn1"]["params"], frozen["bn1"]["stats"]
     )
-    affine3 = _bn_affine_fn(frozen["bn2"]["params"], frozen["bn2"]["stats"])
+    a_fin, c_fin = _bn_affine_params(
+        frozen["bn2"]["params"], frozen["bn2"]["stats"]
+    )
     wh = jnp.asarray(frozen["head_w"])
     bh = jnp.asarray(frozen["head_b"])
 
@@ -156,10 +171,9 @@ def _build_apply(frozen: Dict[str, Any], interpret: bool) -> Callable:
             bits, wp, k, n, a_mid, t_mid, b2, interpret=interpret
         )
         wp, k, n, b3 = packed[1]
-        y = xnor_matmul_packed(bits, wp, k, n, interpret=interpret) + b3
-        # dropout is identity at eval; final block feeds the fp32 head with
-        # real hardtanh values, so compute the actual affine here.
-        h = jnp.clip(affine3(y), -1.0, 1.0)
+        h = xnor_matmul_packed_affine(
+            bits, wp, k, n, a_fin, c_fin, b3, interpret=interpret
+        )
         logits = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
         return jax.nn.log_softmax(logits)
 

@@ -146,6 +146,89 @@ def _xnor_sign_kernel(
         o_ref[...] = jnp.where(pos, 1.0, -1.0)
 
 
+def _xnor_affine_kernel(
+    x_ref, wt_ref, a_ref, c_ref, b_ref, o_ref, *, real_k: int, k_steps: int
+):
+    """``_xnor_kernel`` with the eval-BN affine + hardtanh epilogue fused:
+    after the last K chunk the tile becomes
+    ``clip(a * (y + bias) + c, -1, 1)`` — the frozen path's
+    ``hardtanh(BN(y + bias))`` feeding an fp32 head (infer._bn_affine_fn
+    followed by the clip), without the (M, N) fp32 HBM round trip."""
+    from jax.experimental import pallas as pl
+
+    _xnor_kernel(x_ref, wt_ref, o_ref, real_k=real_k)
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _epilogue():
+        y = a_ref[...] * (o_ref[...] + b_ref[...]) + c_ref[...]
+        o_ref[...] = jnp.clip(y, -1.0, 1.0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n", "block_m", "block_n", "interpret")
+)
+def xnor_matmul_packed_affine(
+    x_pm1: jnp.ndarray,
+    w_packed: jnp.ndarray,
+    k: int,
+    n: int,
+    avec: jnp.ndarray,
+    cvec: jnp.ndarray,
+    bias: jnp.ndarray,
+    *,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(M, K) ±1 @ pre-packed weights with the eval-BN affine + hardtanh
+    clip fused: returns ``clip(a*(y+bias)+c, -1, 1)`` ready for an fp32
+    head — the final-block form of frozen MLP serving (the sign form is
+    ``xnor_matmul_packed_sign``)."""
+    xp, wtp, lay = _prep_packed_operands(
+        x_pm1, w_packed, k, n, block_m, block_n
+    )
+    return _packed_pallas_call(
+        functools.partial(
+            _xnor_affine_kernel, real_k=k, k_steps=lay.k_steps
+        ),
+        lay, xp, wtp,
+        [_pad_cols(avec, lay), _pad_cols(cvec, lay), _pad_cols(bias, lay)],
+        interpret,
+    )
+
+
+def _pad_cols(vec, lay, fill=0.0):
+    """(N,) per-column epilogue vector -> (1, N_p) padded block row."""
+    return jnp.pad(
+        vec.astype(jnp.float32), (0, lay.np_ - lay.n),
+        constant_values=fill,
+    ).reshape(1, lay.np_)
+
+
+def _packed_pallas_call(kernel_fn, lay, xp, wtp, extra, interpret):
+    """The one pallas_call shared by every packed entry point: (x, w)
+    blocks plus any number of per-column (1, bn) epilogue rows. All
+    layout/grid decisions live in ``_prep_packed_operands`` so a tiling
+    fix lands everywhere at once (the round-4 K-grid bug was exactly a
+    divergence of this scaffolding)."""
+    from jax.experimental import pallas as pl
+
+    col = pl.BlockSpec((1, lay.bn), lambda i, j, kk: (0, j))
+    out = pl.pallas_call(
+        kernel_fn,
+        out_shape=jax.ShapeDtypeStruct((lay.mp, lay.np_), jnp.float32),
+        grid=(lay.mp // lay.bm, lay.np_ // lay.bn, lay.k_steps),
+        in_specs=[
+            pl.BlockSpec((lay.bm, lay.kc), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((lay.kc, lay.bn), lambda i, j, kk: (kk, j)),
+            *([col] * len(extra)),
+        ],
+        out_specs=pl.BlockSpec((lay.bm, lay.bn), lambda i, j, kk: (i, j)),
+        interpret=interpret,
+    )(xp, wtp, *extra)
+    return out[: lay.m, : lay.n]
+
+
 class _PackedLayout:
     """Block/grid layout shared by the packed-kernel entry points."""
 
@@ -290,23 +373,13 @@ def xnor_matmul_packed(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """(M, K) ±1 activations @ pre-packed weights (see prepack_weights)."""
-    from jax.experimental import pallas as pl
-
     xp, wtp, lay = _prep_packed_operands(
         x_pm1, w_packed, k, n, block_m, block_n
     )
-    out = pl.pallas_call(
+    return _packed_pallas_call(
         functools.partial(_xnor_kernel, real_k=k),
-        out_shape=jax.ShapeDtypeStruct((lay.mp, lay.np_), jnp.float32),
-        grid=(lay.mp // lay.bm, lay.np_ // lay.bn, lay.k_steps),
-        in_specs=[
-            pl.BlockSpec((lay.bm, lay.kc), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((lay.kc, lay.bn), lambda i, j, kk: (kk, j)),
-        ],
-        out_specs=pl.BlockSpec((lay.bm, lay.bn), lambda i, j, kk: (i, j)),
-        interpret=interpret,
-    )(xp, wtp)
-    return out[: x_pm1.shape[0], :n]
+        lay, xp, wtp, [], interpret,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))

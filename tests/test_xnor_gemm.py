@@ -371,3 +371,45 @@ def test_packed_kernel_shape_sweep_vs_oracle():
             np.asarray(jnp.where(exact >= 0, 1.0, -1.0)),
             err_msg=f"fused shape {(m, k, n)}",
         )
+
+
+def test_fused_affine_epilogue_matches_unfused():
+    """xnor_matmul_packed_affine: GEMM + bias + eval-BN affine + hardtanh
+    clip in one kernel equals the unfused chain exactly (incl. a partial
+    final K chunk and saturating clip values)."""
+    from distributed_mnist_bnns_tpu.infer import (
+        _bn_affine_fn,
+        _bn_affine_params,
+    )
+    from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+        prepack_weights,
+        xnor_matmul_packed,
+        xnor_matmul_packed_affine,
+    )
+
+    for m, k, n in ((8, 96, 160), (4, 4160, 128)):
+        x = _pm1(jax.random.PRNGKey(0), (m, k))
+        w = _pm1(jax.random.PRNGKey(1), (k, n))
+        wp, kk, nn_ = prepack_weights(w)
+        bias = jax.random.normal(jax.random.PRNGKey(2), (n,))
+        bn_params = {
+            "scale": jax.random.normal(jax.random.PRNGKey(3), (n,)),
+            "bias": jax.random.normal(jax.random.PRNGKey(4), (n,)),
+        }
+        bn_stats = {
+            "mean": jax.random.normal(jax.random.PRNGKey(5), (n,)) * 4,
+            "var": jnp.abs(
+                jax.random.normal(jax.random.PRNGKey(6), (n,))
+            ) + 0.5,
+        }
+        a, c = _bn_affine_params(bn_params, bn_stats)
+        got = xnor_matmul_packed_affine(
+            x, wp, kk, nn_, a, c, bias, interpret=True
+        )
+        affine = _bn_affine_fn(bn_params, bn_stats)
+        y = xnor_matmul_packed(x, wp, kk, nn_, interpret=True) + bias
+        want = jnp.clip(affine(y), -1.0, 1.0)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-6, rtol=1e-6,
+            err_msg=f"{(m, k, n)}",
+        )
