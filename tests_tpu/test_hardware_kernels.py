@@ -167,6 +167,28 @@ def test_fused_sign_epilogue_on_chip():
         )
         np.testing.assert_array_equal(got, want, err_msg=f"{(m, k, n)}")
 
+        # the affine+clip epilogue variant on the same operands
+        from distributed_mnist_bnns_tpu.infer import (
+            _bn_affine_fn,
+            _bn_affine_params,
+        )
+        from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+            xnor_matmul_packed_affine,
+        )
+
+        aa, cc = _bn_affine_params(bn_params, bn_stats)
+        got_a = np.asarray(
+            xnor_matmul_packed_affine(x, wp, kk, nn_, aa, cc, bias)
+        )
+        want_a = np.asarray(jnp.clip(
+            _bn_affine_fn(bn_params, bn_stats)(
+                xnor_matmul_packed(x, wp, kk, nn_) + bias
+            ), -1.0, 1.0,
+        ))
+        np.testing.assert_allclose(
+            got_a, want_a, atol=1e-6, rtol=1e-6, err_msg=f"{(m, k, n)}"
+        )
+
 
 def test_bnn_vit_flash_forward_on_chip():
     """BinarizedTransformer with attention='flash' (real Mosaic lowering)
