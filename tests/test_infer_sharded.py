@@ -96,3 +96,22 @@ def test_sharded_moe_equals_per_shard_oracle():
     np.testing.assert_allclose(
         np.asarray(fn(x)), np.asarray(oracle), atol=1e-4, rtol=1e-4,
     )
+
+
+def test_sharded_qnn():
+    """The int8 QNN predictor shards the same way (XLA int8 dots need no
+    shard_map special-casing, but the API should be uniform)."""
+    from distributed_mnist_bnns_tpu.models.mlp import QnnMLP
+
+    model = QnnMLP(hidden=(96, 64, 48))
+    x = jax.random.normal(jax.random.PRNGKey(3), (16, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(4), (16,), 0, 10)
+    variables = trained_variables(
+        model, x, lambda out: cross_entropy_loss(out, labels)
+    )
+    frozen = _freeze_any(model, variables)
+    single = _build_any(frozen, True)(x)
+    fn = make_sharded_predictor(frozen, _mesh(), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(fn(x)), np.asarray(single), atol=1e-5, rtol=1e-5,
+    )
