@@ -73,3 +73,25 @@ def test_lm_kv_decoder_on_chip():
     dt = (time.perf_counter() - t0) / reps
     print(f"kv-decode per-token latency {dt * 1e3:.3f} ms")
     assert dt < 5.0  # sanity only: tunnel jitter dominates small calls
+
+
+def test_qnn_int8_serving_on_chip():
+    """The k-bit QNN's int8 x int8 -> int32 serving GEMMs on the real
+    MXU int8 pipeline: frozen predictions agree with the live fp32
+    forward (exact integer accumulation vs fp32 summation noise)."""
+    from distributed_mnist_bnns_tpu.infer_qnn import freeze_qnn_mlp
+    from distributed_mnist_bnns_tpu.models.mlp import QnnMLP
+
+    model = QnnMLP(hidden=(256, 128, 64))
+    x = jax.random.normal(jax.random.PRNGKey(3), (64, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        x[:1], train=True,
+    )
+    live = np.asarray(model.apply(variables, x, train=False))
+    frozen_fn, info = freeze_qnn_mlp(model, variables)
+    got = np.asarray(frozen_fn(x))
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, live, atol=5e-3, rtol=5e-3)
+    assert info["compression"] == 4.0
