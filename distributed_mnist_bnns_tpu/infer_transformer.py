@@ -302,9 +302,24 @@ def _build_transformer_apply(
     w_embed = jnp.asarray(frozen["w_embed"], jnp.float32)  # disk: int8 ±1
     b_embed = jnp.asarray(frozen["b_embed"], jnp.float32)
 
+    n_tokens = int(pos.shape[1])
+
     def apply_fn(images: jnp.ndarray) -> jnp.ndarray:
         b, h, w, c = images.shape
+        # Static shapes: raise at trace time like the live model's
+        # divisibility check (models/transformer.py) — without this, a
+        # non-divisible or wrong-resolution input would silently truncate
+        # border pixels and serve finite-but-wrong log-probs.
+        if h % patch or w % patch:
+            raise ValueError(
+                f"input {h}x{w} not divisible by patch size {patch}"
+            )
         nh, nw = h // patch, w // patch
+        if nh * nw != n_tokens:
+            raise ValueError(
+                f"input {h}x{w} yields {nh * nw} patch tokens but the "
+                f"artifact's pos_embed was trained for {n_tokens}"
+            )
         x = images.reshape(b, nh, patch, nw, patch, c)
         x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, -1)
         x = x.astype(jnp.float32) @ w_embed
@@ -451,6 +466,11 @@ def make_lm_decoder(
             )
         return jitted(caches, tokens, pos)
 
+    # Expose the cache length so callers holding only the (init, step)
+    # pair — e.g. generate(decoder=...) — can validate total sequence
+    # length upfront instead of failing mid-decode after paid prefill.
+    init_caches.cache_len = cache_len
+    step.cache_len = cache_len
     return init_caches, step
 
 
@@ -490,6 +510,15 @@ def generate(
             f"exceeds the artifact's trained max_len {cache_len}"
         )
     init, step = decoder or make_lm_decoder(frozen, interpret=interpret)
+    # A caller-supplied decoder may have been built with max_len < the
+    # artifact's trained length; validate against its actual cache before
+    # spending prefill compute (step() would only fail mid-decode).
+    dec_len = getattr(step, "cache_len", None)
+    if dec_len is not None and total > dec_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + n_tokens {n_tokens} = {total} "
+            f"exceeds the supplied decoder's cache length {dec_len}"
+        )
     caches = init(prompt.shape[0])
     if temperature > 0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")

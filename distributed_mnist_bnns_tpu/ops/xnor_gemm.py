@@ -302,38 +302,22 @@ def xnor_matmul_packed_sign(
     dominant extra traffic of bandwidth-bound frozen serving at large
     offline batches. ``avec``/``tvec``/``bias`` are (N,) per-output-column
     epilogue params (see ``_xnor_sign_kernel``)."""
-    from jax.experimental import pallas as pl
-
     xp, wtp, lay = _prep_packed_operands(
         x_pm1, w_packed, k, n, block_m, block_n
     )
     # Padding columns: a=0, t=+1 -> "0 >= 1" false -> -1, sliced off.
-    pad = lay.np_ - n
-    a2 = jnp.pad(
-        avec.astype(jnp.float32), (0, pad)
-    ).reshape(1, lay.np_)
-    t2 = jnp.pad(
-        tvec.astype(jnp.float32), (0, pad), constant_values=1.0
-    ).reshape(1, lay.np_)
-    b2 = jnp.pad(bias.astype(jnp.float32), (0, pad)).reshape(1, lay.np_)
-
-    out = pl.pallas_call(
+    return _packed_pallas_call(
         functools.partial(
             _xnor_sign_kernel, real_k=k, k_steps=lay.k_steps
         ),
-        out_shape=jax.ShapeDtypeStruct((lay.mp, lay.np_), jnp.float32),
-        grid=(lay.mp // lay.bm, lay.np_ // lay.bn, lay.k_steps),
-        in_specs=[
-            pl.BlockSpec((lay.bm, lay.kc), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((lay.kc, lay.bn), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((1, lay.bn), lambda i, j, kk: (0, j)),
-            pl.BlockSpec((1, lay.bn), lambda i, j, kk: (0, j)),
-            pl.BlockSpec((1, lay.bn), lambda i, j, kk: (0, j)),
+        lay, xp, wtp,
+        [
+            _pad_cols(avec, lay),
+            _pad_cols(tvec, lay, fill=1.0),
+            _pad_cols(bias, lay),
         ],
-        out_specs=pl.BlockSpec((lay.bm, lay.bn), lambda i, j, kk: (i, j)),
-        interpret=interpret,
-    )(xp, wtp, a2, t2, b2)
-    return out[: x_pm1.shape[0], :n]
+        interpret,
+    )
 
 
 def prepack_weights(

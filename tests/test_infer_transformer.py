@@ -405,3 +405,45 @@ def test_generate_input_validation():
     dec = make_lm_decoder(frozen, interpret=True)
     out = generate(frozen, tokens[:, :2], 2, decoder=dec)
     assert out.shape == (1, 4)
+
+
+def test_generate_validates_supplied_decoder_cache():
+    """A caller-built decoder with max_len < the artifact's trained
+    length must reject an overlong request upfront (via the exposed
+    cache_len), not mid-decode after paid prefill."""
+    from distributed_mnist_bnns_tpu.infer_transformer import (
+        _freeze_lm_tensors,
+        generate,
+        make_lm_decoder,
+    )
+
+    model = BinarizedLM(
+        vocab=16, max_len=8, embed_dim=32, depth=1, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    frozen = _freeze_lm_tensors(model, variables)
+    dec = make_lm_decoder(frozen, max_len=4, interpret=True)
+    assert dec[0].cache_len == 4 and dec[1].cache_len == 4
+    # total 6 fits the artifact's trained window (8) but not this cache
+    with pytest.raises(ValueError, match="decoder's cache length"):
+        generate(frozen, tokens[:, :2], 4, decoder=dec)
+    out = generate(frozen, tokens[:, :2], 2, decoder=dec)
+    assert out.shape == (1, 4)
+
+
+def test_frozen_vit_rejects_bad_resolution():
+    """The frozen ViT validates resolution at trace time like the live
+    model — a non-divisible or wrong-token-count input must raise, not
+    silently truncate border pixels into finite-but-wrong log-probs."""
+    from distributed_mnist_bnns_tpu.infer_transformer import freeze_bnn_vit
+
+    model = bnn_vit_tiny(attention="xla", backend="xla")
+    x = jnp.zeros((1, 28, 28, 1), jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+    fn, _ = freeze_bnn_vit(model, variables, interpret=True)
+    with pytest.raises(ValueError, match="not divisible"):
+        fn(jnp.zeros((1, 30, 30, 1), jnp.float32))
+    with pytest.raises(ValueError, match="patch tokens"):
+        fn(jnp.zeros((1, 14, 14, 1), jnp.float32))  # 4 tokens, trained 16
