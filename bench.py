@@ -642,6 +642,207 @@ def _bench_device_epoch(args, deadline):
     }
 
 
+def _bench_serving(args, deadline):
+    """End-to-end frozen-model serving benchmark (VERDICT r4 item 1):
+    packed img/s for the flagship MLP and the conv stretch at small and
+    offline batches vs the live eval forward, KV-cache decode tokens/s,
+    and artifact-load-to-first-logit latency — the model-level numbers
+    behind SERVING.md's deployment story (the role cuDNN inference plays
+    for the reference, models/binarized_modules.py:80).
+
+    Weights are fresh inits (BN stats degenerate): serving throughput is
+    weight-value-independent, and training on the bench clock would burn
+    the live-window budget the numbers need."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.infer import (
+        export_packed,
+        freeze_bnn_mlp,
+        load_packed,
+    )
+    from distributed_mnist_bnns_tpu.infer_conv import freeze_xnor_resnet
+    from distributed_mnist_bnns_tpu.models import get_model
+
+    interp = jax.default_backend() != "tpu"
+    out = {"interpret_mode": interp}
+    reps = args.reps
+
+    def time_one(fn, x, n_short=20, n_long=200):
+        if time.monotonic() > deadline:
+            return None
+        r = fn(x)  # compile + settle
+        float(jnp.sum(r))
+        dt, _ = _measure(
+            lambda: fn(x), lambda r: float(jnp.sum(r)),
+            n_short, n_long, reps, deadline,
+        )
+        return dt
+
+    def batch_rows(frozen_fn, live_fn, input_shape, batches):
+        rows = {}
+        for b in batches:
+            x = jax.device_put(jax.random.normal(
+                jax.random.PRNGKey(b), (b, *input_shape), jnp.float32
+            ))
+            row = {}
+            dt = time_one(frozen_fn, x)
+            if dt is not None:
+                row["frozen"] = {
+                    "images_per_sec": round(b / dt, 1),
+                    "latency_ms": round(dt * 1e3, 4),
+                }
+            dt = time_one(live_fn, x)
+            if dt is not None:
+                row["live_eval"] = {
+                    "images_per_sec": round(b / dt, 1),
+                    "latency_ms": round(dt * 1e3, 4),
+                }
+            if "frozen" in row and "live_eval" in row:
+                row["frozen_speedup"] = round(
+                    row["frozen"]["images_per_sec"]
+                    / row["live_eval"]["images_per_sec"], 2,
+                )
+            rows[f"batch_{b}"] = row
+        return rows
+
+    # -- flagship MLP -------------------------------------------------
+    try:
+        model = get_model("bnn-mlp-large")
+        x0 = jnp.zeros((2, 28, 28, 1), jnp.float32)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1)},
+            x0, train=True,
+        )
+        frozen_fn, info = freeze_bnn_mlp(
+            model, variables, interpret=interp
+        )
+        live_fn = jax.jit(
+            lambda x: model.apply(variables, x, train=False)
+        )
+        out["bnn_mlp_large"] = {
+            "compression": info["compression"],
+            **batch_rows(
+                frozen_fn, live_fn, (28, 28, 1), args.serving_batches
+            ),
+        }
+    except Exception as e:
+        out["bnn_mlp_large"] = f"failed: {e!r:.300}"
+    # artifact load -> first logit (cold-serve latency): disk read +
+    # predictor build + first batch-1 call including its compile.
+    # Guarded separately so an export/IO failure can't discard the
+    # batch-throughput rows measured above.
+    try:
+        import tempfile
+
+        if (
+            isinstance(out.get("bnn_mlp_large"), dict)
+            and time.monotonic() < deadline
+        ):
+            with tempfile.TemporaryDirectory() as d:
+                path = os.path.join(d, "mlp.packed")
+                export_packed(model, variables, path)
+                x1 = jnp.zeros((1, 28, 28, 1), jnp.float32)
+                t0 = time.perf_counter()
+                fn, _ = load_packed(path, interpret=interp)
+                t_load = time.perf_counter()
+                float(jnp.sum(fn(x1)))
+                t_first = time.perf_counter()
+                out["bnn_mlp_large"]["artifact"] = {
+                    "bytes_on_disk": os.path.getsize(path),
+                    "load_s": round(t_load - t0, 4),
+                    "first_logit_s": round(t_first - t0, 4),
+                    "note": "first_logit includes the batch-1 XLA compile",
+                }
+    except Exception as e:
+        out["bnn_mlp_large"]["artifact"] = f"failed: {e!r:.300}"
+
+    # -- conv stretch -------------------------------------------------
+    try:
+        if time.monotonic() < deadline - 120:
+            model = get_model("xnor-resnet18")
+            x0 = jnp.zeros((2, 32, 32, 3), jnp.float32)
+            variables = model.init(
+                {"params": jax.random.PRNGKey(0)}, x0, train=True
+            )
+            frozen_fn, info = freeze_xnor_resnet(
+                model, variables, input_shape=(32, 32, 3),
+                interpret=interp,
+            )
+            live_fn = jax.jit(
+                lambda x: model.apply(variables, x, train=False)
+            )
+            out["xnor_resnet18"] = {
+                "compression": info["compression"],
+                **batch_rows(
+                    frozen_fn, live_fn, (32, 32, 3),
+                    [b for b in args.serving_batches if b <= 64],
+                ),
+            }
+        else:
+            out["xnor_resnet18"] = "skipped (bench deadline)"
+    except Exception as e:
+        out["xnor_resnet18"] = f"failed: {e!r:.300}"
+
+    # -- KV-cache decode ----------------------------------------------
+    try:
+        if time.monotonic() < deadline - 60:
+            from distributed_mnist_bnns_tpu.infer_transformer import (
+                _freeze_lm_tensors,
+                make_lm_decoder,
+            )
+            from distributed_mnist_bnns_tpu.models.transformer import (
+                BinarizedLM,
+            )
+
+            ctx = args.serving_lm_ctx
+            model = BinarizedLM(
+                vocab=256, max_len=ctx, embed_dim=args.lm_embed_dim,
+                depth=args.lm_depth, num_heads=args.lm_heads,
+                attention="xla",
+            )
+            tokens = jnp.zeros((2, ctx), jnp.int32)
+            variables = model.init(
+                {"params": jax.random.PRNGKey(0)}, tokens, train=False
+            )
+            frozen = _freeze_lm_tensors(model, variables)
+            init, step = make_lm_decoder(frozen, interpret=interp)
+            rows = {}
+            for b in (1, 8):
+                if time.monotonic() > deadline:
+                    break
+                caches = init(b)
+                toks = jnp.zeros((b,), jnp.int32)
+                pos = ctx // 2  # steady-state mid-cache decode step
+                holder = {"c": caches}
+
+                def one():
+                    holder["c"], lp = step(holder["c"], toks, pos)
+                    return lp
+
+                lp = one()
+                float(jnp.sum(lp))
+                dt, _ = _measure(
+                    one, lambda r: float(jnp.sum(r)),
+                    20, 200, reps, deadline,
+                )
+                if dt is not None:
+                    rows[f"batch_{b}"] = {
+                        "tokens_per_sec": round(b / dt, 1),
+                        "step_latency_ms": round(dt * 1e3, 4),
+                    }
+            out["lm_kv_decode"] = {
+                "ctx": ctx, "embed_dim": args.lm_embed_dim,
+                "depth": args.lm_depth, **rows,
+            }
+        else:
+            out["lm_kv_decode"] = "skipped (bench deadline)"
+    except Exception as e:
+        out["lm_kv_decode"] = f"failed: {e!r:.300}"
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=4096)
@@ -686,6 +887,16 @@ def main() -> None:
     p.add_argument("--lm-bench", action="store_true",
                    help="also bench the causal BinarizedLM train step "
                         "(flash attention fwd + Pallas bwd, tokens/sec)")
+    p.add_argument("--serving-bench", action="store_true",
+                   help="also bench end-to-end frozen-model serving: "
+                        "packed img/s at batch 1/8/64 vs live eval, "
+                        "KV-decode tokens/s, artifact cold-start latency")
+    p.add_argument("--serving-lm-ctx", type=int, default=256,
+                   help="KV-cache length for the serving decode bench")
+    p.add_argument("--serving-batches", type=int, nargs="+",
+                   default=[1, 8, 64, 4096],
+                   help="batch sizes for the serving bench (the conv "
+                        "stretch caps at 64)")
     p.add_argument("--lm-seq-len", type=int, default=1024)
     p.add_argument("--lm-batch-size", type=int, default=8)
     p.add_argument("--lm-depth", type=int, default=4)
@@ -974,6 +1185,12 @@ def main() -> None:
             result["lm_flash"] = _bench_lm(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["lm_flash"] = f"failed: {e!r:.300}"
+
+    if args.serving_bench and time.monotonic() < deadline - 60:
+        try:
+            result["serving"] = _bench_serving(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["serving"] = f"failed: {e!r:.300}"
 
     if args.all_backends:
         per_backend = {}
