@@ -22,6 +22,7 @@ from .ring_attention import attention_reference, make_ring_attention
 from .pipeline import make_pipeline_fn, sequential_reference
 from .pipeline_model import (
     make_pipelined_apply,
+    pipelined_state_shardings,
     merge_block_params,
     pipeline_params,
     place_pipelined_state,
@@ -62,6 +63,7 @@ __all__ = [
     "sequential_params",
     "split_block_params",
     "merge_block_params",
+    "pipelined_state_shardings",
     "place_pipelined_state",
     "init_expert_params",
     "make_expert_parallel_moe",

@@ -190,6 +190,27 @@ def bnn_mlp_tp_rules(params: Any, axis: str = "model") -> Any:
     return tp_rules_by_path(params, BNN_MLP_TP_TABLE, axis)
 
 
+def tp_state_shardings(
+    mesh: Mesh, state: TrainState, param_specs: Any
+) -> TrainState:
+    """The TP run's TrainState-of-NamedShardings: params per the rule
+    table, everything else replicated. Shared by the per-step jit
+    (``make_tp_train_step``) and the multi-step scan dispatch
+    (train.make_train_scan's ``state_shardings``), so the two dispatch
+    modes cannot drift in layout."""
+    repl = NamedSharding(mesh, P())
+    return TrainState(
+        step=repl,
+        params=jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), param_specs
+        ),
+        batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+        opt_state=jax.tree.map(lambda _: repl, state.opt_state),
+        apply_fn=state.apply_fn,
+        tx=state.tx,
+    )
+
+
 def make_tp_train_step(
     base_train_step: Callable,
     mesh: Mesh,
@@ -206,14 +227,7 @@ def make_tp_train_step(
     the combined dp x mp configuration, the superset of the reference's
     DDP (data axis) and its 2-device layer-split demo (model axis)."""
     repl = NamedSharding(mesh, P())
-    st_sh = TrainState(
-        step=repl,
-        params=jax.tree.map(lambda spec: NamedSharding(mesh, spec), param_specs),
-        batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
-        opt_state=jax.tree.map(lambda _: repl, state.opt_state),
-        apply_fn=state.apply_fn,
-        tx=state.tx,
-    )
+    st_sh = tp_state_shardings(mesh, state, param_specs)
     placed = jax.device_put(state, st_sh)
     data_sh = NamedSharding(mesh, P(data_axis))
     step = jax.jit(

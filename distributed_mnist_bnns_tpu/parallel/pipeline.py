@@ -30,6 +30,7 @@ def make_pipeline_fn(
     *,
     axis: str = "pipe",
     n_micro: int = 4,
+    batch_axis: str | None = None,
 ):
     """Build f(stage_params, x) -> y running the stage chain as a pipeline.
 
@@ -37,7 +38,16 @@ def make_pipeline_fn(
     sharded over ``axis``). stage_fn(params_for_one_stage, x) -> x' must be
     shape-preserving (homogeneous pipeline).
     x: (B, ...) with B divisible by n_micro; replicated in, replicated out.
-    """
+
+    ``batch_axis``: name of a second mesh axis to shard the batch dim of
+    ``x`` over — DP x PP composition on a 2-D (batch_axis, axis) mesh.
+    Each data-parallel replica row runs its own independent pipeline over
+    its batch shard (stage params replicated across rows, so the
+    ppermute ring only connects devices within a row); the local batch
+    B/dp must itself be divisible by ``n_micro``. Gradient all-reduce
+    over ``batch_axis`` is NOT this function's job — it falls out of the
+    loss mean over the globally-sharded output under jit/GSPMD, exactly
+    as in plain DP."""
     n_stages = mesh.shape[axis]
 
     def local_fn(stage_params, x):
@@ -82,11 +92,12 @@ def make_pipeline_fn(
         )
         return outputs.reshape(b, *x.shape[1:])
 
+    x_spec = P(batch_axis) if batch_axis else P()
     fn = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )
     return jax.jit(fn)

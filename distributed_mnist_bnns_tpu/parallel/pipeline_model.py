@@ -152,6 +152,7 @@ def make_pipelined_apply(
     *,
     axis: str = "pipe",
     n_micro: int = 0,
+    batch_axis: str | None = None,
 ) -> Callable:
     """Build an ``apply_fn(variables, x, train=..., rngs=..., mutable=...)``
     running the model's block stack as a GPipe pipeline over ``axis``.
@@ -160,8 +161,14 @@ def make_pipelined_apply(
     contract: returns ``(out, {})`` when ``mutable`` is non-empty). The
     variables' params must be in the pipelined layout
     ``{"blocks": stacked, "rest": rest}`` (see ``pipeline_params``).
-    ``n_micro=0`` defaults to the stage count."""
+    ``n_micro=0`` defaults to the stage count.
+
+    ``batch_axis``: second mesh axis for DP x PP — the batch dim is
+    sharded over it through the pipeline (see make_pipeline_fn); the
+    embed/head/loss stages outside the shard_map ride the same sharding
+    under jit/GSPMD."""
     n_stages = mesh.shape[axis]
+    dp_size = mesh.shape[batch_axis] if batch_axis else 1
     if depth % n_stages:
         raise ValueError(
             f"model depth {depth} not divisible by pipeline stages "
@@ -179,7 +186,9 @@ def make_pipelined_apply(
             f"(BinarizedTransformer / BinarizedLM), got {type(model).__name__}"
         )
     stage_fn = _make_stage_fn(model, blocks_per_stage)
-    pipe = make_pipeline_fn(mesh, stage_fn, axis=axis, n_micro=n_micro)
+    pipe = make_pipeline_fn(
+        mesh, stage_fn, axis=axis, n_micro=n_micro, batch_axis=batch_axis
+    )
 
     def apply_fn(variables, x, train=False, rngs=None, mutable=()):
         del train, rngs  # dropout unsupported (enforced at setup)
@@ -193,11 +202,13 @@ def make_pipelined_apply(
             ),
             stacked,
         )
-        # The schedule needs B divisible by n_micro; pad (statically, the
-        # batch dim is a trace-time constant) and slice back — partial
-        # final eval batches just ride a slightly padded pipeline.
+        # The schedule needs each DP shard's local batch divisible by
+        # n_micro; pad the global batch to a (dp * n_micro) multiple
+        # (statically, the batch dim is a trace-time constant) and slice
+        # back — partial final eval batches just ride a slightly padded
+        # pipeline.
         b = x.shape[0]
-        pad = (-b) % n_micro
+        pad = (-b) % (n_micro * dp_size)
         if pad:
             x = jnp.concatenate(
                 [x, jnp.zeros((pad, *x.shape[1:]), x.dtype)]
@@ -224,11 +235,12 @@ def sequential_params(pipelined: Dict, depth: int) -> Dict:
     return merge_block_params(pipelined["blocks"], pipelined["rest"], names)
 
 
-def place_pipelined_state(state, mesh: Mesh, *, axis: str = "pipe"):
-    """device_put a pipelined TrainState onto the mesh: block params (and
-    their optimizer moments) sharded stage-major over ``axis``, the rest
-    replicated — each stage's weights and Adam moments live only on the
-    devices that run it (ZeRO-style memory scaling along the pipeline)."""
+def pipelined_state_shardings(state, mesh: Mesh, *, axis: str = "pipe"):
+    """TrainState-of-NamedShardings for a pipelined run: block params
+    (and their optimizer moments) sharded stage-major over ``axis``, the
+    rest replicated. Shared by the initial placement
+    (``place_pipelined_state``) and the multi-step scan dispatch
+    (train.make_train_scan's ``state_shardings``)."""
     repl = NamedSharding(mesh, P())
     blocks_sh = NamedSharding(mesh, P(axis))
 
@@ -243,12 +255,19 @@ def place_pipelined_state(state, mesh: Mesh, *, axis: str = "pipe"):
             jax.tree_util.tree_structure(tree), specs
         )
 
+    return state.replace(
+        step=repl,
+        params=spec_like(state.params),
+        batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+        opt_state=spec_like(state.opt_state),
+    )
+
+
+def place_pipelined_state(state, mesh: Mesh, *, axis: str = "pipe"):
+    """device_put a pipelined TrainState onto the mesh: block params (and
+    their optimizer moments) sharded stage-major over ``axis``, the rest
+    replicated — each stage's weights and Adam moments live only on the
+    devices that run it (ZeRO-style memory scaling along the pipeline)."""
     return jax.device_put(
-        state,
-        state.replace(
-            step=repl,
-            params=spec_like(state.params),
-            batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
-            opt_state=spec_like(state.opt_state),
-        ),
+        state, pipelined_state_shardings(state, mesh, axis=axis)
     )

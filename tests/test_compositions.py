@@ -80,3 +80,215 @@ def test_label_smoothing_with_moe_tp():
                       "expert_features": 32},
         tensor_parallel=2, label_smoothing=0.1,
     )
+
+
+# -- round-5 axis compositions (VERDICT r4 item 2) -------------------------
+
+
+def test_dp_pp_trainer_matches_sequential_fit():
+    """--dp 2 --pp 2 (a (data=2, pipe=2) mesh: each data-replica row runs
+    its own GPipe pipeline over its batch shard) trains the ViT to the
+    sequential single-device parameters — the composition round 4 hard-
+    errored on."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    data = _data(32)
+
+    def fit(**kw):
+        trainer = Trainer(
+            TrainConfig(
+                model="bnn-vit-tiny", epochs=1, batch_size=8,
+                optimizer="sgd", learning_rate=0.05, backend="xla",
+                seed=0, **kw,
+            )
+        )
+        return trainer, trainer.fit(data)
+
+    seq_trainer, seq_hist = fit()
+    pp_trainer, pp_hist = fit(pipeline_parallel=2, data_parallel=2)
+    assert pp_trainer.mesh is not None  # mesh-native eval path active
+    assert pp_trainer.mesh.shape == {"data": 2, "pipe": 2}
+    assert np.isfinite(pp_hist[0]["train_loss"])
+    assert abs(pp_hist[0]["train_loss"] - seq_hist[0]["train_loss"]) < 1e-4
+    assert abs(pp_hist[0]["test_acc"] - seq_hist[0]["test_acc"]) < 1e-6
+    from distributed_mnist_bnns_tpu.parallel import sequential_params
+
+    # Numerics policy tolerance: different XLA program -> few-ulp forward
+    # diffs can flip sign() of near-zero latents (see
+    # test_trainer_pp_vit_matches_sequential_fit).
+    pp_as_seq = sequential_params(pp_trainer.state.params, 2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-3
+        ),
+        seq_trainer.state.params, pp_as_seq,
+    )
+
+
+def test_dp_pp_scan_matches_per_step():
+    """scan_steps composes with DP x PP: the scan program carries the
+    stage-major pipelined state shardings instead of gathering the
+    blocks, and the trajectory equals per-step dispatch exactly (same
+    step body, same data order)."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    data = _data(32)
+
+    def fit(**kw):
+        trainer = Trainer(
+            TrainConfig(
+                model="bnn-vit-tiny", epochs=1, batch_size=8,
+                optimizer="sgd", learning_rate=0.05, backend="xla",
+                seed=0, pipeline_parallel=2, data_parallel=2, **kw,
+            )
+        )
+        return trainer, trainer.fit(data)
+
+    step_trainer, step_hist = fit()
+    scan_trainer, scan_hist = fit(scan_steps=2)
+    assert np.isfinite(scan_hist[0]["train_loss"])
+    # (history train_loss is sampled at log boundaries, so per-step
+    # reports batch-0 loss while scan reports chunk-0's mean — the
+    # trajectory itself must be identical, which the param check pins.)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
+        ),
+        step_trainer.state.params, scan_trainer.state.params,
+    )
+
+
+def test_tp_scan_matches_per_step():
+    """scan_steps composes with tensor_parallel (round 4 silently fell
+    back to per-step dispatch): the scan program carries the model-axis
+    param shardings, and the trajectory equals per-step TP exactly."""
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    data = _data(64)
+
+    def fit(**kw):
+        trainer = Trainer(
+            TrainConfig(
+                model="bnn-mlp-small", model_kwargs={"infl_ratio": 1},
+                epochs=1, batch_size=16, optimizer="sgd",
+                learning_rate=0.05, backend="xla", seed=0,
+                tensor_parallel=2, **kw,
+            )
+        )
+        return trainer, trainer.fit(data)
+
+    step_trainer, step_hist = fit()
+    scan_trainer, scan_hist = fit(scan_steps=4)
+    assert np.isfinite(scan_hist[0]["train_loss"])
+    # metric sampling differs between dispatch modes (see
+    # test_dp_pp_scan_matches_per_step); the param check pins equality
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
+        ),
+        step_trainer.state.params, scan_trainer.state.params,
+    )
+    # the scan really ran sharded: model-axis layout preserved after fit
+    k = scan_trainer.state.params["BinarizedDense_0"]["kernel"]
+    assert "model" in str(k.sharding.spec)
+
+
+def test_tp_device_data_matches_streaming():
+    """device_data composes with tensor_parallel (round 4 silently fell
+    back to streaming): the one-dispatch epoch program carries the TP
+    state shardings; same shuffle order -> same trajectory."""
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    data = _data(64)
+
+    def fit(**kw):
+        trainer = Trainer(
+            TrainConfig(
+                model="bnn-mlp-small", model_kwargs={"infl_ratio": 1},
+                epochs=1, batch_size=16, optimizer="sgd",
+                learning_rate=0.05, backend="xla", seed=0,
+                tensor_parallel=2, **kw,
+            )
+        )
+        return trainer, trainer.fit(data)
+
+    stream_trainer, stream_hist = fit()
+    dev_trainer, dev_hist = fit(device_data=True)
+    assert np.isfinite(dev_hist[0]["train_loss"])
+    # (the one-dispatch epoch reports the epoch-mean loss while the
+    # streaming path samples at log boundaries; the param check pins
+    # trajectory equality)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        ),
+        stream_trainer.state.params, dev_trainer.state.params,
+    )
+
+
+def test_cli_dp_pp_and_tp_scan(tmp_path, monkeypatch):
+    """The VERDICT r4 done-criteria invocations run from the CLI."""
+    from distributed_mnist_bnns_tpu.cli import main
+
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        ["train", "--model", "bnn-vit-tiny", "--epochs", "1",
+         "--batch-size", "16", "--backend", "xla", "--dp", "2",
+         "--pp", "2", "--data-dir", "/nonexistent_use_synth",
+         "--synthetic-sizes", "64", "32",
+         "--log-file", str(tmp_path / "log1.txt")]
+    )
+    assert rc == 0
+    rc = main(
+        ["train", "--model", "bnn-mlp-small", "--epochs", "1",
+         "--batch-size", "16", "--backend", "xla", "--tp", "2",
+         "--scan-steps", "4", "--data-dir", "/nonexistent_use_synth",
+         "--synthetic-sizes", "64", "32",
+         "--log-file", str(tmp_path / "log2.txt")]
+    )
+    assert rc == 0
+
+
+def test_regime_optimizer_switch_with_tp_device_data():
+    """An optimizer-class regime switch mid-fit must rebuild the
+    device-resident train AND eval programs: their in_shardings embed the
+    opt_state pytree structure under TP state shardings, so a stale cache
+    fails with a jit structure mismatch on the next epoch."""
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 virtual devices")
+    trainer = Trainer(
+        TrainConfig(
+            model="bnn-mlp-small", model_kwargs={"infl_ratio": 1},
+            epochs=2, batch_size=16, optimizer="adam",
+            learning_rate=0.003, backend="xla", seed=0,
+            tensor_parallel=2, device_data=True,
+            regime={1: {"optimizer": "sgd", "learning_rate": 0.05}},
+        )
+    )
+    history = trainer.fit(_data())
+    assert len(history) == 2
+    assert all(np.isfinite(h["train_loss"]) for h in history)
+
+
+def test_regime_optimizer_switch_with_dp_pp():
+    """The regime rebuild must keep the DP x PP step: round-5's first cut
+    fell into _set_dp_step, jitting with replicated in_shardings and
+    silently gathering the stage-major block params off their stages."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    trainer = Trainer(
+        TrainConfig(
+            model="bnn-vit-tiny", epochs=2, batch_size=8,
+            optimizer="adam", learning_rate=0.003, backend="xla", seed=0,
+            pipeline_parallel=2, data_parallel=2,
+            regime={1: {"optimizer": "sgd", "learning_rate": 0.05}},
+        )
+    )
+    history = trainer.fit(_data(32))
+    assert len(history) == 2
+    assert all(np.isfinite(h["train_loss"]) for h in history)
+    # stage-major placement survived the rebuild
+    leaf = jax.tree.leaves(trainer.state.params["blocks"])[0]
+    assert "pipe" in str(leaf.sharding.spec)
