@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--xnor-scale", action="store_true",
                         help="XNOR-Net per-channel alpha rescaling on "
                              "binarized GEMMs (models that support it)")
+        sp.add_argument("--dropout", type=float, default=None,
+                        help="dropout rate for the transformer families "
+                             "(bnn-vit*; the MLP topologies carry their "
+                             "reference-fixed rates); composes with --pp")
         sp.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "trained epoch's early steps here")
@@ -131,6 +135,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--pp-microbatches", type=int, default=0,
                         help="microbatches per pipelined step "
                              "(0 = one per stage)")
+        sp.add_argument("--pp-remat", action="store_true",
+                        help="checkpoint each pipeline stage: activation "
+                             "memory bounded per microbatch (1F1B-class) "
+                             "at the cost of recompute in backward")
         sp.add_argument("--log-file", default="log.txt")
         # multi-host rendezvous (replaces MASTER_ADDR/MASTER_PORT env://)
         sp.add_argument("--nodes", type=int, default=1)
@@ -224,6 +232,8 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         model_kwargs["stochastic"] = True
     if args.xnor_scale:
         model_kwargs["scale"] = True
+    if getattr(args, "dropout", None) is not None:
+        model_kwargs["dropout"] = args.dropout
     config = TrainConfig(
         model=args.model,
         model_kwargs=model_kwargs,
@@ -253,6 +263,7 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         dp_mode=args.dp_mode,
         pipeline_parallel=args.pp,
         pp_microbatches=args.pp_microbatches,
+        pp_remat=args.pp_remat,
         tensor_parallel=args.tp,
         profile_dir=args.profile_dir,
         remat=args.remat,

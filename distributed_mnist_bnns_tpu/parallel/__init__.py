@@ -19,7 +19,12 @@ from .model_parallel import (
     tp_rules_for,
 )
 from .ring_attention import attention_reference, make_ring_attention
-from .pipeline import make_pipeline_fn, sequential_reference
+from .pipeline import (
+    make_pipeline_fn,
+    pipeline_bubble_fraction,
+    sequential_reference,
+    sequential_reference_rng,
+)
 from .pipeline_model import (
     make_pipelined_apply,
     pipelined_state_shardings,
@@ -57,7 +62,9 @@ __all__ = [
     "attention_reference",
     "make_ring_attention",
     "make_pipeline_fn",
+    "pipeline_bubble_fraction",
     "sequential_reference",
+    "sequential_reference_rng",
     "make_pipelined_apply",
     "pipeline_params",
     "sequential_params",

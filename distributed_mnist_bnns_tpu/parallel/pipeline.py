@@ -26,13 +26,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_pipeline_fn(
     mesh: Mesh,
-    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_fn: Callable[..., jnp.ndarray],
     *,
     axis: str = "pipe",
     n_micro: int = 4,
     batch_axis: str | None = None,
+    stage_takes_rng: bool = False,
+    stage_remat: bool = False,
 ):
-    """Build f(stage_params, x) -> y running the stage chain as a pipeline.
+    """Build f(stage_params, x[, rng]) -> y running the stage chain as a
+    pipeline.
 
     stage_params: pytree whose leaves have leading dim n_stages (stage-major,
     sharded over ``axis``). stage_fn(params_for_one_stage, x) -> x' must be
@@ -47,10 +50,38 @@ def make_pipeline_fn(
     B/dp must itself be divisible by ``n_micro``. Gradient all-reduce
     over ``batch_axis`` is NOT this function's job — it falls out of the
     loss mean over the globally-sharded output under jit/GSPMD, exactly
-    as in plain DP."""
+    as in plain DP.
+
+    ``stage_takes_rng``: stage_fn is ``(params, x, rng) -> x'`` and the
+    returned callable is ``f(stage_params, x, rng)``. Each (stage,
+    microbatch) cell receives an independent key —
+    ``fold_in(fold_in(rng, microbatch), stage)`` — that depends only on
+    its schedule-invariant coordinates, never on the tick: the draw a
+    cell makes is identical whatever schedule executes it (the property
+    that makes dropout well-defined under pipelining; see
+    tests/test_pipeline.py's rng-matched sequential oracle). Under
+    DP x PP the ``batch_axis`` row index is folded in first, so each
+    data replica draws independent masks for its batch shard (the same
+    decorrelation the step body's grad-accum fold_in enforces).
+
+    ``stage_remat``: wrap each stage execution in ``jax.checkpoint`` so
+    reverse-mode AD stores only the stage's *input* per tick and
+    recomputes its internals in the backward pipeline. This bounds
+    activation memory to O(ticks x microbatch), independent of stage
+    depth — the 1F1B-class memory footprint (see PERF.md §pipeline):
+    with XLA's static schedule, fwd-all-then-bwd-reversed has the same
+    bubble as tick-interleaved 1F1B, so memory is the only axis left,
+    and checkpointing the stage recovers it without a manual vjp
+    schedule."""
     n_stages = mesh.shape[axis]
 
-    def local_fn(stage_params, x):
+    run_stage = stage_fn
+    if not stage_takes_rng:
+        run_stage = lambda params, x, rng: stage_fn(params, x)  # noqa: E731
+    if stage_remat:
+        run_stage = jax.checkpoint(run_stage)
+
+    def local_fn(stage_params, x, rng):
         # stage_params leaves arrive as (1, ...) slices -> squeeze stage dim.
         params = jax.tree.map(lambda p: p[0], stage_params)
         idx = jax.lax.axis_index(axis)
@@ -68,10 +99,20 @@ def make_pipeline_fn(
             # stage 0 injects microbatch t (clamped; masked by validity)
             inject = micro[jnp.clip(t, 0, n_micro - 1)]
             x_in = jnp.where(idx == 0, inject, buf)
-            y = stage_fn(params, x_in)
             # device s at tick t is working on microbatch (t - s)
             mb_idx = t - idx
             valid = (mb_idx >= 0) & (mb_idx < n_micro)
+            row_rng = (
+                jax.random.fold_in(rng, jax.lax.axis_index(batch_axis))
+                if batch_axis else rng
+            )
+            cell_rng = jax.random.fold_in(
+                jax.random.fold_in(
+                    row_rng, jnp.clip(mb_idx, 0, n_micro - 1)
+                ),
+                idx,
+            )
+            y = run_stage(params, x_in, cell_rng)
             y = jnp.where(valid, y, jnp.zeros_like(y))
             # last stage banks its finished microbatch
             is_last = idx == n_stages - 1
@@ -96,11 +137,14 @@ def make_pipeline_fn(
     fn = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(axis), x_spec),
+        in_specs=(P(axis), x_spec, P()),
         out_specs=x_spec,
         check_vma=False,
     )
-    return jax.jit(fn)
+    if stage_takes_rng:
+        return jax.jit(fn)
+    _dummy = jax.random.PRNGKey(0)
+    return jax.jit(lambda p, x: fn(p, x, _dummy))
 
 
 def sequential_reference(
@@ -112,3 +156,40 @@ def sequential_reference(
         params = jax.tree.map(lambda p: p[s], stage_params)
         x = stage_fn(params, x)
     return x
+
+
+def sequential_reference_rng(
+    stage_params: Any,
+    x: jnp.ndarray,
+    stage_fn: Callable,
+    rng: jax.Array,
+    n_micro: int,
+) -> jnp.ndarray:
+    """Single-device oracle for the rng-plumbed pipeline: runs every
+    (stage, microbatch) cell with the SAME key derivation the schedule
+    uses — ``fold_in(fold_in(rng, microbatch), stage)`` — so a pipelined
+    run with dropout/stochastic masks must match it exactly (the
+    schedule-invariance contract of make_pipeline_fn)."""
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    b = x.shape[0]
+    micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+    outs = []
+    for m in range(n_micro):
+        h = micro[m]
+        for s in range(n_stages):
+            params = jax.tree.map(lambda p, s=s: p[s], stage_params)
+            cell_rng = jax.random.fold_in(jax.random.fold_in(rng, m), s)
+            h = stage_fn(params, h, cell_rng)
+        outs.append(h)
+    return jnp.concatenate(outs).reshape(b, *x.shape[1:])
+
+
+def pipeline_bubble_fraction(n_stages: int, n_micro: int) -> float:
+    """Analytic GPipe bubble fraction of the tick schedule:
+    ``(S - 1) / (M + S - 1)`` — each of fill and drain idles S-1 ticks
+    per M work ticks, in forward and (mirrored) in the autodiff-reversed
+    backward, so the fraction holds for the full train step. Under XLA's
+    static schedule this equals tick-interleaved 1F1B's bubble (1F1B's
+    win is in-flight activation memory, recovered here by
+    ``stage_remat`` — see PERF.md §pipeline)."""
+    return (n_stages - 1) / (n_micro + n_stages - 1)

@@ -21,10 +21,15 @@ else}``; ``split_block_params`` / ``merge_block_params`` convert to and
 from the sequential layout (checkpoint interchange + the equality tests
 in tests/test_pipeline_model.py).
 
-Dropout is not supported through the pipelined path (the stage schedule
-re-executes blocks under masking, so per-call rng plumbing would differ
-from the sequential model); models must be built with dropout=0.0 —
-enforced at setup.
+Dropout (round 5): trains pipelined. Each (block, microbatch) cell
+draws an independent mask from a schedule-invariant key —
+``fold_in(fold_in(step_rng, microbatch), stage)`` then per-block in-stage
+fold (``_make_stage_fn``) — so the masks are deterministic given the step
+rng regardless of which schedule executes the cells. The draws differ
+from the sequential ``model.apply`` stream (flax folds rngs by module
+path, which stage-stacking erases); the contract is distributional
+equivalence + schedule invariance, pinned against an rng-matched
+sequential oracle in tests.
 """
 
 from __future__ import annotations
@@ -75,15 +80,11 @@ def merge_block_params(stacked: Any, rest: Dict, names: List[str]) -> Dict:
 
 def _block_module(model) -> TransformerBlock:
     """The stage's block module, rebuilt from the parent model's knobs."""
-    if model.dropout:
-        raise ValueError(
-            "pipeline parallelism requires dropout=0.0 (see module doc)"
-        )
     return TransformerBlock(
         model.embed_dim,
         model.num_heads,
         mlp_ratio=model.mlp_ratio,
-        dropout=0.0,
+        dropout=model.dropout,
         attention=model.attention,
         attention_fn=model.attention_fn,
         causal=isinstance(model, BinarizedLM),
@@ -94,18 +95,54 @@ def _block_module(model) -> TransformerBlock:
     )
 
 
-def _make_stage_fn(model, blocks_per_stage: int) -> Callable:
-    """stage params (blocks_per_stage, ...) -> apply that many blocks."""
+def _make_stage_fn(
+    model, blocks_per_stage: int, *, train: bool = False
+) -> Callable:
+    """stage params (blocks_per_stage, ...) -> apply that many blocks.
+
+    The train variant is ``(p_group, x, rng) -> x`` where ``rng`` is the
+    pipeline's per-(stage, microbatch) cell key (make_pipeline_fn):
+    block ``i`` of the stage folds it by its in-stage index, so every
+    (block, microbatch) pair draws an independent, schedule-invariant
+    dropout/stochastic-binarize mask. The draws intentionally do NOT
+    reproduce the sequential ``model.apply`` stream (flax folds by
+    module path, which pipelining erases) — the contract is
+    distributional equivalence plus schedule-invariance, pinned by the
+    rng-matched sequential oracle in tests/test_pipeline_model.py."""
     block = _block_module(model)
+    needs_rng = bool(model.dropout) or bool(model.stochastic)
 
-    def stage_fn(p_group, x):
-        def body(carry, p_one):
-            return block.apply({"params": p_one}, carry), None
+    if not (train and needs_rng):
 
-        x, _ = jax.lax.scan(body, x, p_group)
+        def stage_fn(p_group, x):
+            def body(carry, p_one):
+                return block.apply({"params": p_one}, carry), None
+
+            x, _ = jax.lax.scan(body, x, p_group)
+            return x
+
+        return stage_fn
+
+    def stage_fn_train(p_group, x, rng):
+        def body(carry, xs):
+            p_one, i = xs
+            d_rng, b_rng = jax.random.split(jax.random.fold_in(rng, i))
+            rngs = {}
+            if model.dropout:
+                rngs["dropout"] = d_rng
+            if model.stochastic:
+                rngs["binarize"] = b_rng
+            y = block.apply(
+                {"params": p_one}, carry, train=True, rngs=rngs
+            )
+            return y, None
+
+        x, _ = jax.lax.scan(
+            body, x, (p_group, jnp.arange(blocks_per_stage))
+        )
         return x
 
-    return stage_fn
+    return stage_fn_train
 
 
 def _vit_embed(model: BinarizedTransformer, rest: Dict, x: jnp.ndarray):
@@ -153,6 +190,7 @@ def make_pipelined_apply(
     axis: str = "pipe",
     n_micro: int = 0,
     batch_axis: str | None = None,
+    stage_remat: bool = False,
 ) -> Callable:
     """Build an ``apply_fn(variables, x, train=..., rngs=..., mutable=...)``
     running the model's block stack as a GPipe pipeline over ``axis``.
@@ -166,7 +204,16 @@ def make_pipelined_apply(
     ``batch_axis``: second mesh axis for DP x PP — the batch dim is
     sharded over it through the pipeline (see make_pipeline_fn); the
     embed/head/loss stages outside the shard_map ride the same sharding
-    under jit/GSPMD."""
+    under jit/GSPMD.
+
+    Dropout (and stochastic binarization) train pipelined: ``train=True``
+    routes through a second pipeline program whose stages draw
+    per-(block, microbatch) schedule-invariant masks from the step's
+    ``rngs`` (see ``_make_stage_fn``); ``train=False`` (eval) runs the
+    deterministic program.
+
+    ``stage_remat``: checkpoint each stage execution — 1F1B-class
+    activation memory (make_pipeline_fn docstring / PERF.md)."""
     n_stages = mesh.shape[axis]
     dp_size = mesh.shape[batch_axis] if batch_axis else 1
     if depth % n_stages:
@@ -185,13 +232,23 @@ def make_pipelined_apply(
             "pipeline parallelism supports the transformer families "
             f"(BinarizedTransformer / BinarizedLM), got {type(model).__name__}"
         )
-    stage_fn = _make_stage_fn(model, blocks_per_stage)
-    pipe = make_pipeline_fn(
-        mesh, stage_fn, axis=axis, n_micro=n_micro, batch_axis=batch_axis
+    pipe_eval = make_pipeline_fn(
+        mesh, _make_stage_fn(model, blocks_per_stage),
+        axis=axis, n_micro=n_micro, batch_axis=batch_axis,
+        stage_remat=stage_remat,
+    )
+    train_needs_rng = bool(model.dropout) or bool(model.stochastic)
+    pipe_train = (
+        make_pipeline_fn(
+            mesh, _make_stage_fn(model, blocks_per_stage, train=True),
+            axis=axis, n_micro=n_micro, batch_axis=batch_axis,
+            stage_takes_rng=True, stage_remat=stage_remat,
+        )
+        if train_needs_rng
+        else pipe_eval
     )
 
     def apply_fn(variables, x, train=False, rngs=None, mutable=()):
-        del train, rngs  # dropout unsupported (enforced at setup)
         params = variables["params"]
         stacked, rest = params["blocks"], params["rest"]
         # (depth, ...) -> (n_stages, blocks_per_stage, ...): stage-major
@@ -214,7 +271,19 @@ def make_pipelined_apply(
                 [x, jnp.zeros((pad, *x.shape[1:]), x.dtype)]
             )
         h = embed(model, rest, x)
-        h = pipe(grouped, h)
+        if train and train_needs_rng:
+            # The cell keys derive from one base stream: the 'dropout'
+            # key for dropout models, else the 'binarize' key (stages
+            # split per-purpose keys from the cell key — _make_stage_fn).
+            need = "dropout" if model.dropout else "binarize"
+            if not rngs or need not in rngs:
+                raise ValueError(
+                    "pipelined train step with dropout/stochastic "
+                    f"binarization needs rngs={{'{need}': key}}"
+                )
+            h = pipe_train(grouped, h, rngs[need])
+        else:
+            h = pipe_eval(grouped, h)
         out = head(model, rest, h)[:b]
         if mutable:
             return out, {}

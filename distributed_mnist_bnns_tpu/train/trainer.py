@@ -516,6 +516,8 @@ class TrainConfig:
                                    # over N devices (parallel/pipeline_model)
     pp_microbatches: int = 0       # microbatches per pipelined step
                                    # (0 = one per stage)
+    pp_remat: bool = False         # checkpoint each pipeline stage:
+                                   # 1F1B-class activation memory
     tensor_parallel: int = 1       # >1: Megatron-style TP over a 'model'
                                    # mesh axis (parallel/model_parallel);
                                    # composes with data_parallel as a
@@ -753,6 +755,7 @@ class Trainer:
         apply_fn = make_pipelined_apply(
             self.model, mesh, depth, n_micro=n_micro,
             batch_axis="data" if dp_n > 1 else None,
+            stage_remat=cfg.pp_remat,
         )
         new_params = pipeline_params(self.state.params)
         tx = self.state.tx

@@ -120,12 +120,6 @@ class TestForwardEquality:
             np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
         )
 
-    def test_dropout_rejected(self):
-        mesh = _mesh(2)
-        model = _vit(depth=2, dropout=0.1)
-        with pytest.raises(ValueError, match="dropout"):
-            make_pipelined_apply(model, mesh, 2)
-
     def test_indivisible_depth_rejected(self):
         mesh = _mesh(2)
         model = _vit(depth=3)
@@ -285,3 +279,161 @@ class TestTrainedTrajectory:
              "--log-file", str(tmp_path / "log.txt")]
         )
         assert rc == 0
+
+
+class TestPipelinedDropout:
+    """Round 5: dropout (and stochastic binarize) train pipelined via
+    per-(block, microbatch) schedule-invariant rng cells."""
+
+    def test_train_forward_matches_rng_oracle(self):
+        """The pipelined train forward equals the rng-matched sequential
+        oracle built from the SAME stage fn and cell-key derivation."""
+        from distributed_mnist_bnns_tpu.parallel.pipeline import (
+            sequential_reference_rng,
+        )
+        from distributed_mnist_bnns_tpu.parallel.pipeline_model import (
+            _make_stage_fn,
+            _vit_embed,
+            _vit_head,
+        )
+
+        mesh = _mesh(2)
+        model = _vit(depth=4, dropout=0.3)
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 1))
+        variables = _init(model, x)
+        apply_fn = make_pipelined_apply(model, mesh, 4, n_micro=4)
+        pp = pipeline_params(variables["params"])
+        rng = jax.random.PRNGKey(9)
+        got = apply_fn(
+            {"params": pp}, x, train=True, rngs={"dropout": rng}
+        )
+        # oracle: embed -> sequential (stage, microbatch) cells -> head
+        stacked = pp["blocks"]
+        grouped = jax.tree.map(
+            lambda p: p.reshape(2, 2, *p.shape[1:]), stacked
+        )
+        h = _vit_embed(model, pp["rest"], x)
+        h = sequential_reference_rng(
+            grouped, h, _make_stage_fn(model, 2, train=True), rng,
+            n_micro=4,
+        )
+        want = _vit_head(model, pp["rest"], h)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+        )
+
+    def test_dropout_active_and_deterministic(self):
+        mesh = _mesh(2)
+        model = _vit(depth=2, dropout=0.5)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 28, 28, 1))
+        variables = _init(model, x)
+        apply_fn = make_pipelined_apply(model, mesh, 2, n_micro=4)
+        pp = {"params": pipeline_params(variables["params"])}
+        eval_out = apply_fn(pp, x, train=False)
+        r1 = apply_fn(pp, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)})
+        r1b = apply_fn(pp, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)})
+        r2 = apply_fn(pp, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r1b))
+        assert np.abs(np.asarray(r1) - np.asarray(eval_out)).max() > 1e-6
+        assert np.abs(np.asarray(r1) - np.asarray(r2)).max() > 1e-6
+
+    def test_missing_rng_raises(self):
+        mesh = _mesh(2)
+        model = _vit(depth=2, dropout=0.3)
+        x = jnp.zeros((4, 28, 28, 1))
+        variables = _init(model, x)
+        apply_fn = make_pipelined_apply(model, mesh, 2, n_micro=4)
+        with pytest.raises(ValueError, match="rngs"):
+            apply_fn(
+                {"params": pipeline_params(variables["params"])},
+                x, train=True,
+            )
+
+    def test_trainer_fit_with_dropout_and_remat(self):
+        """The full Trainer: --pp 2 with dropout 0.3 (the flagship-recipe
+        rate) and --pp-remat trains to finite loss; remat does not change
+        the numbers (same cells, recomputed)."""
+        from distributed_mnist_bnns_tpu.data.common import ImageClassData
+        from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+        if jax.device_count() < 2:
+            pytest.skip("needs 2 virtual devices")
+        rng = np.random.RandomState(0)
+        data = ImageClassData(
+            train_images=rng.rand(32, 28, 28, 1).astype(np.float32),
+            train_labels=rng.randint(0, 10, 32).astype(np.int32),
+            test_images=rng.rand(8, 28, 28, 1).astype(np.float32),
+            test_labels=rng.randint(0, 10, 8).astype(np.int32),
+        )
+
+        def fit(**kw):
+            trainer = Trainer(
+                TrainConfig(
+                    model="bnn-vit-tiny",
+                    model_kwargs={"dropout": 0.3},
+                    epochs=1, batch_size=8, optimizer="sgd",
+                    learning_rate=0.05, backend="xla", seed=0,
+                    pipeline_parallel=2, **kw,
+                )
+            )
+            return trainer, trainer.fit(data)
+
+        t1, h1 = fit()
+        assert np.isfinite(h1[0]["train_loss"])
+        t2, h2 = fit(pp_remat=True)
+        assert abs(h1[0]["train_loss"] - h2[0]["train_loss"]) < 1e-4
+        # remat recomputes the stage in backward — a different XLA
+        # program, so ulp-level reassociation can flip near-zero latent
+        # sign bits (repo numerics policy tolerance)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-3
+            ),
+            t1.state.params, t2.state.params,
+        )
+
+    def test_dp_rows_draw_independent_masks(self):
+        """Under DP x PP the batch-axis row index folds into the cell
+        keys: feeding both DP rows identical data must yield different
+        train-mode outputs (decorrelated dropout masks) while eval-mode
+        outputs stay identical."""
+        if jax.device_count() < 4:
+            pytest.skip("needs 4 virtual devices")
+        mesh = Mesh(
+            np.array(jax.devices()[:4]).reshape(2, 2),
+            axis_names=("data", "pipe"),
+        )
+        model = _vit(depth=2, dropout=0.5)
+        half = jax.random.normal(jax.random.PRNGKey(0), (4, 28, 28, 1))
+        x = jnp.concatenate([half, half])  # row 0 == row 1 data
+        variables = _init(model, x)
+        apply_fn = make_pipelined_apply(
+            model, mesh, 2, n_micro=2, batch_axis="data"
+        )
+        pp = {"params": pipeline_params(variables["params"])}
+        ev = np.asarray(apply_fn(pp, x, train=False))
+        np.testing.assert_allclose(ev[:4], ev[4:], atol=1e-5, rtol=1e-5)
+        tr = np.asarray(apply_fn(
+            pp, x, train=True, rngs={"dropout": jax.random.PRNGKey(3)}
+        ))
+        assert np.abs(tr[:4] - tr[4:]).max() > 1e-6
+
+    def test_stochastic_only_model_uses_binarize_stream(self):
+        """stochastic=True, dropout=0 models take the flax-conventional
+        'binarize' rng stream (not a spurious 'dropout' requirement)."""
+        mesh = _mesh(2)
+        model = _vit(depth=2, stochastic=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 28, 28, 1))
+        variables = model.init(
+            {"params": jax.random.PRNGKey(1),
+             "binarize": jax.random.PRNGKey(2)},
+            x, train=True,
+        )
+        apply_fn = make_pipelined_apply(model, mesh, 2, n_micro=4)
+        pp = {"params": pipeline_params(variables["params"])}
+        with pytest.raises(ValueError, match="binarize"):
+            apply_fn(pp, x, train=True, rngs={"dropout": jax.random.PRNGKey(3)})
+        out = apply_fn(
+            pp, x, train=True, rngs={"binarize": jax.random.PRNGKey(3)}
+        )
+        assert np.isfinite(np.asarray(out)).all()
