@@ -675,7 +675,7 @@ class Trainer:
 
     @staticmethod
     def _build_model(name: str, mk: Dict[str, Any]):
-        optional = ("dtype", "backend", "stochastic", "scale")
+        optional = ("dtype", "backend", "stochastic", "scale", "dropout")
         while True:
             try:
                 return get_model(name, **mk)

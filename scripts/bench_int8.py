@@ -1,0 +1,150 @@
+"""int8 training-headline study (VERDICT r4 item 4).
+
+Round-4 measured the int8 MXU pipeline at ~2x bf16 binary-TOPS on GEMMs
+with pre-cast operands, but the flagship headline stayed bf16 because the
+standalone fp32->int8 cast pass appeared to eat the win (PERF.md §short
+version 2). This script settles it on-chip:
+
+1. GEMM level, flagship training shape (2048x3072x1536), operands
+   produced from fp32 *latents* inside the jitted program (the real
+   per-step situation, where XLA can fuse sign+convert into the
+   producing pass):
+     - bf16_from_latent:  dot(sign(x).bf16, sign(w).bf16)
+     - int8_from_latent:  dot(sign_int8(x), sign_int8(w)) — sign emits
+       int8 directly (select on int8 constants, no fp32 intermediate)
+     - int8_cast_pm1:     the round-4 formulation (±1 fp32 args, cast
+       in-graph) for continuity with PERF.md's numbers
+2. Full train step A/B: Trainer step on backend bf16 vs int8, scan
+   dispatch, steady state — the number that decides the headline. The
+   backward GEMMs are bf16 in both (gradients are not ±1), so int8 can
+   accelerate at most the forward third of step FLOPs.
+
+Emits one JSON line; paste into PERF.md and, if int8 wins end-to-end,
+flip bench.py's default --backend.
+
+CPU smoke: ``--smoke`` shrinks shapes/steps so the harness logic runs
+anywhere (numbers meaningless off-chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (repo-root harness: _measure, _mfu helpers)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    m, k, n = (256, 512, 256) if args.smoke else (2048, 3072, 1536)
+    n_short, n_long = (5, 20) if args.smoke else (20, 100)
+    deadline = time.monotonic() + (120 if args.smoke else 900)
+
+    key = jax.random.PRNGKey(0)
+    latent_x = jax.random.normal(key, (m, k), jnp.float32)
+    latent_w = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.float32)
+    pm1_x = jnp.where(latent_x >= 0, 1.0, -1.0)
+    pm1_w = jnp.where(latent_w >= 0, 1.0, -1.0)
+
+    def sign_i8(v):
+        return jnp.where(v >= 0, jnp.int8(1), jnp.int8(-1))
+
+    bf16_from_latent = jax.jit(lambda x, w: jnp.dot(
+        jnp.where(x >= 0, 1.0, -1.0).astype(jnp.bfloat16),
+        jnp.where(w >= 0, 1.0, -1.0).astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ))
+    int8_from_latent = jax.jit(lambda x, w: jnp.dot(
+        sign_i8(x), sign_i8(w), preferred_element_type=jnp.int32,
+    ).astype(jnp.float32))
+    int8_cast_pm1 = jax.jit(lambda x, w: jnp.dot(
+        x.astype(jnp.int8), w.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32))
+
+    tops = 2.0 * m * k * n
+    gemm = {}
+    for name, fn, a, b in (
+        ("bf16_from_latent", bf16_from_latent, latent_x, latent_w),
+        ("int8_from_latent", int8_from_latent, latent_x, latent_w),
+        ("int8_cast_pm1", int8_cast_pm1, pm1_x, pm1_w),
+    ):
+        dt, _ = bench._measure(
+            lambda fn=fn, a=a, b=b: fn(a, b),
+            lambda r: float(jnp.sum(r)),
+            n_short, n_long, args.reps, deadline,
+        )
+        gemm[name] = (
+            "below measurement floor" if dt is None else {
+                "ms": round(dt * 1e3, 4),
+                "binary_tops": round(tops / dt / 1e12, 2),
+            }
+        )
+
+    # -- full train step A/B ------------------------------------------
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    bs = 256 if args.smoke else 4096
+    steps = 4 if args.smoke else 64
+    step_ab = {}
+    for backend in ("bf16", "int8"):
+        trainer = Trainer(
+            TrainConfig(
+                model="bnn-mlp-large", batch_size=bs, optimizer="adam",
+                learning_rate=0.01, backend=backend, seed=0,
+            ),
+            input_shape=(28, 28, 1),
+        )
+        dt, loss = bench._bench_train_scan(
+            trainer, steps, bs, (28, 28, 1), 2, 2, args.reps, deadline,
+        )
+        if dt is None:
+            step_ab[backend] = "below measurement floor"
+            continue
+        flops_info = bench._step_flops(trainer, bs)
+        peak, prec = bench._chip_peak(jax.devices()[0], backend)
+        step_ab[backend] = {
+            "images_per_sec": round(bs / dt, 1),
+            "step_time_ms": round(dt * 1e3, 3),
+            "mfu_vs_matched_peak": bench._mfu(
+                flops_info[0] if flops_info else None, dt, peak
+            ),
+            "peak_precision": prec,
+        }
+
+    verdict = None
+    if (
+        isinstance(step_ab.get("bf16"), dict)
+        and isinstance(step_ab.get("int8"), dict)
+    ):
+        r = (step_ab["int8"]["images_per_sec"]
+             / step_ab["bf16"]["images_per_sec"])
+        verdict = {
+            "int8_over_bf16_step_ratio": round(r, 4),
+            "headline_backend": "int8" if r > 1.02 else "bf16",
+        }
+    print(json.dumps({
+        "metric": "int8_headline_study",
+        "ts": bench._utc_now(),
+        "device": str(jax.devices()[0]),
+        "shape": [m, k, n],
+        "gemm_from_latents": gemm,
+        "train_step_ab": step_ab,
+        "verdict": verdict,
+    }))
+
+
+if __name__ == "__main__":
+    main()

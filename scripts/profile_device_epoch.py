@@ -1,0 +1,160 @@
+"""Device-resident epoch MFU decomposition (VERDICT r4 item 6).
+
+BENCH_LOCAL_r04 measured the one-dispatch 60k-image epoch at MFU 0.26
+vs 0.675 for the steady-state scan — ~60% of the chip idle somewhere in
+the epoch program. This script attributes the gap on-chip by timing the
+pieces separately:
+
+  A. epoch_fn            — the full one-dispatch epoch (gather + scan)
+  B. scan_pregathered    — make_train_scan over the SAME (n_batches, B)
+                           data, pre-gathered outside the timed region:
+                           isolates the whole-epoch gather cost
+  C. gather_only         — images_all[idx] materialized alone
+  D. tail                — the epoch's non-full trailing batches and
+                           small n_batches amortization are visible by
+                           comparing B at n_batches vs the long-scan
+                           steady state from bench.py
+
+Identity check: A ≈ B + C within noise, else something else (e.g.
+donation/copy) is eating time. Emits one JSON line for PERF.md; pass
+``--profile-dir DIR`` to also dump a jax profiler trace of one epoch
+dispatch.
+
+CPU smoke: ``--smoke`` shrinks everything (numbers meaningless).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=4096)
+    p.add_argument("--images", type=int, default=60000)
+    p.add_argument("--profile-dir", default=None)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_mnist_bnns_tpu.data.mnist import shard_indices
+    from distributed_mnist_bnns_tpu.train import (
+        TrainConfig,
+        Trainer,
+        make_train_scan,
+    )
+
+    bs = 256 if args.smoke else args.batch_size
+    n = 4096 if args.smoke else args.images
+    deadline = time.monotonic() + (120 if args.smoke else 600)
+
+    trainer = Trainer(
+        TrainConfig(
+            model="bnn-mlp-large", batch_size=bs, optimizer="adam",
+            learning_rate=0.01, backend="bf16", seed=0, device_data=True,
+        ),
+        input_shape=(28, 28, 1),
+    )
+    key = jax.random.PRNGKey(0)
+    images_all = jax.random.normal(key, (n, 28, 28, 1), jnp.float32)
+    labels_all = jax.random.randint(key, (n,), 0, 10)
+    idx = shard_indices(n, epoch=0, seed=0, host_id=0, num_hosts=1)
+    nb = len(idx) // bs
+    idx = jnp.asarray(
+        np.asarray(idx[: nb * bs], np.int32).reshape(nb, bs)
+    )
+    epoch_fn = trainer._get_epoch_fn()
+    rng = trainer.rng
+
+    def timed(run, fetch, n_short=1, n_long=3):
+        dt, _ = bench._measure(run, fetch, n_short, n_long,
+                               args.reps, deadline)
+        return dt
+
+    holder = {}
+
+    # A. full epoch dispatch
+    def run_epoch():
+        trainer.state, holder["m"] = epoch_fn(
+            trainer.state, images_all, labels_all, idx, rng
+        )
+        return holder["m"]
+
+    run_epoch()
+    t_epoch = timed(run_epoch, lambda m: float(m["loss"]))
+
+    # C. the whole-epoch gather alone
+    gather = jax.jit(lambda im, lb, idx: (im[idx], lb[idx]))
+
+    def run_gather():
+        return gather(images_all, labels_all, idx)
+
+    run_gather()
+    t_gather = timed(
+        run_gather, lambda r: float(jnp.sum(r[0][0, 0])),
+    )
+
+    # B. scan over pre-gathered batches (no gather in the timed program)
+    im_seq, lb_seq = jax.block_until_ready(run_gather())
+    scan = make_train_scan(
+        trainer.clamp_mask, loss_fn=trainer._loss_fn, donate=False,
+    )
+
+    def run_scan():
+        trainer.state, holder["m"] = scan(
+            trainer.state, im_seq, lb_seq, rng
+        )
+        return holder["m"]
+
+    run_scan()
+    t_scan = timed(run_scan, lambda m: float(m["loss"]))
+
+    flops_info = bench._step_flops(trainer, nb * bs)
+    peak, _ = bench._chip_peak(jax.devices()[0], "bf16")
+
+    def mfu(t):
+        return bench._mfu(flops_info[0] if flops_info else None, t, peak)
+
+    if args.profile_dir:
+        from distributed_mnist_bnns_tpu.utils.profiling import trace
+
+        with trace(args.profile_dir):
+            jax.block_until_ready(run_epoch()["loss"])
+
+    out = {
+        "metric": "device_resident_epoch_breakdown",
+        "ts": bench._utc_now(),
+        "device": str(jax.devices()[0]),
+        "batch_size": bs,
+        "n_batches": nb,
+        "epoch_s": None if t_epoch is None else round(t_epoch, 4),
+        "scan_pregathered_s": None if t_scan is None else round(t_scan, 4),
+        "gather_only_s": None if t_gather is None else round(t_gather, 4),
+        "mfu_epoch": mfu(t_epoch),
+        "mfu_scan_pregathered": mfu(t_scan),
+        "identity_residual_s": (
+            None
+            if None in (t_epoch, t_scan, t_gather)
+            else round(t_epoch - t_scan - t_gather, 4)
+        ),
+        "note": "epoch ~= scan + gather => the gather is the gap; "
+                "large residual => look elsewhere (donation copies, "
+                "metric reductions)",
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
