@@ -23,8 +23,13 @@ from .cnn import DeepCNN
 from .convnet import ConvNet
 from .mlp import bnn_mlp_large, bnn_mlp_small, fp32_mlp_large, qnn_mlp_large
 from .moe import bnn_moe_mlp
-from .resnet import xnor_resnet18, xnor_resnet50
-from .transformer import bnn_vit_small, bnn_vit_tiny
+from .resnet import fp32_resnet18, xnor_resnet18, xnor_resnet50
+from .transformer import (
+    bnn_vit_small,
+    bnn_vit_tiny,
+    fp32_vit_small,
+    fp32_vit_tiny,
+)
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     # flagship BNN MLPs (mnist-dist2.py:46-76 / mnist-dist3.py:40-70)
@@ -42,10 +47,16 @@ MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     # stretch configs (BASELINE.json): binarized ResNets
     "xnor-resnet18": xnor_resnet18,
     "xnor-resnet50": xnor_resnet50,
+    # fp32 twin of the resnet stretch (conv binarization-gap denominator)
+    "fp32-resnet18": fp32_resnet18,
     # binarized transformers (no reference counterpart: the attention
     # stack — flash/ring attention — as a trainable model family)
     "bnn-vit-tiny": bnn_vit_tiny,
     "bnn-vit-small": bnn_vit_small,
+    # fp32 twins of the vit family (binarization-gap denominators,
+    # mirroring fp32-mlp-large's role for the MLP family)
+    "fp32-vit-tiny": fp32_vit_tiny,
+    "fp32-vit-small": fp32_vit_small,
     # binarized MoE (no reference counterpart: the expert-parallel stack
     # — top-2 routing + load-balance aux loss — as a trainable family)
     "bnn-moe-mlp": bnn_moe_mlp,
@@ -72,11 +83,15 @@ def latent_clamp_mask(params: Any) -> Any:
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
 
     def is_latent(path) -> bool:
-        return any(
-            getattr(p, "key", "").startswith("Binarized")
-            for p in path
-            if hasattr(p, "key")
-        )
+        # Match the leaf's immediate owner module, not any ancestor: an
+        # fp32-twin nn.Dense nested under BinarizedSelfAttention_0 must
+        # NOT be clamped (binarized=False swaps the children, but the
+        # attention wrapper keeps its class-derived name). Every real
+        # latent is directly owned by a Binarized* module
+        # (BinarizedDense/BinarizedConv kernels+biases, the
+        # BinarizedExperts_0 stacked bank).
+        keys = [getattr(p, "key", "") for p in path if hasattr(p, "key")]
+        return len(keys) >= 2 and keys[-2].startswith("Binarized")
 
     mask_flat = [is_latent(path) for path, _ in flat]
     treedef = jax.tree_util.tree_structure(params)

@@ -25,6 +25,18 @@ from ..ops.xnor_gemm import Backend
 from .layers import BinarizedConv
 
 
+def _twin_conv(block, features, kernel, strides=(1, 1)):
+    """The binarized/fp32 twin switch shared by both block types: one
+    definition of which kwargs each side gets, so the basic and
+    bottleneck blocks' twins cannot drift apart."""
+    if not block.binarized:
+        return nn.Conv(features, kernel, strides=strides)
+    return BinarizedConv(
+        features, kernel, strides=strides, ste=block.ste,
+        backend=block.backend, scale=block.scale,
+    )
+
+
 class XnorBasicBlock(nn.Module):
     """Pre-activation binarized basic block: BN -> BinConv3x3 -> BN ->
     BinConv3x3 (+ fp32 1x1 projection shortcut on stride/width change)."""
@@ -34,21 +46,24 @@ class XnorBasicBlock(nn.Module):
     backend: Backend | None = None
     ste: str = "identity"
     scale: bool = False  # XNOR-Net per-channel alpha on binarized convs
+    binarized: bool = True  # False: fp32 twin (nn.Conv), same topology
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         bn = lambda: nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5
         )
+
+        def conv(features, kernel, strides=(1, 1)):
+            return _twin_conv(self, features, kernel, strides)
+
         shortcut = x
         y = bn()(x)
-        y = BinarizedConv(
-            self.features, (3, 3), strides=(self.strides, self.strides),
-            ste=self.ste, backend=self.backend, scale=self.scale,
+        y = conv(
+            self.features, (3, 3), strides=(self.strides, self.strides)
         )(y)
         y = bn()(y)
-        y = BinarizedConv(self.features, (3, 3), ste=self.ste,
-                          backend=self.backend, scale=self.scale)(y)
+        y = conv(self.features, (3, 3))(y)
         if shortcut.shape[-1] != self.features or self.strides != 1:
             shortcut = nn.Conv(
                 self.features, (1, 1),
@@ -65,25 +80,27 @@ class XnorBottleneckBlock(nn.Module):
     backend: Backend | None = None
     ste: str = "identity"
     scale: bool = False  # XNOR-Net per-channel alpha on binarized convs
+    binarized: bool = True  # False: fp32 twin (nn.Conv), same topology
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         bn = lambda: nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5
         )
+
+        def conv(features, kernel, strides=(1, 1)):
+            return _twin_conv(self, features, kernel, strides)
+
         out_ch = self.features * 4
         shortcut = x
         y = bn()(x)
-        y = BinarizedConv(self.features, (1, 1), ste=self.ste,
-                          backend=self.backend, scale=self.scale)(y)
+        y = conv(self.features, (1, 1))(y)
         y = bn()(y)
-        y = BinarizedConv(
-            self.features, (3, 3), strides=(self.strides, self.strides),
-            ste=self.ste, backend=self.backend, scale=self.scale,
+        y = conv(
+            self.features, (3, 3), strides=(self.strides, self.strides)
         )(y)
         y = bn()(y)
-        y = BinarizedConv(out_ch, (1, 1), ste=self.ste,
-                          backend=self.backend, scale=self.scale)(y)
+        y = conv(out_ch, (1, 1))(y)
         if shortcut.shape[-1] != out_ch or self.strides != 1:
             shortcut = nn.Conv(
                 out_ch, (1, 1), strides=(self.strides, self.strides),
@@ -103,6 +120,8 @@ class XnorResNet(nn.Module):
     backend: Backend | None = None
     ste: str = "identity"
     scale: bool = False  # XNOR-Net per-channel alpha on binarized convs
+    binarized: bool = True  # False: fp32 twin — the accuracy denominator
+                            # for the conv binarization gap (RESULTS.md)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -122,6 +141,7 @@ class XnorResNet(nn.Module):
                 x = block(
                     features, strides=strides, ste=self.ste,
                     backend=self.backend, scale=self.scale,
+                    binarized=self.binarized,
                 )(x, train=train)
         x = nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5
@@ -138,3 +158,10 @@ def xnor_resnet18(**kw) -> XnorResNet:
 def xnor_resnet50(**kw) -> XnorResNet:
     return XnorResNet(stage_sizes=(3, 4, 6, 3), bottleneck=True,
                       cifar_stem=False, **kw)
+
+
+def fp32_resnet18(**kw) -> XnorResNet:
+    """xnor_resnet18 with binarization removed — the conv-family
+    accuracy denominator (same role as fp32_mlp_large / fp32_vit_tiny)."""
+    kw.setdefault("binarized", False)
+    return xnor_resnet18(**kw)

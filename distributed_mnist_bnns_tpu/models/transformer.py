@@ -74,6 +74,8 @@ class BinarizedSelfAttention(nn.Module):
     stochastic: bool = False
     scale: bool = False  # XNOR-Net per-channel alpha on binarized GEMMs
     backend: Optional[Backend] = None
+    binarized: bool = True  # False: fp32 twin (nn.Dense projections),
+                            # topology otherwise identical (see BnnMLP)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -89,6 +91,8 @@ class BinarizedSelfAttention(nn.Module):
         # BinarizedDense_N names — latent_clamp_mask selects latents by
         # the "Binarized" module-path prefix (models/registry.py).
         def dense():
+            if not self.binarized:
+                return nn.Dense(self.embed_dim)
             return BinarizedDense(
                 self.embed_dim,
                 binarize_input=True,
@@ -148,10 +152,13 @@ class TransformerBlock(nn.Module):
     stochastic: bool = False
     scale: bool = False
     backend: Optional[Backend] = None
+    binarized: bool = True
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         def dense(features):
+            if not self.binarized:
+                return nn.Dense(features)
             return BinarizedDense(
                 features,
                 binarize_input=True,
@@ -172,6 +179,7 @@ class TransformerBlock(nn.Module):
             stochastic=self.stochastic,
             scale=self.scale,
             backend=self.backend,
+            binarized=self.binarized,
         )(y)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
@@ -207,6 +215,8 @@ class BinarizedTransformer(nn.Module):
     stochastic: bool = False
     scale: bool = False  # XNOR-Net per-channel alpha on binarized GEMMs
     backend: Optional[Backend] = None
+    binarized: bool = True  # False: fp32 twin — accuracy yardstick for
+                            # the transformer binarization gap (RESULTS.md)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -222,12 +232,15 @@ class BinarizedTransformer(nn.Module):
         x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, nh * nw, p * p * c)
         # Patch embedding on raw pixels: binarized weights, fp32 input
         # (first-layer passthrough semantics).
-        x = BinarizedDense(  # patch embedding (auto-named: clamp mask)
-            self.embed_dim,
-            binarize_input=False,
-            ste=self.ste,
-            backend=self.backend,
-        )(x)
+        if self.binarized:
+            x = BinarizedDense(  # patch embedding (auto-named: clamp mask)
+                self.embed_dim,
+                binarize_input=False,
+                ste=self.ste,
+                backend=self.backend,
+            )(x)
+        else:
+            x = nn.Dense(self.embed_dim)(x)
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(stddev=0.02),
@@ -246,6 +259,7 @@ class BinarizedTransformer(nn.Module):
                 stochastic=self.stochastic,
                 scale=self.scale,
                 backend=self.backend,
+                binarized=self.binarized,
             )(x, train=train)
         x = nn.LayerNorm(name="ln_head")(x).mean(axis=1)
         x = nn.Dense(self.num_classes, name="head")(x)
@@ -278,6 +292,7 @@ class BinarizedLM(nn.Module):
     stochastic: bool = False
     scale: bool = False
     backend: Optional[Backend] = None
+    binarized: bool = True  # False: fp32 twin (see BinarizedTransformer)
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -304,6 +319,7 @@ class BinarizedLM(nn.Module):
                 stochastic=self.stochastic,
                 scale=self.scale,
                 backend=self.backend,
+                binarized=self.binarized,
             )(x, train=train)
         x = nn.LayerNorm(name="ln_head")(x)
         return nn.log_softmax(nn.Dense(self.vocab, name="head")(x))
@@ -333,3 +349,16 @@ def bnn_vit_small(**kw) -> BinarizedTransformer:
     kw.setdefault("depth", 4)
     kw.setdefault("num_heads", 8)
     return BinarizedTransformer(**kw)
+
+
+def fp32_vit_tiny(**kw) -> BinarizedTransformer:
+    """bnn-vit-tiny with binarization removed — the accuracy denominator
+    for the transformer binarization gap (same role as fp32_mlp_large)."""
+    kw.setdefault("binarized", False)
+    return bnn_vit_tiny(**kw)
+
+
+def fp32_vit_small(**kw) -> BinarizedTransformer:
+    """bnn-vit-small with binarization removed (fp32 twin)."""
+    kw.setdefault("binarized", False)
+    return bnn_vit_small(**kw)

@@ -1,0 +1,44 @@
+"""Conv-family binarization-gap study (VERDICT r4 item 3).
+
+BASELINE.json config 4 names "CIFAR-10 XNOR-ResNet-18", but CIFAR-10 is
+not shippable in this workspace: zero network egress and no CIFAR bytes
+anywhere in the image (the keras loader present under site-packages
+downloads on first use, which cannot happen here). What IS real data is
+the vendored MNIST t10k split (9k train / 1k test — RESULTS.md's
+established methodology), and the XnorResNet CIFAR stem consumes any
+HWC resolution, so the conv-family control the item actually needs —
+xnor-resnet18 vs an architecture-identical fp32-resnet18, multi-seed,
+real data — runs on that split.
+
+Writes RESULTS_CONV.md via examples/accuracy_report (which computes the
+twin gap) and prints the per-model accuracies. Sized for a live TPU
+window; on CPU expect ~2 h.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_mnist_bnns_tpu.examples.accuracy_report import run  # noqa: E402
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=15)
+    p.add_argument("--seeds", type=int, nargs="+", default=[42, 43, 44])
+    p.add_argument("--out", default="RESULTS_CONV.md")
+    args = p.parse_args()
+    run(
+        ["xnor-resnet18", "fp32-resnet18"],
+        epochs=args.epochs, batch_size=64, lr=0.01,
+        seeds=args.seeds, out_path=args.out, scan_steps=4,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Transformer binarization-gap study (VERDICT r4 item 5).
+
+Round 4 published bnn-vit-tiny at 46.3% tuned with no fp32 denominator.
+This runs the twin pair (bnn-vit-tiny vs fp32-vit-tiny — identical
+topology, binarization removed) multi-seed on the real t10k split via
+examples/accuracy_report, then the byte-LM twin pair on the external
+licenses corpus (scripts/lm_corpus_eval --fp32-twin) at the full 256-dim
+configuration.
+
+Writes RESULTS_VIT.md + prints the lm_corpus_eval JSON line. Sized for a
+live TPU window; the ViT half is CPU-feasible (~15 min), the 256-dim LM
+half is slow off-chip (use --lm-steps 0 to skip it).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distributed_mnist_bnns_tpu.examples.accuracy_report import run  # noqa: E402
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--seeds", type=int, nargs="+", default=[42, 43, 44])
+    p.add_argument("--out", default="RESULTS_VIT.md")
+    p.add_argument("--lm-steps", type=int, default=4000,
+                   help="0 skips the LM corpus half")
+    args = p.parse_args()
+    run(
+        ["bnn-vit-tiny", "fp32-vit-tiny"],
+        epochs=args.epochs, batch_size=64, lr=0.003,
+        seeds=args.seeds, out_path=args.out, scan_steps=4,
+    )
+    if args.lm_steps > 0:
+        subprocess.run(
+            [sys.executable, "scripts/lm_corpus_eval.py",
+             "--embed-dim", "256", "--depth", "4", "--seq-len", "256",
+             "--steps", str(args.lm_steps), "--fp32-twin"],
+            cwd=REPO, check=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

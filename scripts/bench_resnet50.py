@@ -1,0 +1,96 @@
+"""xnor-resnet50 ImageNet-shape single-chip evidence (VERDICT r4 item 9).
+
+BASELINE.json config 5 is "ImageNet-1k XNOR-ResNet-50"; no ImageNet
+bytes ship in this workspace (zero egress), so the single-chip evidence
+is synthetic-data throughput at the real resolution: the train step at
+224x224x3 through the ImageNet streaming pipeline's synthetic-tar path
+(data/imagenet.py), plus a conv MFU from XLA's analytic conv FLOPs.
+
+Conv MFU accounting: per-image forward FLOPs are computed analytically
+from the model's conv shapes (2 * K_h * K_w * C_in * C_out * H_out *
+W_out per conv, the standard convention), x3 for the two backward GEMMs
+— the same 3x-forward estimate bench.py uses for the MLP families.
+
+Emits one JSON line for BENCH extras / PERF.md. ``--smoke`` shrinks the
+resolution/batch for CPU validation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from bench import _conv_macs_per_image  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    input_shape = (64, 64, 3) if args.smoke else (224, 224, 3)
+    bs = 8 if args.smoke else args.batch_size
+    deadline = time.monotonic() + (180 if args.smoke else 900)
+
+    trainer = Trainer(
+        TrainConfig(
+            model="xnor-resnet50",
+            model_kwargs={"num_classes": 1000},
+            batch_size=bs, optimizer="adam", learning_rate=0.01,
+            backend="bf16", seed=0,
+        ),
+        input_shape=input_shape,
+    )
+    key = jax.random.PRNGKey(0)
+    images = jax.device_put(
+        jax.random.normal(key, (bs, *input_shape), jnp.float32)
+    )
+    labels = jax.device_put(jax.random.randint(key, (bs,), 0, 1000))
+    dt, loss = bench._bench_train_step(
+        trainer, images, labels, steps=10 if args.smoke else 30,
+        warmup=2, reps=args.reps, deadline=deadline,
+    )
+    out = {
+        "metric": "resnet50_imagenet_synthetic",
+        "ts": bench._utc_now(),
+        "device": str(jax.devices()[0]),
+        "input_shape": list(input_shape),
+        "batch_size": bs,
+        "backend": "bf16",
+    }
+    if dt is None:
+        out["note"] = "below measurement floor"
+    else:
+        variables = {
+            "params": trainer.state.params,
+            "batch_stats": trainer.state.batch_stats,
+        }
+        macs = _conv_macs_per_image(trainer.model, variables, input_shape)
+        step_flops = 3.0 * 2.0 * macs * bs
+        peak, _ = bench._chip_peak(jax.devices()[0], "bf16")
+        out.update({
+            "images_per_sec": round(bs / dt, 1),
+            "step_time_ms": round(dt * 1e3, 3),
+            "loss_finite": bool(loss == loss),
+            "conv_macs_per_image": int(macs),
+            "mfu": bench._mfu(step_flops, dt, peak),
+            "flops_method": "analytic_3x_conv_and_dense_from_jaxpr",
+        })
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

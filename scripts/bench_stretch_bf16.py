@@ -1,0 +1,92 @@
+"""CIFAR-shape XNOR-ResNet-18 stretch bench on the bf16 backend
+(VERDICT r4 item 3, perf half).
+
+Round 4 published `stretch_xnor_resnet18_cifar` on backend=pallas_xnor —
+the backend PERF.md itself shows loses training shapes to bf16 by ~2x.
+This measures the stretch on the measured-fastest backend (bf16 MXU,
+the framework default) AND emits conv MFU via the same jaxpr-walk
+analytic FLOPs as scripts/bench_resnet50.py, so the stretch row finally
+compares against the north star. Also keeps a pallas_xnor row for the
+backend-gap record.
+
+Emits one JSON line. ``--smoke`` shrinks for CPU validation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from bench import _conv_macs_per_image  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    bs = 32 if args.smoke else args.batch_size
+    input_shape = (32, 32, 3)
+    deadline = time.monotonic() + (240 if args.smoke else 900)
+    key = jax.random.PRNGKey(0)
+    images = jax.device_put(
+        jax.random.normal(key, (bs, *input_shape), jnp.float32)
+    )
+    labels = jax.device_put(jax.random.randint(key, (bs,), 0, 10))
+
+    out = {
+        "metric": "stretch_xnor_resnet18_cifar_bf16",
+        "ts": bench._utc_now(),
+        "device": str(jax.devices()[0]),
+        "batch_size": bs,
+    }
+    macs = None  # computed once from the bf16 trace: model MACs are
+    # backend-invariant, and the im2col backends' jaxprs count the
+    # patch-extraction conv as ~13x phantom MACs
+    for backend in ("bf16",) if args.smoke else ("bf16", "pallas_xnor"):
+        trainer = Trainer(
+            TrainConfig(
+                model="xnor-resnet18", batch_size=bs, optimizer="adam",
+                learning_rate=0.01, backend=backend, seed=0,
+            ),
+            input_shape=input_shape,
+        )
+        if backend == "bf16":
+            macs = _conv_macs_per_image(
+                trainer.model,
+                {"params": trainer.state.params,
+                 "batch_stats": trainer.state.batch_stats},
+                input_shape,
+            )
+        dt, loss = bench._bench_train_step(
+            trainer, images, labels, steps=10 if args.smoke else 30,
+            warmup=2, reps=args.reps, deadline=deadline,
+        )
+        if dt is None:
+            out[backend] = "below measurement floor"
+            continue
+        peak, _ = bench._chip_peak(jax.devices()[0], "bf16")
+        out[backend] = {
+            "images_per_sec": round(bs / dt, 1),
+            "step_time_ms": round(dt * 1e3, 3),
+            "loss_finite": bool(loss == loss),
+            "mfu": bench._mfu(3.0 * 2.0 * macs * bs, dt, peak),
+        }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Assemble the repo's external byte corpus (VERDICT r4 item 7).
+
+Round 4's LM evidence used the framework's own source as the corpus —
+self-referential. This image has no downloadable datasets (zero egress)
+and no bundled NLP corpora (nltk data absent), so the best available
+non-self-referential English prose is /usr/share/common-licenses: the
+GNU/Apache/MPL/CC0 license texts, ~300 KB of real legal-register
+English whose verbatim redistribution is explicitly permitted by every
+one of them.
+
+Deterministic assembly: files sorted by name, symlink duplicates
+(e.g. GPL -> GPL-3) dropped by realpath, concatenated with a one-line
+header each. The output is committed at data_files/licenses_corpus.txt
+so training is reproducible off this image too.
+"""
+
+from __future__ import annotations
+
+import os
+
+SRC = "/usr/share/common-licenses"
+DST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "data_files", "licenses_corpus.txt",
+)
+
+
+def main() -> None:
+    seen = set()
+    parts = []
+    for name in sorted(os.listdir(SRC)):
+        path = os.path.join(SRC, name)
+        real = os.path.realpath(path)
+        if real in seen or not os.path.isfile(real):
+            continue
+        seen.add(real)
+        with open(real, "rb") as f:
+            body = f.read()
+        parts.append(f"===== {name} =====\n".encode() + body + b"\n")
+    os.makedirs(os.path.dirname(DST), exist_ok=True)
+    with open(DST, "wb") as f:
+        f.write(b"".join(parts))
+    print(f"{DST}: {os.path.getsize(DST)} bytes from {len(seen)} licenses")
+
+
+if __name__ == "__main__":
+    main()

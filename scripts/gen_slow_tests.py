@@ -1,0 +1,58 @@
+"""Regenerate tests/slow_tests.txt (the fast-tier exclusion list).
+
+Usage:
+    python -m pytest tests/ -q --durations=0 > /tmp/durations.txt
+    python scripts/gen_slow_tests.py /tmp/durations.txt
+
+Tests whose summed setup+call+teardown time exceeds THRESH seconds are
+marked slow, except that every test file keeps its fastest test in the
+fast tier so ``pytest -m "not slow"`` still touches every subsystem.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import sys
+
+THRESH = 3.0
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "slow_tests.txt",
+)
+
+
+def main() -> None:
+    src = sys.argv[1]
+    durs: dict = {}
+    for line in open(src):
+        m = re.match(r"([\d.]+)s (call|setup|teardown)\s+(tests/\S+)", line)
+        if m:
+            durs[m.group(3)] = durs.get(m.group(3), 0.0) + float(m.group(1))
+    by_file = collections.defaultdict(list)
+    for nid, t in durs.items():
+        by_file[nid.split("::")[0]].append((t, nid))
+    slow = set()
+    for f, tests in by_file.items():
+        tests.sort()
+        fast = [x for x in tests if x[0] < THRESH]
+        cands = [x for x in tests if x[0] >= THRESH]
+        if not fast and cands:
+            cands = cands[1:]  # keep the file's fastest for coverage
+        slow.update(nid for _, nid in cands)
+    with open(OUT, "w") as fh:
+        fh.write(
+            "# Tests marked slow by conftest (fast tier: pytest -m 'not "
+            "slow').\n# Generated from a full-suite `--durations=0` run; "
+            f"threshold {THRESH}s,\n# keeping at least one fast test per "
+            "file so the fast tier still\n# touches every subsystem. "
+            "Regenerate with scripts/gen_slow_tests.py.\n"
+        )
+        for nid in sorted(slow):
+            fh.write(nid + "\n")
+    print(f"{OUT}: {len(slow)} slow tests")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,218 @@
+"""Binarized-LM bits/byte on the external corpus, with honest baselines
+(VERDICT r4 item 7).
+
+Protocol: contiguous 90/10 train/valid split of
+data_files/licenses_corpus.txt (build_licenses_corpus.py). The LM trains
+on random train-side windows; bits/byte is measured on the UNSEEN valid
+side with full-window context (positions past the warmup prefix score
+their next byte; the first ``context`` positions of each window are
+excluded so every scored byte has at least that much context).
+
+Anchors computed on the same split (train-fit, valid-scored):
+  - order-0 (unigram) entropy: add-1-smoothed byte unigram model
+  - bigram conditional: add-1-smoothed P(b_t | b_{t-1})
+  - trigram conditional: add-1-smoothed P(b_t | b_{t-2}, b_{t-1})
+A byte LM only earns its keep below the n-gram line it can afford to
+beat; enwik8-class transformer results sit near ~1.0-1.3 bits/byte for
+context, but that corpus is 400x larger — the honest comparison here is
+the n-grams on THIS corpus.
+
+Emits one JSON line (paste into RESULTS.md). Defaults are sized to run
+on CPU in ~15 min; pass --embed-dim 256 --depth 4 --steps 4000 on a live
+TPU window for the full-size family evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CORPUS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "data_files", "licenses_corpus.txt",
+)
+
+
+def ngram_bits_per_byte(train, valid, order: int) -> float:
+    """Add-1-smoothed order-``order`` conditional model, fit on train,
+    scored on valid (contexts drawn from valid itself, first order
+    bytes skipped)."""
+    import numpy as np
+
+    if order == 1:
+        counts = np.bincount(train, minlength=256).astype(np.float64)
+        probs = (counts + 1.0) / (counts.sum() + 256.0)
+        return float(-np.log2(probs[valid]).mean())
+    # context hash: previous (order-1) bytes as an integer
+    def ctx(arr, i):
+        c = 0
+        for j in range(order - 1):
+            c = c * 256 + int(arr[i - order + 1 + j])
+        return c
+
+    from collections import defaultdict
+
+    counts: dict = defaultdict(lambda: defaultdict(int))
+    totals: dict = defaultdict(int)
+    for i in range(order - 1, len(train)):
+        c = ctx(train, i)
+        counts[c][int(train[i])] += 1
+        totals[c] += 1
+    bits = 0.0
+    n = 0
+    for i in range(order - 1, len(valid)):
+        c = ctx(valid, i)
+        num = counts[c][int(valid[i])] + 1.0
+        den = totals[c] + 256.0
+        bits += -math.log2(num / den)
+        n += 1
+    return bits / n
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=1500)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--embed-dim", type=int, default=128)
+    p.add_argument("--depth", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--context", type=int, default=32,
+                   help="min context per scored byte in eval windows "
+                        "(>= 1; position i scores byte i+1, so context 1 "
+                        "scores every window position)")
+    p.add_argument("--fp32-twin", action="store_true",
+                   help="also train an fp32 twin (binarization-gap "
+                        "denominator)")
+    args = p.parse_args()
+    if args.context < 1 or args.context >= args.seq_len:
+        p.error(
+            f"--context must be in [1, seq_len); got {args.context} "
+            f"(context-1 slicing would silently score only window-final "
+            "bytes at 0)"
+        )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_mnist_bnns_tpu.models import (
+        latent_clamp_mask,
+        lm_loss,
+    )
+    from distributed_mnist_bnns_tpu.models.transformer import BinarizedLM
+    from distributed_mnist_bnns_tpu.train import clamp_latent
+
+    data = np.frombuffer(open(CORPUS, "rb").read(), np.uint8)
+    split = int(len(data) * 0.9)
+    train, valid = data[:split], data[split:]
+    rng = np.random.RandomState(args.seed)
+    t = args.seq_len
+
+    def train_lm(binarized: bool):
+        model = BinarizedLM(
+            vocab=256, max_len=t, embed_dim=args.embed_dim,
+            depth=args.depth, num_heads=args.num_heads, attention="xla",
+            binarized=binarized,
+        )
+        variables = model.init(
+            {"params": jax.random.PRNGKey(args.seed)},
+            jnp.zeros((2, t), jnp.int32), train=False,
+        )
+        params = variables["params"]
+        mask = latent_clamp_mask(params)
+        tx = optax.adam(args.lr)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, tokens):
+            def loss_fn(p):
+                return lm_loss(
+                    model.apply({"params": p}, tokens, train=False),
+                    tokens,
+                )
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            up, opt = tx.update(g, opt, params)
+            return (
+                clamp_latent(optax.apply_updates(params, up), mask),
+                opt, loss,
+            )
+
+        t0 = time.time()
+        loss = None
+        for i in range(args.steps):
+            starts = rng.randint(0, len(train) - t, size=args.batch)
+            tokens = jnp.asarray(
+                np.stack([train[s:s + t] for s in starts]), jnp.int32
+            )
+            params, opt, loss = step(params, opt, tokens)
+        train_s = time.time() - t0
+
+        # held-out bits/byte: tile valid into overlapping windows with
+        # stride (t - context); score positions [context, t) of each
+        @jax.jit
+        def window_bits(params, tokens):
+            lp = model.apply({"params": params}, tokens, train=False)
+            tgt = tokens[:, 1:]
+            per = jnp.take_along_axis(
+                lp[:, :-1], tgt[..., None], axis=-1
+            )[..., 0]
+            return per[:, args.context - 1:]
+
+        stride = t - args.context
+        starts = list(range(0, len(valid) - t, stride))
+        bits, count = 0.0, 0
+        for i in range(0, len(starts), args.batch):
+            chunk = starts[i:i + args.batch]
+            toks = jnp.asarray(
+                np.stack([valid[s:s + t] for s in chunk]), jnp.int32
+            )
+            per = np.asarray(window_bits(params, toks))
+            bits += float(-per.sum() / math.log(2.0))
+            count += per.size
+        return {
+            "train_final_loss_bits": round(
+                float(loss) / math.log(2.0), 4
+            ),
+            "valid_bits_per_byte": round(bits / count, 4),
+            "train_seconds": round(train_s, 1),
+            "scored_bytes": count,
+        }
+
+    result = {
+        "metric": "lm_licenses_corpus",
+        "corpus_bytes": int(len(data)),
+        "train_bytes": int(split),
+        "valid_bytes": int(len(data) - split),
+        "config": {
+            "embed_dim": args.embed_dim, "depth": args.depth,
+            "seq_len": t, "steps": args.steps, "batch": args.batch,
+        },
+        "baselines_bits_per_byte": {
+            "unigram": round(ngram_bits_per_byte(train, valid, 1), 4),
+            "bigram": round(ngram_bits_per_byte(train, valid, 2), 4),
+            "trigram": round(ngram_bits_per_byte(train, valid, 3), 4),
+        },
+        "bnn_lm": train_lm(True),
+    }
+    if args.fp32_twin:
+        result["fp32_lm"] = train_lm(False)
+        result["binarization_gap_bits_per_byte"] = round(
+            result["bnn_lm"]["valid_bits_per_byte"]
+            - result["fp32_lm"]["valid_bits_per_byte"], 4,
+        )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

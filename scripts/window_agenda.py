@@ -76,6 +76,9 @@ def _steps():
         ("device_resident_profile",
          [py, "scripts/profile_device_epoch.py"],
          1800, os.path.join(HERE, "profile_device_epoch.py")),
+        ("resnet50_imagenet",
+         [py, "scripts/bench_resnet50.py"],
+         1800, os.path.join(HERE, "bench_resnet50.py")),
         ("cifar_accuracy",
          [py, "scripts/accuracy_cifar.py"],
          7200, os.path.join(HERE, "accuracy_cifar.py")),
