@@ -25,12 +25,11 @@ import sys
 import time
 
 # Re-assert JAX_PLATFORMS over any sitecustomize that flipped the jax
-# config at interpreter start (same dance as cli._honor_platform_env) —
-# must run before anything initializes a backend.
-if os.environ.get("JAX_PLATFORMS"):
-    from distributed_mnist_bnns_tpu.utils.platform import pin_platform
+# config at interpreter start — must run before anything initializes a
+# backend; raises if a backend already initialized elsewhere.
+from distributed_mnist_bnns_tpu.utils.platform import pin_platform_from_env
 
-    pin_platform(os.environ["JAX_PLATFORMS"])
+pin_platform_from_env()
 
 
 def _min_marginal(fn, fetch, n_short: int, n_long: int, reps: int) -> float:

@@ -194,12 +194,9 @@ def main():
     # Re-assert JAX_PLATFORMS over any sitecustomize that flipped the jax
     # config at interpreter start (same dance as cli/bench) — must run
     # before anything initializes a backend.
-    import os
+    from ..utils.platform import pin_platform_from_env
 
-    if os.environ.get("JAX_PLATFORMS"):
-        from ..utils.platform import pin_platform
-
-        pin_platform(os.environ["JAX_PLATFORMS"])
+    pin_platform_from_env()
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--seq-len", type=int, default=32)

@@ -25,6 +25,29 @@ def backend_initialized() -> bool:
     return bool(getattr(xb, "_backends", None))
 
 
+def pin_platform_from_env() -> None:
+    """Honor ``JAX_PLATFORMS`` when set, raising if it is too late.
+
+    The shared entry-point preamble (bench.py, the CLI-adjacent scripts,
+    examples/lm_demo): with the env var unset this is a no-op (the
+    default — possibly remote-TPU — platform wins, which is what a live
+    hardware window wants); with it set, the platform is pinned before
+    backend init, and a pin that can no longer take effect raises
+    instead of letting the run proceed onto the wrong backend (e.g. a
+    multi-hour CPU study silently dialing a dead remote endpoint)."""
+    platform = os.environ.get("JAX_PLATFORMS")
+    if not platform:
+        return
+    if not pin_platform(platform):
+        import jax
+
+        raise RuntimeError(
+            f"JAX_PLATFORMS={platform!r} requested but a "
+            f"{jax.default_backend()!r} backend is already initialized; "
+            "pin earlier (before any jax computation/import side effect)"
+        )
+
+
 def pin_platform(
     platform: str, virtual_device_count: int | None = None
 ) -> bool:

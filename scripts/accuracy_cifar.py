@@ -22,6 +22,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Honor JAX_PLATFORMS over the image's sitecustomize (remote-TPU
+# plugin); raises if a backend already initialized on the wrong platform.
+from distributed_mnist_bnns_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()
+
 from distributed_mnist_bnns_tpu.examples.accuracy_report import run  # noqa: E402
 
 

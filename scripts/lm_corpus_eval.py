@@ -33,6 +33,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Honor JAX_PLATFORMS over the image's sitecustomize (remote-TPU
+# plugin); raises if a backend already initialized on the wrong platform.
+from distributed_mnist_bnns_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()
+
 CORPUS = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "data_files", "licenses_corpus.txt",
