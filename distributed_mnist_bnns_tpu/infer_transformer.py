@@ -94,6 +94,12 @@ def _ln_fn(params: Dict) -> Callable:
 
 
 def _check_freezable(model) -> None:
+    if not model.binarized or model.binarized_attention is False:
+        raise ValueError(
+            "packed freezing covers fully-binarized models only; the "
+            "fp32 twins / partial-binarization ablations have no packed "
+            "weights to freeze (serve them as live models)"
+        )
     if model.stochastic:
         raise ValueError(
             "stochastic activation binarization is a train-time feature; "

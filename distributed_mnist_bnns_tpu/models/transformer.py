@@ -153,6 +153,9 @@ class TransformerBlock(nn.Module):
     scale: bool = False
     backend: Optional[Backend] = None
     binarized: bool = True
+    binarized_attention: Optional[bool] = None  # None: follow `binarized`;
+    # False with binarized=True = the partial-binarization ablation
+    # (fp32 q/k/v/out, binary MLP blocks — RESULTS.md gap attribution)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -169,6 +172,11 @@ class TransformerBlock(nn.Module):
             )
 
         y = nn.LayerNorm(name="ln_attn")(x)
+        attn_binarized = (
+            self.binarized
+            if self.binarized_attention is None
+            else self.binarized_attention
+        )
         y = BinarizedSelfAttention(
             self.embed_dim,
             self.num_heads,
@@ -179,7 +187,7 @@ class TransformerBlock(nn.Module):
             stochastic=self.stochastic,
             scale=self.scale,
             backend=self.backend,
-            binarized=self.binarized,
+            binarized=attn_binarized,
         )(y)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
@@ -217,6 +225,8 @@ class BinarizedTransformer(nn.Module):
     backend: Optional[Backend] = None
     binarized: bool = True  # False: fp32 twin — accuracy yardstick for
                             # the transformer binarization gap (RESULTS.md)
+    binarized_attention: Optional[bool] = None  # partial-binarization
+                            # ablation (see TransformerBlock)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -260,6 +270,7 @@ class BinarizedTransformer(nn.Module):
                 scale=self.scale,
                 backend=self.backend,
                 binarized=self.binarized,
+                binarized_attention=self.binarized_attention,
             )(x, train=train)
         x = nn.LayerNorm(name="ln_head")(x).mean(axis=1)
         x = nn.Dense(self.num_classes, name="head")(x)
@@ -293,6 +304,8 @@ class BinarizedLM(nn.Module):
     scale: bool = False
     backend: Optional[Backend] = None
     binarized: bool = True  # False: fp32 twin (see BinarizedTransformer)
+    binarized_attention: Optional[bool] = None  # partial-binarization
+                            # ablation (see TransformerBlock)
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -320,6 +333,7 @@ class BinarizedLM(nn.Module):
                 scale=self.scale,
                 backend=self.backend,
                 binarized=self.binarized,
+                binarized_attention=self.binarized_attention,
             )(x, train=train)
         x = nn.LayerNorm(name="ln_head")(x)
         return nn.log_softmax(nn.Dense(self.vocab, name="head")(x))

@@ -92,6 +92,8 @@ def _block_module(model) -> TransformerBlock:
         stochastic=model.stochastic,
         scale=model.scale,
         backend=model.backend,
+        binarized=model.binarized,
+        binarized_attention=model.binarized_attention,
     )
 
 

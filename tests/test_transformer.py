@@ -312,3 +312,73 @@ class TestBinarizedLM:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
             )
+
+
+class TestTwinsAndAblation:
+    """Round 5: fp32 twins + the partial-binarization ablation."""
+
+    def _fit_probe(self, model):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+        v = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+        out = model.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+        return v
+
+    def test_fp32_twin_has_no_clamped_latents(self):
+        import jax
+
+        from distributed_mnist_bnns_tpu.models import (
+            get_model,
+            latent_clamp_mask,
+        )
+
+        v = self._fit_probe(get_model("fp32-vit-tiny"))
+        mask = latent_clamp_mask(v["params"])
+        assert not any(jax.tree.leaves(mask))
+
+    def test_ablation_keeps_mlp_latents_only(self):
+        import jax
+
+        from distributed_mnist_bnns_tpu.models import latent_clamp_mask
+        from distributed_mnist_bnns_tpu.models.transformer import (
+            bnn_vit_tiny,
+        )
+
+        full = bnn_vit_tiny()
+        abl = bnn_vit_tiny(binarized_attention=False)
+        v_full = self._fit_probe(full)
+        v_abl = self._fit_probe(abl)
+        n_full = sum(
+            bool(x) for x in jax.tree.leaves(
+                latent_clamp_mask(v_full["params"])
+            )
+        )
+        n_abl = sum(
+            bool(x) for x in jax.tree.leaves(
+                latent_clamp_mask(v_abl["params"])
+            )
+        )
+        # 2 blocks x 4 attention projections x (kernel, bias) = 16 fewer
+        assert n_full - n_abl == 16
+
+    def test_fp32_twin_rejected_by_freezer(self):
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        from distributed_mnist_bnns_tpu.infer_transformer import (
+            freeze_bnn_vit,
+        )
+        from distributed_mnist_bnns_tpu.models.transformer import (
+            bnn_vit_tiny,
+        )
+
+        for kw in ({"binarized": False}, {"binarized_attention": False}):
+            model = bnn_vit_tiny(**kw)
+            x = jnp.zeros((1, 28, 28, 1), jnp.float32)
+            v = model.init({"params": jax.random.PRNGKey(0)}, x)
+            with pytest.raises(ValueError, match="fully-binarized"):
+                freeze_bnn_vit(model, v)
