@@ -130,23 +130,27 @@ _MERGE_KEYS = (
 )
 
 
-def _keep_best_bench(stdout: str) -> None:
+def _keep_best_bench(stdout: str):
     """Merge a bench record into BENCH_LOCAL_r05.json (bench.py's
     dead-endpoint path globs the latest BENCH_LOCAL_r*.json).
 
     The headline block is replaced only by a better headline; section
     blocks (serving, lm_flash, crossover, ...) are adopted whenever the
     new record has a non-failed value for them, so the three bench tiers
-    accumulate into one complete record across short windows."""
+    accumulate into one complete record across short windows.
+
+    Returns the parsed record (even when nothing merged) so the caller
+    can decide whether the tier actually banked what it exists for —
+    bench.py exits 0 for dead-endpoint/unmeasurable records too."""
     lines = [ln for ln in stdout.strip().splitlines() if ln.startswith("{")]
     if not lines:
-        return
+        return None
     try:
         rec = json.loads(lines[-1])
     except json.JSONDecodeError:
-        return
+        return None
     if rec.get("value") is None:
-        return
+        return rec
     target = os.path.join(REPO, "BENCH_LOCAL_r05.json")
     try:
         with open(target) as f:
@@ -165,15 +169,16 @@ def _keep_best_bench(stdout: str) -> None:
                 merged[k] = v
     for k in _MERGE_KEYS:
         v = rec.get(k)
-        good = v is not None and not (
-            isinstance(v, str) and v.startswith("failed"))
-        if good and not (isinstance(v, str) and v.startswith("skipped")):
+        if v is not None and not (
+                isinstance(v, str)
+                and (v.startswith("failed") or v.startswith("skipped"))):
             merged[k] = v
     with open(target, "w") as f:
         json.dump(merged, f)
         f.write("\n")
     log(f"BENCH_LOCAL_r05.json merged: headline={merged.get('value')} "
         f"sections={[k for k in _MERGE_KEYS if k in merged]}")
+    return rec
 
 
 def run_agenda() -> bool:
@@ -197,7 +202,21 @@ def run_agenda() -> bool:
         # death in between must not mark the step done with its
         # measurement unbanked
         if name.startswith("bench_") and res["rc"] == 0:
-            _keep_best_bench(stdout)
+            rec = _keep_best_bench(stdout)
+            # bench.py exits 0 even for dead-endpoint (value: null)
+            # records, and a slow-tunnel headline can eat the budget
+            # before the serving section runs — in either case the tier
+            # has not banked what it exists for, so keep it retryable
+            # instead of retiring it on rc alone.
+            if rec is None or rec.get("value") is None:
+                res["rc"] = -2
+                res["tail"] = ("no hardware headline banked; kept "
+                               "retryable. " + res["tail"])[-2000:]
+            elif name == "bench_serving" and "serving" not in rec:
+                res["rc"] = -3
+                res["tail"] = ("headline ok but serving section never "
+                               "ran (budget); kept retryable. "
+                               + res["tail"])[-2000:]
         st[name] = res
         _save_status(st)
         log(f"step {name}: rc={res['rc']} in {res['s']}s")
