@@ -72,33 +72,62 @@ def _train_size_sweep(
 
 
 def run(models, epochs, batch_size, lr, seeds, out_path, scan_steps=1,
-        device_data=False, sweep_sizes=None):
+        device_data=False, sweep_sizes=None, cache_path=None):
     if epochs < 1:
         raise ValueError(f"epochs must be >= 1, got {epochs}")
+    import os
+
     import jax
 
     from ..data import load_mnist
     from ..train import TrainConfig, Trainer
+
+    # Per-(model, seed) fit cache: a multi-model multi-seed report is
+    # 6+ full training runs, and the TPU tunnel's live windows can be
+    # shorter than that — with a cache_path each completed fit persists
+    # immediately, so a window that dies mid-report resumes at the next
+    # un-fit (model, seed) pair instead of from scratch.
+    cache: dict = {}
+    if cache_path and os.path.exists(cache_path):
+        with open(cache_path) as f:
+            cache = json.load(f)
+
+    def _fit_cached(model, seed):
+        # platform is part of the key: the report stamps its numbers
+        # with the live device, so a CPU-cached fit must never be
+        # republished as a TPU measurement (epoch_times_s especially)
+        key = (f"{model}|{seed}|{epochs}|{batch_size}|{lr}|{scan_steps}"
+               f"|{device_data}|{jax.default_backend()}")
+        if key in cache:
+            return cache[key]
+        trainer = Trainer(
+            TrainConfig(
+                model=model,
+                epochs=epochs,
+                batch_size=batch_size,
+                optimizer="adam",
+                learning_rate=lr,
+                seed=seed,
+                log_interval=1000,
+                scan_steps=scan_steps,
+                device_data=device_data,
+            )
+        )
+        history = trainer.fit(data)
+        if cache_path:
+            cache[key] = json.loads(json.dumps(history, default=float))
+            tmp = cache_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(cache, f)
+            os.replace(tmp, cache_path)
+        return history
 
     data = load_mnist()
     rows = []
     for model in models:
         per_seed = []
         for seed in seeds:
-            trainer = Trainer(
-                TrainConfig(
-                    model=model,
-                    epochs=epochs,
-                    batch_size=batch_size,
-                    optimizer="adam",
-                    learning_rate=lr,
-                    seed=seed,
-                    log_interval=1000,
-                    scan_steps=scan_steps,
-                    device_data=device_data,
-                )
-            )
-            per_seed.append(trainer.fit(data))
+            per_seed.append(_fit_cached(model, seed))
         # Accuracy on the available 1000-example test split moves ~0.1%
         # per example; a single seed is inside that noise, so the
         # headline figure is the mean over seeds (per-seed values kept).

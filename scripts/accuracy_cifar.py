@@ -43,6 +43,7 @@ def main() -> None:
         ["xnor-resnet18", "fp32-resnet18"],
         epochs=args.epochs, batch_size=64, lr=0.01,
         seeds=args.seeds, out_path=args.out, scan_steps=4,
+        cache_path=args.out + ".cache.json",
     )
 
 

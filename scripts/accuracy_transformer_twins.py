@@ -44,6 +44,7 @@ def main() -> None:
         ["bnn-vit-tiny", "fp32-vit-tiny"],
         epochs=args.epochs, batch_size=64, lr=0.003,
         seeds=args.seeds, out_path=args.out, scan_steps=4,
+        cache_path=args.out + ".cache.json",
     )
     if args.lm_steps > 0:
         subprocess.run(

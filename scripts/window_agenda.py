@@ -204,19 +204,25 @@ def run_agenda() -> bool:
         if name.startswith("bench_") and res["rc"] == 0:
             rec = _keep_best_bench(stdout)
             # bench.py exits 0 even for dead-endpoint (value: null)
-            # records, and a slow-tunnel headline can eat the budget
-            # before the serving section runs — in either case the tier
-            # has not banked what it exists for, so keep it retryable
-            # instead of retiring it on rc alone.
+            # records, for sections skipped on budget (key absent), and
+            # for sections that raised (key = "failed: ..." string) —
+            # in all of those the tier has not banked what it exists
+            # for, so keep it retryable instead of retiring on rc alone.
+            required = {"bench_serving": ("serving",),
+                        "bench_full": ("serving", "lm_flash")}
+            missing = [
+                k for k in required.get(name, ())
+                if not isinstance((rec or {}).get(k), dict)
+            ]
             if rec is None or rec.get("value") is None:
                 res["rc"] = -2
                 res["tail"] = ("no hardware headline banked; kept "
-                               "retryable. " + res["tail"])[-2000:]
-            elif name == "bench_serving" and "serving" not in rec:
+                               "retryable. " + res["tail"])[:2000]
+            elif missing:
                 res["rc"] = -3
-                res["tail"] = ("headline ok but serving section never "
-                               "ran (budget); kept retryable. "
-                               + res["tail"])[-2000:]
+                res["tail"] = (f"headline ok but section(s) {missing} "
+                               "not banked (budget or failure); kept "
+                               "retryable. " + res["tail"])[:2000]
         st[name] = res
         _save_status(st)
         log(f"step {name}: rc={res['rc']} in {res['s']}s")
