@@ -104,17 +104,84 @@ def _steps():
 
 
 def _run_step(name: str, argv: list, timeout_s: float) -> tuple:
-    """Returns (status_record, full_stdout)."""
+    """Run a step with a tunnel watchdog; returns (status_record,
+    full_stdout).
+
+    A dead remote-TPU tunnel hangs in-flight dispatches indefinitely
+    (the 2026-08-01 00:19 window close ate 59 min of a 60 min timeout
+    on one hung remote compile), so alongside the hard timeout the
+    watchdog probes the tunnel every ~4 min and kills the step after
+    3 consecutive dead probes (~12 min) — 3 because a single 70 s
+    probe can starve spuriously while the step itself keeps the tunnel
+    busy with large compiles."""
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     t0 = time.time()
-    stdout = ""
-    try:
-        p = subprocess.run(argv, cwd=REPO, capture_output=True, text=True,
-                           timeout=timeout_s, env=env)
-        rc, stdout = p.returncode, p.stdout
-        tail = (p.stdout + p.stderr)[-2000:]
-    except subprocess.TimeoutExpired:
-        rc, tail = -9, f"timed out after {timeout_s:.0f}s"
+    out_path = os.path.join(HERE, f".step_{name}.out")
+    err_path = os.path.join(HERE, f".step_{name}.err")
+    dead_probes = 0
+    killed_reason = None
+
+    def _out_bytes():
+        try:
+            return os.path.getsize(out_path) + os.path.getsize(err_path)
+        except OSError:
+            return 0
+
+    def _kill_group():
+        # steps spawn their own subprocesses (e.g. the twins script runs
+        # lm_corpus_eval.py) and the hang lives in whichever grandchild
+        # holds the in-flight dispatch — reap the whole session, not
+        # just the direct child
+        import signal
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            p.kill()
+        p.wait()
+
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        p = subprocess.Popen(argv, cwd=REPO, stdout=out_f, stderr=err_f,
+                             text=True, env=env, start_new_session=True)
+        next_probe = t0 + 240
+        last_out = _out_bytes()
+        while True:
+            try:
+                p.wait(timeout=10)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            now = time.time()
+            if now - t0 > timeout_s:
+                _kill_group()
+                killed_reason = f"timed out after {timeout_s:.0f}s"
+                break
+            if now >= next_probe:
+                # a probe can starve while the step saturates the
+                # tunnel, so a failed probe only counts as dead when
+                # the step's own output has ALSO stopped advancing —
+                # otherwise a healthy >12-min busy step would be
+                # livelocked by its own load
+                cur_out = _out_bytes()
+                progressing = cur_out > last_out
+                last_out = cur_out
+                alive = bench._device_responsive(70.0) or progressing
+                dead_probes = 0 if alive else dead_probes + 1
+                log(f"step {name}: watchdog probe "
+                    f"{'alive' if alive else f'dead x{dead_probes}'}"
+                    f"{' (output advancing)' if progressing else ''}")
+                if dead_probes >= 3:
+                    _kill_group()
+                    killed_reason = (
+                        "killed by watchdog: tunnel dead on 3 "
+                        "consecutive probes with no step output")
+                    break
+                next_probe = now + 240
+    stdout = open(out_path).read()
+    stderr = open(err_path).read()
+    if killed_reason is not None:
+        rc, tail = -9, (killed_reason + ". " + (stdout + stderr)[-1500:])
+    else:
+        rc, tail = p.returncode, (stdout + stderr)[-2000:]
     return ({"rc": rc, "s": round(time.time() - t0, 1),
              "tail": tail, "ts": bench._utc_now()}, stdout)
 
