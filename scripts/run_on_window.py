@@ -2,7 +2,7 @@
 then run the full on-chip certification — `pytest tests_tpu` and the
 bench harness — and keep the better headline record in
 BENCH_LOCAL_r04.json (bench.py's unreachable-endpoint path embeds that
-file as `last_hardware_measurement`, so catching even one live window
+file as `best_hardware_measurement`, so catching even one live window
 preserves the round's hardware evidence). Keeps retrying until a
 certification actually lands a record or the budget runs out.
 
