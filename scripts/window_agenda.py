@@ -234,11 +234,25 @@ def _keep_best_bench(stdout: str):
         for k, v in rec.items():
             if k not in _MERGE_KEYS:
                 merged[k] = v
+    def _real(v):
+        return v is not None and not (
+            isinstance(v, str)
+            and (v.startswith("failed") or v.startswith("skipped")))
+
     for k in _MERGE_KEYS:
         v = rec.get(k)
-        if v is not None and not (
-                isinstance(v, str)
-                and (v.startswith("failed") or v.startswith("skipped"))):
+        if not _real(v):
+            continue
+        old = merged.get(k)
+        if isinstance(v, dict) and isinstance(old, dict):
+            # sub-key-aware: a later run whose sub-block was skipped on
+            # budget (e.g. serving.lm_kv_decode) must not clobber an
+            # earlier banked one
+            merged[k] = {
+                **{sk: sv for sk, sv in old.items() if _real(sv)},
+                **{sk: sv for sk, sv in v.items() if _real(sv)},
+            }
+        else:
             merged[k] = v
     with open(target, "w") as f:
         json.dump(merged, f)
