@@ -25,6 +25,12 @@ from .pipeline import (
     sequential_reference,
     sequential_reference_rng,
 )
+from .tp_pipeline import (
+    init_tp_pipeline_params,
+    make_tp_pipeline_fn,
+    tp_pipeline_param_specs,
+    tp_pipeline_reference,
+)
 from .pipeline_model import (
     make_pipelined_apply,
     pipelined_state_shardings,
@@ -63,6 +69,10 @@ __all__ = [
     "make_ring_attention",
     "make_pipeline_fn",
     "pipeline_bubble_fraction",
+    "init_tp_pipeline_params",
+    "make_tp_pipeline_fn",
+    "tp_pipeline_param_specs",
+    "tp_pipeline_reference",
     "sequential_reference",
     "sequential_reference_rng",
     "make_pipelined_apply",

@@ -33,6 +33,7 @@ def make_pipeline_fn(
     batch_axis: str | None = None,
     stage_takes_rng: bool = False,
     stage_remat: bool = False,
+    param_specs=None,
 ):
     """Build f(stage_params, x[, rng]) -> y running the stage chain as a
     pipeline.
@@ -63,6 +64,15 @@ def make_pipeline_fn(
     DP x PP the ``batch_axis`` row index is folded in first, so each
     data replica draws independent masks for its batch shard (the same
     decorrelation the step body's grad-accum fold_in enforces).
+
+    ``param_specs``: optional pytree of PartitionSpecs (matching the
+    stage_params structure) for leaves that are sharded over MORE than
+    the stage axis — e.g. Megatron-TP stage weights also sharded over a
+    'model' mesh axis (see tp_pipeline.py). Every spec's dim 0 must
+    still be ``axis`` (the stage dim); defaults to ``P(axis)`` on every
+    leaf. The stage_fn is then responsible for the model-axis
+    collectives (psum of row-parallel partials) — inside shard_map the
+    axis name is in scope.
 
     ``stage_remat``: wrap each stage execution in ``jax.checkpoint`` so
     reverse-mode AD stores only the stage's *input* per tick and
@@ -133,11 +143,25 @@ def make_pipeline_fn(
         )
         return outputs.reshape(b, *x.shape[1:])
 
+    if param_specs is not None:
+        for spec in jax.tree.leaves(
+                param_specs, is_leaf=lambda s: isinstance(s, P)):
+            if not spec or spec[0] != axis:
+                # local_fn squeezes dim 0 as the per-stage slice; any
+                # other leading placement silently runs stage-0 weights
+                # on every device
+                raise ValueError(
+                    f"param_specs leaf {spec} must have the stage axis "
+                    f"{axis!r} at dim 0"
+                )
     x_spec = P(batch_axis) if batch_axis else P()
     fn = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(axis), x_spec, P()),
+        in_specs=(
+            param_specs if param_specs is not None else P(axis),
+            x_spec, P(),
+        ),
         out_specs=x_spec,
         check_vma=False,
     )

@@ -1,0 +1,125 @@
+"""Three-axis DP x TP x PP composition: Megatron tensor parallelism
+INSIDE each pipeline stage, data parallelism across replica rows.
+
+The reference's parallelism never composed (its model-parallel demo was
+a bare two-device layer split, mnist-distributed-BNNS2.py:193-213, and
+its DP was DDP, mnist-dist2.py:93); this module is the TPU-native
+composition of all three axes on one mesh, in the scaling-book style:
+pick a ``(data, model, pipe)`` mesh, annotate shardings, let the
+collectives ride ICI.
+
+Each pipeline stage is a binarized two-matmul MLP block in the
+column->row Megatron layout over ``model_axis``:
+
+    h   = hardtanh(x @ sign(W1_col) + b1_col)     # local: no collective
+    y   = psum(h @ sign(W2_row), model_axis) + b2 # one all-reduce/stage
+
+W1 is column-parallel (each model-shard holds hidden/tp columns), W2
+row-parallel (hidden/tp rows), so the ONLY model-axis collective is the
+single psum of the row-parallel partials — the canonical Megatron
+schedule. Weights are binarized via ``ops.binarize`` (STE custom_vjp),
+so the composed program differentiates end-to-end like every other
+layer in the framework. The stage chain runs through the GPipe ring of
+``make_pipeline_fn`` (microbatches ppermute'd over ``pipe`` within
+each (data, model) slice), and the batch dim is sharded over ``data``
+(stage/TP weights replicated across rows, gradient all-reduce falling
+out of the loss mean under jit/GSPMD — same contract as DP x PP).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.binarize import binarize
+
+
+def init_tp_pipeline_params(
+    key: jax.Array, n_stages: int, d_model: int, d_hidden: int
+) -> dict:
+    """Stage-major (dim 0 = stage) params for the TP-MLP stage chain.
+
+    Full (unsharded) shapes — sharding happens at dispatch via
+    ``tp_pipeline_param_specs``: w1 (S, d, h) col-parallel on h,
+    b1 (S, h), w2 (S, h, d) row-parallel on h, b2 (S, d) replicated.
+    """
+    k1, k2 = jax.random.split(key)
+    s = 1.0 / jnp.sqrt(d_model)
+    return {
+        "w1": jax.random.uniform(
+            k1, (n_stages, d_model, d_hidden), minval=-s, maxval=s
+        ),
+        "b1": jnp.zeros((n_stages, d_hidden)),
+        "w2": jax.random.uniform(
+            k2, (n_stages, d_hidden, d_model), minval=-s, maxval=s
+        ),
+        "b2": jnp.zeros((n_stages, d_model)),
+    }
+
+
+def tp_pipeline_param_specs(
+    axis: str = "pipe", model_axis: str = "model"
+) -> dict:
+    """Per-leaf shardings: dim 0 = pipeline stage, hidden dim = TP."""
+    return {
+        "w1": P(axis, None, model_axis),   # column-parallel
+        "b1": P(axis, model_axis),
+        "w2": P(axis, model_axis, None),   # row-parallel
+        "b2": P(axis, None),               # replicated over model
+    }
+
+
+def make_tp_pipeline_fn(
+    mesh: Mesh,
+    *,
+    axis: str = "pipe",
+    model_axis: str = "model",
+    batch_axis: str | None = "data",
+    n_micro: int = 0,
+    stage_remat: bool = False,
+):
+    """f(stage_params, x) -> y: the stage chain pipelined over ``axis``
+    with Megatron TP over ``model_axis`` inside every stage and the
+    batch sharded over ``batch_axis``. ``stage_params`` leaves are the
+    FULL shapes of ``init_tp_pipeline_params``; shard_map slices them
+    per ``tp_pipeline_param_specs``."""
+    from .pipeline import make_pipeline_fn
+
+    def stage_fn(params, x):
+        # local column-parallel matmul: params["w1"] is (d, h/tp) here
+        h = jnp.dot(x, binarize(params["w1"])) + params["b1"]
+        h = jax.nn.hard_tanh(h)
+        partial = jnp.dot(h, binarize(params["w2"]))
+        # the one model-axis collective of the Megatron schedule
+        return jax.lax.psum(partial, model_axis) + params["b2"]
+
+    return make_pipeline_fn(
+        mesh,
+        stage_fn,
+        axis=axis,
+        n_micro=n_micro or mesh.shape[axis],
+        batch_axis=batch_axis,
+        stage_remat=stage_remat,
+        param_specs=tp_pipeline_param_specs(axis, model_axis),
+    )
+
+
+def tp_pipeline_reference(stage_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Single-device dense oracle (same binarize, unsharded matmuls)."""
+    n_stages = stage_params["w1"].shape[0]
+    for s in range(n_stages):
+        h = jnp.dot(x, binarize(stage_params["w1"][s]))
+        h = jax.nn.hard_tanh(h + stage_params["b1"][s])
+        x = jnp.dot(h, binarize(stage_params["w2"][s])) + stage_params["b2"][s]
+    return x
+
+
+def latent_mask(stage_params: dict) -> dict:
+    """Clamp mask for the latent fp32 masters: binarized weight leaves
+    (w*) -> True, biases -> False. Derived from the params keys so a
+    new leaf fails loudly in clamp_latent's tree map rather than
+    silently drifting out of sync."""
+    return {k: k.startswith("w") for k in stage_params}

@@ -245,12 +245,14 @@ def _keep_best_bench(stdout: str):
             continue
         old = merged.get(k)
         if isinstance(v, dict) and isinstance(old, dict):
-            # sub-key-aware: a later run whose sub-block was skipped on
-            # budget (e.g. serving.lm_kv_decode) must not clobber an
-            # earlier banked one
+            # sub-key-aware: a later run whose sub-block was skipped or
+            # failed (e.g. serving.lm_kv_decode) must not clobber an
+            # earlier banked one; old markers survive until a real
+            # value replaces them (same retention as the non-dict path)
             merged[k] = {
-                **{sk: sv for sk, sv in old.items() if _real(sv)},
-                **{sk: sv for sk, sv in v.items() if _real(sv)},
+                **old,
+                **{sk: sv for sk, sv in v.items()
+                   if _real(sv) or sk not in old},
             }
         else:
             merged[k] = v

@@ -292,3 +292,88 @@ def test_regime_optimizer_switch_with_dp_pp():
     # stage-major placement survived the rebuild
     leaf = jax.tree.leaves(trainer.state.params["blocks"])[0]
     assert "pipe" in str(leaf.sharding.spec)
+
+
+class TestThreeAxis:
+    """DP x TP x PP on one (data, model, pipe) mesh — the 3-axis
+    composition VERDICT r4 item 2 asks the dryrun to exercise. Megatron
+    column->row TP inside each binarized pipeline stage (one psum per
+    stage), GPipe ring over pipe, batch sharded over data."""
+
+    def _mesh(self):
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        from jax.sharding import Mesh
+        return Mesh(
+            np.array(jax.devices()[:8]).reshape(2, 2, 2),
+            axis_names=("data", "model", "pipe"),
+        )
+
+    def test_forward_matches_dense_oracle(self):
+        import jax.numpy as jnp
+        from distributed_mnist_bnns_tpu.parallel.tp_pipeline import (
+            init_tp_pipeline_params,
+            make_tp_pipeline_fn,
+            tp_pipeline_reference,
+        )
+
+        mesh = self._mesh()
+        params = init_tp_pipeline_params(jax.random.PRNGKey(0), 2, 8, 16)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+        fn = make_tp_pipeline_fn(mesh, n_micro=2)
+        np.testing.assert_allclose(
+            np.asarray(fn(params, x)),
+            np.asarray(tp_pipeline_reference(params, x)),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_train_trajectory_matches_dense_oracle(self):
+        """Five SGD steps through the 3-axis program == the same steps
+        through the dense single-device oracle (STE grads, latent
+        clamp) — composition changes the schedule, not the math."""
+        import jax.numpy as jnp
+        import optax
+        from distributed_mnist_bnns_tpu.parallel.tp_pipeline import (
+            init_tp_pipeline_params,
+            latent_mask,
+            make_tp_pipeline_fn,
+            tp_pipeline_reference,
+        )
+        from distributed_mnist_bnns_tpu.train import clamp_latent
+
+        mesh = self._mesh()
+        params0 = init_tp_pipeline_params(jax.random.PRNGKey(0), 2, 8, 16)
+        fn = make_tp_pipeline_fn(mesh, n_micro=2)
+        mask = latent_mask(params0)
+        tx = optax.sgd(0.1)
+
+        def make_step(apply):
+            @jax.jit
+            def step(params, opt, x, y):
+                def loss_fn(p):
+                    return jnp.mean((apply(p, x) - y) ** 2)
+
+                loss, g = jax.value_and_grad(loss_fn)(params)
+                up, opt = tx.update(g, opt, params)
+                params = clamp_latent(optax.apply_updates(params, up), mask)
+                return params, opt, loss
+
+            return step
+
+        step_pp = make_step(fn)
+        step_ref = make_step(tp_pipeline_reference)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+        y = jax.random.normal(jax.random.PRNGKey(2), (8, 8))
+        p_pp, o_pp = params0, tx.init(params0)
+        p_rf, o_rf = params0, tx.init(params0)
+        for _ in range(5):
+            p_pp, o_pp, l_pp = step_pp(p_pp, o_pp, x, y)
+            p_rf, o_rf, l_rf = step_ref(p_rf, o_rf, x, y)
+            np.testing.assert_allclose(
+                float(l_pp), float(l_rf), atol=1e-5, rtol=1e-5
+            )
+        for k in p_pp:
+            np.testing.assert_allclose(
+                np.asarray(p_pp[k]), np.asarray(p_rf[k]),
+                atol=1e-5, rtol=1e-5,
+            )
