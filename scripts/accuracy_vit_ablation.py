@@ -1,0 +1,91 @@
+"""Partial-binarization ablation for the ViT family (VERDICT r4 item 5):
+where does the transformer binarization gap live?
+
+Three-point sweep under the identical recipe (Adam lr=0.003, batch 64,
+30 epochs, t10k 9k/1k split, 3 seeds):
+  - bnn-vit-tiny                      fully binarized (attention + MLP)
+  - bnn-vit-tiny + fp32 attention     binarized_attention=False: q/k/v/out
+                                      projections stay fp32, MLP binary
+  - fp32-vit-tiny                     the fp32 twin (denominator)
+
+The first and third already come from accuracy_transformer_twins
+(RESULTS_VIT.md); this script measures the middle point and emits one
+JSON line for RESULTS.md. Per-seed fits persist to the --out sidecar so
+a killed run resumes (same contract as accuracy_report's cache).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_mnist_bnns_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--seeds", type=int, nargs="+", default=[42, 43, 44])
+    p.add_argument("--out", default="vit_ablation.json")
+    args = p.parse_args()
+
+    import jax
+
+    from distributed_mnist_bnns_tpu.data import load_mnist
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    cache_path = args.out + ".cache.json"
+    cache = {}
+    if os.path.exists(cache_path):
+        with open(cache_path) as f:
+            cache = json.load(f)
+
+    data = load_mnist()
+    accs = []
+    for seed in args.seeds:
+        key = f"fp32attn|{seed}|{args.epochs}|{jax.default_backend()}"
+        if key not in cache:
+            trainer = Trainer(
+                TrainConfig(
+                    model="bnn-vit-tiny",
+                    model_kwargs={"binarized_attention": False},
+                    epochs=args.epochs, batch_size=64,
+                    optimizer="adam", learning_rate=0.003,
+                    seed=seed, log_interval=1000, scan_steps=4,
+                )
+            )
+            history = trainer.fit(data)
+            cache[key] = {
+                "test_acc": history[-1]["test_acc"],
+                "test_loss": history[-1]["test_loss"],
+            }
+            tmp = cache_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(cache, f)
+            os.replace(tmp, cache_path)
+        accs.append(cache[key]["test_acc"])
+
+    rec = {
+        "metric": "vit_partial_binarization_ablation",
+        "model": "bnn-vit-tiny + binarized_attention=False",
+        "epochs": args.epochs,
+        "seeds": args.seeds,
+        "test_acc_per_seed": [round(a, 2) for a in accs],
+        "test_acc_mean": round(sum(accs) / len(accs), 2),
+        "device": str(jax.devices()[0]),
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f)
+        f.write("\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
