@@ -58,6 +58,25 @@ def _freeze_dense(params: Dict, scale: bool) -> Dict[str, Any]:
     return out
 
 
+def _freeze_dense_fp32(params: Dict) -> Dict[str, Any]:
+    """One fp32 nn.Dense, carried as-is: the partial-binarization recipe
+    (RESULTS.md ablation — fp32 q/k/v/out, binary MLP) keeps attention
+    projections dense, so the artifact stores their fp32 kernels and the
+    serving graph runs plain matmuls for them. Marker: 'kernel' instead
+    of 'wp'."""
+    return {"kernel": params["kernel"], "bias": params["bias"]}
+
+
+def _dense_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
+    """Layer closure dispatch: packed 1-bit ('wp') or carried fp32
+    ('kernel' — partial binarization)."""
+    if "wp" in layer:
+        return _packed_dense_fn(layer, interpret)
+    kernel = jnp.asarray(layer["kernel"], jnp.float32)
+    bias = jnp.asarray(layer["bias"], jnp.float32)
+    return lambda x: jnp.dot(x, kernel) + bias
+
+
 def _packed_dense_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
     """sign(x) @ packed-W (+ alpha) + b over any leading shape."""
     wp = jnp.asarray(layer["wp"])
@@ -94,11 +113,10 @@ def _ln_fn(params: Dict) -> Callable:
 
 
 def _check_freezable(model) -> None:
-    if not model.binarized or model.binarized_attention is False:
+    if not model.binarized:
         raise ValueError(
-            "packed freezing covers fully-binarized models only; the "
-            "fp32 twins / partial-binarization ablations have no packed "
-            "weights to freeze (serve them as live models)"
+            "packed freezing needs binarized weights; the fp32 twins "
+            "have none to pack (serve them as live models)"
         )
     if model.stochastic:
         raise ValueError(
@@ -121,12 +139,23 @@ def _freeze_blocks(params: Dict, depth: int, scale: bool) -> list:
     for i in range(depth):
         bp = params[f"TransformerBlock_{i}"]
         attn = bp["BinarizedSelfAttention_0"]
+        if "Dense_0" in attn:
+            # binarized_attention=False: fp32 q/k/v/out (flax auto-names
+            # nn.Dense as Dense_0..3 in the same q,k,v,out order)
+            proj = [
+                _freeze_dense_fp32(attn[f"Dense_{j}"]) for j in range(4)
+            ]
+        else:
+            proj = [
+                _freeze_dense(attn[f"BinarizedDense_{j}"], scale)
+                for j in range(4)
+            ]
         blocks.append({
             "ln_attn": dict(bp["ln_attn"]),
-            "q": _freeze_dense(attn["BinarizedDense_0"], scale),
-            "k": _freeze_dense(attn["BinarizedDense_1"], scale),
-            "v": _freeze_dense(attn["BinarizedDense_2"], scale),
-            "out": _freeze_dense(attn["BinarizedDense_3"], scale),
+            "q": proj[0],
+            "k": proj[1],
+            "v": proj[2],
+            "out": proj[3],
             "ln_mlp": dict(bp["ln_mlp"]),
             "mlp1": _freeze_dense(bp["BinarizedDense_0"], scale),
             "mlp2": _freeze_dense(bp["BinarizedDense_1"], scale),
@@ -149,8 +178,10 @@ def _binarized_kernel_bytes(params: Dict) -> int:
 
 
 def _packed_bytes(frozen_blocks: list, embed_w=None) -> int:
+    """Artifact weight bytes: int32 bitplanes for packed layers, fp32
+    kernels for dense-carried ones (partial binarization)."""
     per_block = sum(
-        int(jnp.asarray(b[key]["wp"]).size) * 4
+        int(jnp.asarray(b[key].get("wp", b[key].get("kernel"))).size) * 4
         for b in frozen_blocks
         for key in ("q", "k", "v", "out", "mlp1", "mlp2")
     )
@@ -159,10 +190,25 @@ def _packed_bytes(frozen_blocks: list, embed_w=None) -> int:
     return per_block
 
 
-def _freeze_info(params: Dict, blocks: list, kind: str, depth: int,
+def _dense_carried_bytes(frozen_blocks: list) -> int:
+    """fp32 bytes of dense-carried (unpacked) block kernels — identical
+    in the live and frozen model, so added to BOTH sides of the
+    compression ratio."""
+    return sum(
+        int(jnp.asarray(b[key]["kernel"]).size) * 4
+        for b in frozen_blocks
+        for key in ("q", "k", "v", "out", "mlp1", "mlp2")
+        if "kernel" in b[key]
+    )
+
+
+def _freeze_info(params: Dict, blocks: list, kind: str,
                  embed_w=None) -> Dict[str, Any]:
     """The artifact's size-accounting dict, shared by both freezers."""
-    latent = _binarized_kernel_bytes(params)
+    # dense-carried fp32 kernels (partial binarization) weigh the same
+    # live and frozen; count them on both sides so `compression` stays
+    # the honest whole-model ratio
+    latent = _binarized_kernel_bytes(params) + _dense_carried_bytes(blocks)
     packed = _packed_bytes(blocks, embed_w)
     return {
         "family": "bnn-transformer",
@@ -172,8 +218,9 @@ def _freeze_info(params: Dict, blocks: list, kind: str, depth: int,
         "compression": round(latent / packed, 2),
         "packed_layers": [
             f"TransformerBlock_{i}.{k}"
-            for i in range(depth)
+            for i, b in enumerate(blocks)
             for k in ("q", "k", "v", "out", "mlp1", "mlp2")
+            if "wp" in b[k]
         ],
     }
 
@@ -202,7 +249,7 @@ def _freeze_vit_tensors(
         "head_w": params["head"]["kernel"],
         "head_b": params["head"]["bias"],
     }
-    frozen["info"] = _freeze_info(params, blocks, "vit", model.depth,
+    frozen["info"] = _freeze_info(params, blocks, "vit",
                                   embed_w=w_embed)
     return frozen
 
@@ -223,7 +270,7 @@ def _freeze_lm_tensors(model: BinarizedLM, variables: Dict) -> Dict[str, Any]:
         "head_w": params["head"]["kernel"],
         "head_b": params["head"]["bias"],
     }
-    frozen["info"] = _freeze_info(params, blocks, "lm", model.depth)
+    frozen["info"] = _freeze_info(params, blocks, "lm")
     return frozen
 
 
@@ -234,12 +281,12 @@ def _block_layers(blk: Dict[str, Any], interpret: bool) -> Dict[str, Callable]:
     return {
         "ln_attn": _ln_fn(blk["ln_attn"]),
         "ln_mlp": _ln_fn(blk["ln_mlp"]),
-        "q": _packed_dense_fn(blk["q"], interpret),
-        "k": _packed_dense_fn(blk["k"], interpret),
-        "v": _packed_dense_fn(blk["v"], interpret),
-        "out": _packed_dense_fn(blk["out"], interpret),
-        "mlp1": _packed_dense_fn(blk["mlp1"], interpret),
-        "mlp2": _packed_dense_fn(blk["mlp2"], interpret),
+        "q": _dense_fn(blk["q"], interpret),
+        "k": _dense_fn(blk["k"], interpret),
+        "v": _dense_fn(blk["v"], interpret),
+        "out": _dense_fn(blk["out"], interpret),
+        "mlp1": _dense_fn(blk["mlp1"], interpret),
+        "mlp2": _dense_fn(blk["mlp2"], interpret),
     }
 
 

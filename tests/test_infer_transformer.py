@@ -447,3 +447,82 @@ def test_frozen_vit_rejects_bad_resolution():
         fn(jnp.zeros((1, 30, 30, 1), jnp.float32))
     with pytest.raises(ValueError, match="patch tokens"):
         fn(jnp.zeros((1, 14, 14, 1), jnp.float32))  # 4 tokens, trained 16
+
+
+class TestPartialBinarizationServing:
+    """binarized_attention=False (the RESULTS.md ablation recipe: fp32
+    q/k/v/out, binary MLP) freezes and serves: attention kernels are
+    carried fp32 in the artifact, MLP stays packed 1-bit."""
+
+    def _setup(self):
+        model = bnn_vit_tiny(
+            attention="xla", backend="xla", binarized_attention=False
+        )
+        x = jax.random.normal(
+            jax.random.PRNGKey(3), (4, 28, 28, 1), jnp.float32
+        )
+        labels = jax.random.randint(jax.random.PRNGKey(4), (4,), 0, 10)
+
+        def loss(out):
+            return -jnp.take_along_axis(
+                out, labels[:, None], axis=-1
+            ).mean()
+
+        variables = trained_variables(
+            model, x, loss, init_rngs={"params": jax.random.PRNGKey(0)}
+        )
+        return model, variables, x
+
+    def test_frozen_matches_live_eval(self):
+        model, variables, x = self._setup()
+        live = model.apply(variables, x, train=False)
+        frozen_fn, info = freeze_bnn_vit(model, variables, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(frozen_fn(x)), np.asarray(live),
+            atol=1e-4, rtol=1e-4,
+        )
+        # only the MLP projections are packed now
+        assert all("mlp" in name.split(".")[-1]
+                   for name in info["packed_layers"])
+        assert info["packed_layers"]  # and there are some
+        # fp32-carried attention cuts the whole-model ratio below the
+        # fully-binarized artifact's, but the MLP packing still wins
+        assert 1 < info["compression"] < 32
+
+    def test_export_load_roundtrip(self, tmp_path):
+        model, variables, x = self._setup()
+        live = model.apply(variables, x, train=False)
+        path = str(tmp_path / "vit_partial.packed")
+        export_packed(model, variables, path)
+        fn, info = load_packed(path, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(fn(x)), np.asarray(live), atol=1e-4, rtol=1e-4
+        )
+
+    def test_partial_lm_decodes(self):
+        from distributed_mnist_bnns_tpu.infer_transformer import (
+            _freeze_lm_tensors,
+            make_lm_decoder,
+        )
+        from distributed_mnist_bnns_tpu.models.transformer import (
+            BinarizedLM,
+        )
+
+        model = BinarizedLM(
+            vocab=17, embed_dim=16, depth=2, num_heads=2, max_len=12,
+            attention="xla", backend="xla", binarized_attention=False,
+        )
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 17)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0)}, tokens, train=False
+        )
+        live = model.apply(variables, tokens, train=False)
+        frozen = _freeze_lm_tensors(model, variables)
+        init, step = make_lm_decoder(frozen, interpret=True)
+        caches = init(tokens.shape[0])
+        for t in range(tokens.shape[1]):
+            caches, lp = step(caches, tokens[:, t], t)
+        live_lp = jax.nn.log_softmax(live[:, -1, :])
+        np.testing.assert_allclose(
+            np.asarray(lp), np.asarray(live_lp), atol=1e-4, rtol=1e-4
+        )

@@ -376,9 +376,18 @@ class TestTwinsAndAblation:
             bnn_vit_tiny,
         )
 
-        for kw in ({"binarized": False}, {"binarized_attention": False}):
-            model = bnn_vit_tiny(**kw)
-            x = jnp.zeros((1, 28, 28, 1), jnp.float32)
-            v = model.init({"params": jax.random.PRNGKey(0)}, x)
-            with pytest.raises(ValueError, match="fully-binarized"):
-                freeze_bnn_vit(model, v)
+        # fully-fp32 twins have nothing to pack and are rejected;
+        # partial binarization (binarized_attention=False) freezes since
+        # round 5 (tests/test_infer_transformer.py::
+        # TestPartialBinarizationServing covers the served equivalence)
+        model = bnn_vit_tiny(binarized=False)
+        x = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        v = model.init({"params": jax.random.PRNGKey(0)}, x)
+        with pytest.raises(ValueError, match="binarized weights"):
+            freeze_bnn_vit(model, v)
+        partial = bnn_vit_tiny(
+            attention="xla", backend="xla", binarized_attention=False
+        )
+        vp = partial.init({"params": jax.random.PRNGKey(0)}, x)
+        _, info = freeze_bnn_vit(partial, vp, interpret=True)
+        assert all("mlp" in n.split(".")[-1] for n in info["packed_layers"])
