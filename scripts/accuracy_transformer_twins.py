@@ -50,7 +50,7 @@ def main() -> None:
         subprocess.run(
             [sys.executable, "scripts/lm_corpus_eval.py",
              "--embed-dim", "256", "--depth", "4", "--seq-len", "256",
-             "--steps", str(args.lm_steps), "--fp32-twin"],
+             "--steps", str(args.lm_steps), "--fp32-twin", "--partial"],
             cwd=REPO, check=True,
         )
 

@@ -95,6 +95,10 @@ def main() -> None:
                    help="min context per scored byte in eval windows "
                         "(>= 1; position i scores byte i+1, so context 1 "
                         "scores every window position)")
+    p.add_argument("--partial", action="store_true",
+                   help="also train the partial-binarization point "
+                        "(fp32 attention + binary MLP — the RESULTS.md "
+                        "ablation recipe, binarized_attention=False)")
     p.add_argument("--fp32-twin", action="store_true",
                    help="also train an fp32 twin (binarization-gap "
                         "denominator)")
@@ -124,11 +128,11 @@ def main() -> None:
     rng = np.random.RandomState(args.seed)
     t = args.seq_len
 
-    def train_lm(binarized: bool):
+    def train_lm(binarized: bool, binarized_attention=None):
         model = BinarizedLM(
             vocab=256, max_len=t, embed_dim=args.embed_dim,
             depth=args.depth, num_heads=args.num_heads, attention="xla",
-            binarized=binarized,
+            binarized=binarized, binarized_attention=binarized_attention,
         )
         variables = model.init(
             {"params": jax.random.PRNGKey(args.seed)},
@@ -211,6 +215,10 @@ def main() -> None:
         },
         "bnn_lm": train_lm(True),
     }
+    if args.partial:
+        result["partial_lm_fp32_attn"] = train_lm(
+            True, binarized_attention=False
+        )
     if args.fp32_twin:
         result["fp32_lm"] = train_lm(False)
         result["binarization_gap_bits_per_byte"] = round(
