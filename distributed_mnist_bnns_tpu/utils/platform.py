@@ -48,6 +48,37 @@ def pin_platform_from_env() -> None:
         )
 
 
+def enable_persistent_compilation_cache(path: str | None = None) -> str:
+    """Point jax's persistent compilation cache at ``path`` (default:
+    ``JAX_COMPILATION_CACHE_DIR``, else ``<repo-root>/.jax_cache``
+    derived from this package's location, so every entry point shares
+    one cache with a no-arg call).
+
+    On the remote-tunneled TPU endpoint a cold compile of the flagship
+    train step can consume most of a short hardware-availability window
+    (the 2026-08-01 08:31 window died mid-compile with nothing banked),
+    so compiled executables are persisted across processes and windows.
+    Safe everywhere: when a backend cannot serialize executables the
+    cache degrades to a warning, and CPU test runs simply get faster
+    re-runs. Returns the directory in use."""
+    # Env var wins over the caller's default so an operator-exported
+    # cache location is honored by every entry point uniformly.
+    cache_dir = (
+        os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or path
+        or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    )
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    return cache_dir
+
+
 def pin_platform(
     platform: str, virtual_device_count: int | None = None
 ) -> bool:

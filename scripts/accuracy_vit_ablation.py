@@ -22,9 +22,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distributed_mnist_bnns_tpu.utils.platform import pin_platform_from_env
+from distributed_mnist_bnns_tpu.utils.platform import (
+    enable_persistent_compilation_cache,
+    pin_platform_from_env,
+)
 
 pin_platform_from_env()
+# Persist compiled executables across processes/windows (shared
+# repo-root cache; a cold remote compile can eat a short TPU window).
+enable_persistent_compilation_cache()
 
 
 def main() -> None:

@@ -38,6 +38,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402  (repo-root harness: _measure, _mfu helpers)
 
+# Persist compiled executables across processes/windows (shared
+# repo-root cache; a cold remote compile can eat a short TPU window).
+from distributed_mnist_bnns_tpu.utils.platform import (  # noqa: E402
+    enable_persistent_compilation_cache,
+)
+
+enable_persistent_compilation_cache()
+
 
 def main() -> None:
     p = argparse.ArgumentParser()

@@ -26,6 +26,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
+
+# Persist compiled executables across processes/windows (shared
+# repo-root cache; a cold remote compile can eat a short TPU window).
+from distributed_mnist_bnns_tpu.utils.platform import (  # noqa: E402
+    enable_persistent_compilation_cache,
+)
+
+enable_persistent_compilation_cache()
 from bench import _conv_macs_per_image  # noqa: E402
 
 

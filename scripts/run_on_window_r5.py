@@ -40,7 +40,10 @@ def main() -> None:
             if run_agenda():
                 log("full agenda complete; exiting")
                 return
-        time.sleep(480)
+        # Window #1 (2026-08-01) lasted ~12 min; a 480 s probe gap can
+        # eat most of such a window, and a dead-endpoint probe already
+        # burns its 70 s timeout, so the idle duty cycle stays low.
+        time.sleep(150)
     log("budget exhausted; agenda incomplete (see window_r05_status.json)")
 
 

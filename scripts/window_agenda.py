@@ -115,6 +115,13 @@ def _run_step(name: str, argv: list, timeout_s: float) -> tuple:
     probe can starve spuriously while the step itself keeps the tunnel
     busy with large compiles."""
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # Share one persistent compilation cache across every agenda step and
+    # window, so a step killed mid-compile (08:31 window: bench_headline
+    # died to the tunnel with nothing banked) resumes from warm
+    # executables next window instead of paying the cold remote compile
+    # again. jax reads this env var as the cache-dir default.
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
     t0 = time.time()
     out_path = os.path.join(HERE, f".step_{name}.out")
     err_path = os.path.join(HERE, f".step_{name}.err")
