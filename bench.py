@@ -280,6 +280,22 @@ def _utc_now(epoch_s: float | None = None) -> str:
     )
 
 
+_PROGRESS_T0 = time.monotonic()
+_PROGRESS_ON = False
+
+
+def _progress(msg: str) -> None:
+    """Stage marker on stderr (``--verbose``): the window watchdog sees
+    output advance between sections, and a killed run's captured stderr
+    names the stage it died in (the 08:31 window post-mortem had only a
+    probe line to go on)."""
+    if _PROGRESS_ON:
+        print(
+            f"[bench +{time.monotonic() - _PROGRESS_T0:.0f}s] {msg}",
+            file=sys.stderr, flush=True,
+        )
+
+
 def _device_responsive(timeout_s: float) -> bool:
     """Probe the default jax backend in a CHILD process with a hard
     timeout. A degraded remote-TPU tunnel hangs dispatches indefinitely
@@ -974,6 +990,8 @@ def main() -> None:
                         "backoff before declaring the endpoint dead "
                         "(sleeps 30s doubling to 480s between attempts)")
     args = p.parse_args()
+    global _PROGRESS_ON
+    _PROGRESS_ON = args.verbose
 
     probe_log = None
     if args.probe_timeout > 0:
@@ -1048,6 +1066,10 @@ def main() -> None:
             print(json.dumps(result))
             return
     deadline = time.monotonic() + args.budget_s
+    _progress(
+        f"headline: model={args.model} backend={args.backend} "
+        f"batch={args.batch_size} scan={args.scan_steps} "
+        "(first compile may take minutes on a remote backend)")
 
     import jax
     import jax.numpy as jnp
@@ -1129,6 +1151,8 @@ def main() -> None:
         if dispatch_dt is not None:
             per_step_dispatch_ms = round(dispatch_dt * 1e3, 3)
     ips = args.batch_size / step_time
+    _progress(f"headline measured: {ips:.0f} img/s "
+              f"(scan_steps={scan_used})")
     # The baseline only describes the flagship model (BASELINE.md covers
     # mnist-dist2.py's bnn-mlp-large); any other model has no reference
     # number to compare against.
@@ -1193,6 +1217,7 @@ def main() -> None:
     # minutes on a remote-compile backend and cannot be interrupted, so
     # the budget is best-effort once a compile is in flight.
     if args.stretch and time.monotonic() < deadline - 240:
+        _progress("stretch: xnor-resnet18 CIFAR-shape (bf16)")
         # BASELINE.json stretch config: XNOR-ResNet-18 at CIFAR shape on
         # the measured-fastest backend (bf16 MXU — round 5; PERF.md shows
         # pallas_xnor loses training shapes to bf16 by ~2x), with conv
@@ -1255,6 +1280,7 @@ def main() -> None:
         and time.monotonic() < deadline - 60
     ):
         try:
+            _progress("device_resident_epoch: one-dispatch epoch")
             result["device_resident_epoch"] = _bench_device_epoch(
                 args, deadline
             )
@@ -1263,12 +1289,14 @@ def main() -> None:
 
     if args.lm_bench and time.monotonic() < deadline - 60:
         try:
+            _progress("lm_flash: causal-LM flash train step")
             result["lm_flash"] = _bench_lm(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["lm_flash"] = f"failed: {e!r:.300}"
 
     if args.serving_bench and time.monotonic() < deadline - 60:
         try:
+            _progress("serving: frozen-model end-to-end section")
             result["serving"] = _bench_serving(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["serving"] = f"failed: {e!r:.300}"
@@ -1303,9 +1331,11 @@ def main() -> None:
         if time.monotonic() > deadline:
             result["crossover"] = "skipped (bench deadline; see PERF.md)"
         else:
+            _progress("crossover: GEMM-level backend sweep")
             result["crossover"] = _gemm_crossover(
                 jax, jnp, deadline, args.reps
             )
+    _progress("sections complete; emitting record")
     print(json.dumps(result))
 
 

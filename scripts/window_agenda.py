@@ -69,17 +69,17 @@ def _steps():
         # died and banked nothing in 59 min. Tier timeouts are tight for
         # the same reason — a dead-tunnel hang must not eat the catcher.
         ("bench_headline",
-         [py, "bench.py", "--no-crossover", "--no-stretch",
+         [py, "bench.py", "--verbose", "--no-crossover", "--no-stretch",
           "--no-epoch-bench", "--budget-s", "240",
           "--probe-budget-s", "90"],
          1200, os.path.join(REPO, "bench.py")),
         ("bench_serving",
-         [py, "bench.py", "--serving-bench", "--no-crossover",
+         [py, "bench.py", "--verbose", "--serving-bench", "--no-crossover",
           "--no-stretch", "--no-epoch-bench", "--budget-s", "600",
           "--probe-budget-s", "90"],
          1500, os.path.join(REPO, "bench.py")),
         ("bench_full",
-         [py, "bench.py", "--lm-bench", "--serving-bench",
+         [py, "bench.py", "--verbose", "--lm-bench", "--serving-bench",
           "--budget-s", "900", "--probe-budget-s", "90"],
          2700, os.path.join(REPO, "bench.py")),
         ("stretch_bf16",
