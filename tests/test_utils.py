@@ -111,3 +111,47 @@ def test_trainer_profile_dir_writes_trace(tmp_path):
     import glob
 
     assert glob.glob(str(tmp_path / "tb" / "**" / "*"), recursive=True)
+
+
+def test_persistent_compilation_cache_env_wins(tmp_path, monkeypatch):
+    """Operator-exported JAX_COMPILATION_CACHE_DIR beats the caller's
+    path so every entry point shares the operator's cache."""
+    import jax
+
+    from distributed_mnist_bnns_tpu.utils.platform import (
+        enable_persistent_compilation_cache,
+    )
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
+        got = enable_persistent_compilation_cache("/ignored/by/env")
+        assert got == str(tmp_path)
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_persistent_compilation_cache_repo_root_default(monkeypatch):
+    """No env, no arg: the default derives the repo root from the
+    package location (one shared .jax_cache regardless of cwd)."""
+    import jax
+
+    import distributed_mnist_bnns_tpu
+    from distributed_mnist_bnns_tpu.utils.platform import (
+        enable_persistent_compilation_cache,
+    )
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        got = enable_persistent_compilation_cache()
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(
+                distributed_mnist_bnns_tpu.__file__))
+        )
+        assert got == os.path.join(repo_root, ".jax_cache")
+        # helper exports the choice so subprocesses inherit it
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == got
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
