@@ -1059,6 +1059,35 @@ def main() -> None:
                             "not newest; source file holds the full "
                             "record)",
                 }
+                # Sections (serving, lm_flash, ...) may have been banked
+                # by a different window than the best headline — e.g. a
+                # round-5 serving-only window with a weaker tunnel. Carry
+                # each section from the newest saved record that has it,
+                # so best-by-value headline selection cannot shadow
+                # banked section evidence.
+                sections = {}
+                for local in sorted(
+                        glob.glob(os.path.join(
+                            here, "BENCH_LOCAL_r*.json"))):
+                    try:
+                        with open(local) as f:
+                            rec2 = json.load(f)
+                    except Exception:
+                        continue
+                    if rec2.get("metric") != result["metric"]:
+                        continue
+                    for k in ("serving", "lm_flash",
+                              "stretch_xnor_resnet18_cifar",
+                              "device_resident_epoch", "crossover"):
+                        if isinstance(rec2.get(k), dict):
+                            sections[k] = {
+                                "source": os.path.basename(local),
+                                "captured_at": rec2.get("ts"),
+                                **rec2[k],
+                            }
+                if sections:
+                    result["best_hardware_measurement"][
+                        "sections"] = sections
             try:
                 result["cpu_fallback"] = _cpu_fallback_extras(args)
             except Exception as e:
