@@ -88,6 +88,16 @@ def _steps():
         ("int8_headline",
          [py, "scripts/bench_int8.py"],
          1800, os.path.join(HERE, "bench_int8.py")),
+        # VERDICT r4 item 4's decision half: measure the flagship
+        # headline on the int8 MXU pipeline. _keep_best_bench merges
+        # best-by-value, so the banked headline (and its precision-
+        # matched MFU) switches to int8 exactly when int8 actually wins
+        # end-to-end.
+        ("bench_headline_int8",
+         [py, "bench.py", "--verbose", "--backend", "int8",
+          "--no-crossover", "--no-stretch", "--no-epoch-bench",
+          "--budget-s", "240", "--probe-budget-s", "90"],
+         1200, os.path.join(REPO, "bench.py")),
         ("device_resident_profile",
          [py, "scripts/profile_device_epoch.py"],
          1800, os.path.join(HERE, "profile_device_epoch.py")),
