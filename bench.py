@@ -280,6 +280,52 @@ def _utc_now(epoch_s: float | None = None) -> str:
     )
 
 
+# Sections a bench record can contribute independently of its headline
+# number. THE single definition — the dead-endpoint carry-over below and
+# scripts/window_agenda.py's merge both import this tuple, so the two
+# whitelists can no longer drift (a banked train_step_per_backend section
+# was silently dropped when they did).
+SECTION_MERGE_KEYS = (
+    "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
+    "device_resident_epoch", "train_step_per_backend",
+)
+
+
+def _emit_events(path: str | None, result: dict,
+                 model: str | None = None) -> None:
+    """Mirror the bench record into the telemetry event schema
+    (obs/events.py): a run manifest, one ``step`` event derived from the
+    headline measurement (so `cli telemetry` reports bench latency with
+    the same fields as a training run), and the full record as a
+    ``bench`` event. Best-effort — an emission failure must never cost
+    the bench its JSON line."""
+    if not path:
+        return
+    try:
+        from distributed_mnist_bnns_tpu.obs import EventLog
+
+        with EventLog(path) as ev:
+            ev.manifest(config={
+                "tool": "bench.py", "metric": result.get("metric"),
+                "model": model,
+                "backend": result.get("backend"),
+                "batch_size": result.get("batch_size"),
+            })
+            step_ms = result.get("step_time_ms")
+            if isinstance(step_ms, (int, float)) and step_ms > 0:
+                ev.emit(
+                    "step",
+                    latency_s=step_ms / 1e3,
+                    examples_per_sec=result.get("value"),
+                    mfu=result.get("mfu"),
+                    batch_size=result.get("batch_size"),
+                    n_steps=1,
+                )
+            ev.emit("bench", **result)
+    except Exception as e:
+        print(f"bench events emission failed: {e!r}", file=sys.stderr)
+
+
 _PROGRESS_T0 = time.monotonic()
 _PROGRESS_ON = False
 
@@ -365,67 +411,16 @@ def _probe_device_retry(attempt_timeout_s: float, budget_s: float):
         sleep = min(sleep * 2.0, 480.0)
 
 
-# Per-chip bf16 peak (dense MXU FLOPs/s) by device_kind substring, most
-# specific first. Sources: public TPU spec sheets (v5e 197 TF, v5p 459 TF,
-# v4 275 TF, v6e 918 TF, v3 123 TF, v2 45 TF bf16 per chip).
-_PEAKS_BF16 = (
-    ("v5 lite", 197e12),
-    ("v5litepod", 197e12),
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v6", 918e12),
-    ("trillium", 918e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+# Chip-peak / MAC / MFU accounting lives in the telemetry subsystem
+# (distributed_mnist_bnns_tpu/obs/flops.py — single source shared with
+# the trainer's step-level telemetry); these thin aliases keep bench.py's
+# long-standing helper names working for the scripts/ harnesses.
+from distributed_mnist_bnns_tpu.obs.flops import (  # noqa: E402
+    chip_peak as _chip_peak,
+    chip_peak_bf16 as _chip_peak_bf16,
+    dense_macs_per_example as _dense_macs_per_image,
+    mfu as _mfu,
 )
-
-
-def _chip_peak_bf16(device) -> float | None:
-    kind = (getattr(device, "device_kind", "") or str(device)).lower()
-    for sub, peak in _PEAKS_BF16:
-        if sub in kind:
-            return peak
-    return None
-
-
-# int8 MXU peak relative to bf16: 2x on v5e/v5p/v6 (the generations with
-# a doubled int8 pipeline), 1x on v4 and earlier.
-_INT8_MULT = (
-    ("v5", 2.0), ("v6", 2.0), ("trillium", 2.0),
-    ("v4", 1.0), ("v3", 1.0), ("v2", 1.0),
-)
-
-
-def _chip_peak(device, backend: str) -> tuple[float | None, str]:
-    """Precision-matched MXU peak for MFU accounting: the int8 pipeline's
-    peak for the int8 backend, the dense bf16 peak for everything else
-    (the xnor/pallas_xnor backends run on the VPU but are still scored
-    against the bf16 MXU peak — that IS the machine's dense capability
-    the kernel is competing with)."""
-    peak = _chip_peak_bf16(device)
-    if peak is None:
-        return None, "unknown"
-    if backend == "int8":
-        kind = (getattr(device, "device_kind", "") or str(device)).lower()
-        mult = next((m for sub, m in _INT8_MULT if sub in kind), 1.0)
-        return peak * mult, "int8"
-    return peak, "bf16"
-
-
-def _dense_macs_per_image(params) -> int:
-    """Analytic per-image MAC count of every Dense kernel in the model
-    (rank-2 (in, out) kernels contribute in*out MACs per image). Exact
-    for the MLP/QNN families where all FLOPs are in Dense layers; returns
-    0 if no rank-2 kernel is found (conv models: use XLA cost analysis
-    instead)."""
-    import jax
-
-    total = 0
-    for leaf in jax.tree.leaves(params):
-        if getattr(leaf, "ndim", 0) == 2:
-            total += int(leaf.shape[0]) * int(leaf.shape[1])
-    return total
 
 
 def _step_flops(trainer, batch_size: int) -> tuple[float, str] | None:
@@ -448,59 +443,13 @@ def _step_flops(trainer, batch_size: int) -> tuple[float, str] | None:
     return None
 
 
-def _mfu(step_flops: float | None, step_time_s: float | None,
-         peak: float | None) -> float | None:
-    """Model FLOPs Utilization: achieved model FLOPs/s over the chip's
-    dense bf16 peak (BASELINE.md names images/sec/chip and MFU-style
-    utilization as the headline metrics)."""
-    if not step_flops or not step_time_s or not peak or step_time_s <= 0:
-        return None
-    return round(step_flops / step_time_s / peak, 4)
-
-
 def _conv_macs_per_image(model, variables, input_shape) -> int:
-    """Analytic conv+dense MAC count of one forward pass, by walking the
-    shaped jaxpr for conv_general_dilated / dot_general primitives — the
-    conv-family counterpart of ``_dense_macs_per_image`` (convs put most
-    FLOPs outside rank-2 kernels, so the dense count undercounts)."""
-    import jax
-    import jax.numpy as jnp
+    """Analytic conv+dense MAC count of one forward pass (delegates to
+    obs/flops.jaxpr_macs_per_example — the conv-family counterpart of
+    ``_dense_macs_per_image``)."""
+    from distributed_mnist_bnns_tpu.obs.flops import jaxpr_macs_per_example
 
-    macs = [0]
-
-    def fwd(v, x):
-        return model.apply(v, x, train=False)
-
-    jaxpr = jax.make_jaxpr(fwd)(
-        variables, jnp.zeros((1, *input_shape), jnp.float32)
-    )
-
-    def count(jx):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "conv_general_dilated":
-                out = eqn.outvars[0].aval.shape      # (N, H, W, O)
-                rhs = eqn.invars[1].aval.shape       # (Kh, Kw, I, O)
-                macs[0] += (
-                    out[1] * out[2] * out[3]
-                    * rhs[0] * rhs[1] * rhs[2]
-                )
-            elif eqn.primitive.name == "dot_general":
-                shapes = [v.aval.shape for v in eqn.invars]
-                if len(shapes) == 2 and len(shapes[1]) == 2:
-                    m = 1
-                    for d in eqn.outvars[0].aval.shape[:-1]:
-                        m *= d
-                    macs[0] += m * shapes[1][0] * shapes[1][1]
-            for sub in eqn.params.values():
-                if hasattr(sub, "jaxpr"):
-                    count(sub.jaxpr)
-                elif isinstance(sub, (list, tuple)):
-                    for s in sub:
-                        if hasattr(s, "jaxpr"):
-                            count(s.jaxpr)
-
-    count(jaxpr.jaxpr)
-    return macs[0]
+    return jaxpr_macs_per_example(model.apply, variables, input_shape)
 
 
 def _cpu_fallback_extras(args):
@@ -989,6 +938,14 @@ def main() -> None:
                    help="total wall-clock budget for probe retries with "
                         "backoff before declaring the endpoint dead "
                         "(sleeps 30s doubling to 480s between attempts)")
+    p.add_argument("--events", default=None,
+                   help="also mirror the bench record into a telemetry "
+                        "JSONL event log at this path (same schema as "
+                        "training's --telemetry-dir; OBSERVABILITY.md), "
+                        "so bench and training runs are comparable via "
+                        "`cli telemetry`. Live-endpoint runs only: the "
+                        "dead-endpoint record skips the mirror (its "
+                        "manifest would re-dial the dead backend)")
     args = p.parse_args()
     global _PROGRESS_ON
     _PROGRESS_ON = args.verbose
@@ -1076,13 +1033,17 @@ def main() -> None:
                         continue
                     if rec2.get("metric") != result["metric"]:
                         continue
-                    for k in ("serving", "lm_flash",
-                              "stretch_xnor_resnet18_cifar",
-                              "device_resident_epoch", "crossover"):
+                    for k in SECTION_MERGE_KEYS:
                         if isinstance(rec2.get(k), dict):
                             sections[k] = {
                                 "source": os.path.basename(local),
-                                "captured_at": rec2.get("ts"),
+                                # mtime fallback mirrors the best-record
+                                # path above: records written before the
+                                # "ts" stamp existed must not yield
+                                # captured_at: null.
+                                "captured_at": rec2.get("ts") or _utc_now(
+                                    os.path.getmtime(local)
+                                ),
                                 **rec2[k],
                             }
                 if sections:
@@ -1092,7 +1053,14 @@ def main() -> None:
                 result["cpu_fallback"] = _cpu_fallback_extras(args)
             except Exception as e:
                 result["cpu_fallback"] = f"failed: {e!r:.300}"
-            print(json.dumps(result))
+            # NO events mirror here: _emit_events touches jax
+            # (process_index / jax.devices() for the manifest), and on
+            # this dead-endpoint path an in-process backend init can
+            # hang forever — uncatchable, burning the window harness's
+            # whole timeout on a path engineered to exit promptly. The
+            # JSON line is the record; the mirror only exists for runs
+            # that measured something.
+            print(json.dumps(result), flush=True)
             return
     deadline = time.monotonic() + args.budget_s
     _progress(
@@ -1365,7 +1333,11 @@ def main() -> None:
                 jax, jnp, deadline, args.reps
             )
     _progress("sections complete; emitting record")
-    print(json.dumps(result))
+    # Record first, telemetry mirror second (same ordering rule as the
+    # dead-endpoint path: nothing may stand between the measurement and
+    # its JSON line).
+    print(json.dumps(result), flush=True)
+    _emit_events(args.events, result, model=args.model)
 
 
 if __name__ == "__main__":

@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "trained epoch's early steps here")
+        sp.add_argument("--telemetry-dir", default=None,
+                        help="write structured run telemetry here: JSONL "
+                             "events (manifest/step/epoch/checkpoint), "
+                             "per-process heartbeats, recompile counts "
+                             "(OBSERVABILITY.md); read back with the "
+                             "`telemetry` subcommand")
         sp.add_argument("--loss", default="ce",
                         choices=["ce", "hinge", "sqrt_hinge"])
         sp.add_argument("--label-smoothing", type=float, default=0.0,
@@ -217,6 +223,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: interpret off-TPU, kernels on)")
     lm.add_argument("--log-interval", type=int, default=25)
     lm.add_argument("--log-file", default="log.txt")
+    tm = sub.add_parser(
+        "telemetry",
+        help="summarize a run's telemetry event log (from "
+             "--telemetry-dir or bench --events) into a human-readable "
+             "table; --json for tooling",
+    )
+    tm.add_argument("log",
+                    help="path to an events.jsonl, or the telemetry "
+                         "directory containing one")
+    tm.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object instead "
+                         "of a table")
     return p
 
 
@@ -266,6 +284,7 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         pp_remat=args.pp_remat,
         tensor_parallel=args.tp,
         profile_dir=args.profile_dir,
+        telemetry_dir=args.telemetry_dir,
         remat=args.remat,
         grad_accum=args.grad_accum,
         scan_steps=args.scan_steps,
@@ -298,6 +317,26 @@ def main(argv=None) -> int:
     repin_failed = _honor_platform_env()
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if args.cmd == "telemetry":
+        # Pure host-side log reading: no jax backend, no logging setup
+        # (stdout stays the report).
+        import json
+        import os
+
+        from .obs import render_table, summarize
+        from .obs.telemetry import EVENTS_FILE
+
+        path = args.log
+        if os.path.isdir(path):
+            path = os.path.join(path, EVENTS_FILE)
+        try:
+            summary = summarize(path)
+        except FileNotFoundError:
+            print(f"no event log at {path}", file=sys.stderr)
+            return 2
+        print(json.dumps(summary) if args.json else render_table(summary))
+        return 0
 
     if args.cmd == "lm":
         from .utils import setup_logging
@@ -485,8 +524,19 @@ def main(argv=None) -> int:
             jax.default_backend() != "tpu"
             if args.interpret is None else args.interpret
         )
+        from .obs import default_registry, get_tracker
+
         fn, info = load_packed(args.artifact, interpret=interpret)
         bs = args.batch_size
+        registry = default_registry()
+        batch_hist = registry.histogram(
+            "infer_batch_seconds", "packed-serving full-batch latency"
+        )
+        examples_ctr = registry.counter(
+            "infer_examples_total", "examples served by packed inference"
+        )
+        tracker = get_tracker()
+        compiles_before = tracker.mark()
         # Warm the full-batch program so reported latency is serving
         # time, not jit/Mosaic compile time (the trailing partial batch
         # compiles its own shape; it is excluded from the average).
@@ -500,10 +550,13 @@ def main(argv=None) -> int:
             t0 = _time.perf_counter()
             preds = np.asarray(fn(x)).argmax(-1)  # host fetch = sync
             if len(y) == bs:
-                t_sum += _time.perf_counter() - t0
+                dt = _time.perf_counter() - t0
+                t_sum += dt
                 full_batches += 1
+                batch_hist.observe(dt)
             correct += int((preds == y).sum())
             total += len(y)
+            examples_ctr.inc(len(y))
         out = {
             "artifact": args.artifact,
             "family": info.get("family"),
@@ -515,6 +568,20 @@ def main(argv=None) -> int:
             "compression": info.get("compression"),
             "interpret": interpret,
         }
+        if args.telemetry_dir:
+            # Serving runs share the training event schema, so one
+            # `telemetry` read covers both sides of a model's life.
+            from .obs import Telemetry
+
+            with Telemetry(args.telemetry_dir, heartbeat=False) as tel:
+                tel.manifest(config=vars(args))
+                tel.emit(
+                    "infer",
+                    **out,
+                    p50_batch_s=batch_hist.percentile(50),
+                    p95_batch_s=batch_hist.percentile(95),
+                    recompiles=tracker.count - compiles_before,
+                )
         log.info("packed inference: %s", out)
         print(json.dumps(out))
         return 0
@@ -536,6 +603,10 @@ def main(argv=None) -> int:
             return 2
         trainer.state = trainer.restore(args.checkpoint_dir, best=args.best)
         metrics = trainer.evaluate(data)
+        # fit() owns the close in training runs; standalone eval must
+        # seal its own log (run_end + heartbeat stop).
+        trainer.telemetry.emit("eval", **metrics)
+        trainer.telemetry.close()
         log.info("eval: %s", metrics)
         print(metrics)
         return 0
@@ -556,6 +627,8 @@ def main(argv=None) -> int:
             args.out,
             input_shape=data.input_shape,
         )
+        trainer.telemetry.emit("export", out=args.out, **info)
+        trainer.telemetry.close()
         log.info("exported packed model to %s: %s", args.out, info)
         print({"out": args.out, **info})
         return 0

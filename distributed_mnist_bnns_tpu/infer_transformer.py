@@ -32,6 +32,7 @@ them in fp32 — equality tests pin backend="xla".
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Tuple
 
 import jax
@@ -576,10 +577,27 @@ def generate(
     if temperature > 0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")
 
+    # Serving telemetry (obs/): token counters + per-token decode
+    # latency, so a `telemetry` snapshot shows decode throughput next to
+    # training throughput. Host-observed wall time over the whole decode
+    # loop, amortized per emitted token.
+    from .obs import default_registry
+
+    _reg = default_registry()
+    _reg.counter(
+        "lm_prefill_tokens_total", "prompt tokens fed through prefill"
+    ).inc(int(prompt.shape[0]) * int(prompt.shape[1]))
+
     lp = None
     for t in range(prompt.shape[1]):           # prefill
         caches, lp = step(caches, prompt[:, t], t)
     out = [prompt]
+    if n_tokens > 0 and lp is not None:
+        # Sync the (async-dispatched) prefill before starting the decode
+        # clock, or the per-token metric silently absorbs the prompt's
+        # device time.
+        jax.block_until_ready(lp)
+    _t0 = time.perf_counter()
     for t in range(prompt.shape[1], total):    # decode
         if temperature > 0:
             rng, sub = jax.random.split(rng)
@@ -590,4 +608,14 @@ def generate(
         out.append(nxt[:, None])
         if t < total - 1:
             caches, lp = step(caches, nxt, t)
-    return jnp.concatenate(out, axis=1)
+    result = jnp.concatenate(out, axis=1)
+    if n_tokens > 0:
+        jax.block_until_ready(result)
+        _reg.counter(
+            "lm_decode_tokens_total", "tokens emitted by KV-cache decode"
+        ).inc(int(prompt.shape[0]) * n_tokens)
+        _reg.histogram(
+            "lm_decode_seconds_per_token",
+            "KV-cache decode wall time per emitted token",
+        ).observe((time.perf_counter() - _t0) / n_tokens)
+    return result

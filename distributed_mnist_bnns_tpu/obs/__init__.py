@@ -1,0 +1,79 @@
+"""obs — the unified telemetry subsystem.
+
+One layer answering "how fast is this step, why did it recompile, and
+which host is unhealthy" across the trainer, the infer paths, the
+parallel backends and bench.py. See OBSERVABILITY.md for the event
+schema and how to read a run.
+
+  registry   thread-safe counters/gauges/histograms + snapshot()
+  events     structured JSONL run events + run manifest
+  flops      analytic model FLOPs, chip peaks, MFU, HBM stats
+  recompile  jit cache-miss counting (jax.monitoring + spike fallback)
+  heartbeat  per-process liveness records
+  telemetry  the facade the training/serving layers talk to
+  summary    fold a run log into a report (the `telemetry` CLI)
+"""
+
+from .events import (
+    EventLog,
+    MANIFEST_KIND,
+    SCHEMA_VERSION,
+    git_rev,
+    load_events,
+    read_events,
+    utc_now,
+)
+from .flops import (
+    chip_peak,
+    chip_peak_bf16,
+    dense_macs_per_example,
+    device_memory_stats,
+    device_peak_flops,
+    jaxpr_macs_per_example,
+    mfu,
+    train_step_flops,
+)
+from .heartbeat import Heartbeat, read_heartbeats
+from .recompile import RecompileTracker, get_tracker
+from .registry import (
+    Counter,
+    DEFAULT_TIME_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from .summary import render_table, summarize
+from .telemetry import Telemetry, peak_for_default_device
+
+__all__ = [
+    "Counter",
+    "DEFAULT_TIME_BUCKETS",
+    "EventLog",
+    "Gauge",
+    "Heartbeat",
+    "Histogram",
+    "MANIFEST_KIND",
+    "MetricsRegistry",
+    "RecompileTracker",
+    "SCHEMA_VERSION",
+    "Telemetry",
+    "chip_peak",
+    "chip_peak_bf16",
+    "default_registry",
+    "dense_macs_per_example",
+    "device_memory_stats",
+    "device_peak_flops",
+    "get_tracker",
+    "git_rev",
+    "jaxpr_macs_per_example",
+    "load_events",
+    "mfu",
+    "peak_for_default_device",
+    "read_events",
+    "read_heartbeats",
+    "render_table",
+    "summarize",
+    "train_step_flops",
+    "utc_now",
+]

@@ -1,0 +1,214 @@
+"""Structured JSONL run events — one append-only file per run.
+
+Every record is one JSON object per line with three envelope fields:
+``v`` (schema version), ``kind`` (event type) and ``ts`` (UTC ISO-8601).
+The first record of a run is the ``run_manifest`` — config, device/mesh
+topology, jax version and git rev — so a log file is self-describing:
+any later reader knows exactly what produced the numbers that follow.
+
+Event kinds (schema v1):
+  run_manifest   config, devices, mesh, versions, git rev  (exactly once)
+  step           step index, latency, examples/sec, mfu, loss/acc
+  epoch          per-epoch aggregates + device memory stats
+  eval           test metrics
+  checkpoint     epoch, path, best flag
+  bench          a bench.py section result (same envelope as training)
+  infer          packed-serving run summary
+  error          exception type/message before a crash propagates
+  heartbeat      liveness records (written per process by obs/heartbeat)
+
+Writes happen only on the primary host (process_index 0) unless
+``primary_only=False`` — the multi-host analogue of the reference's
+``if rank == 0`` print guards. Heartbeats intentionally bypass that rule
+(every process writes its own file) so a stalled non-primary host is
+diagnosable after the fact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..utils.logging_utils import is_primary_host
+
+SCHEMA_VERSION = 1
+MANIFEST_KIND = "run_manifest"
+
+
+def utc_now(epoch_s: Optional[float] = None) -> str:
+    return time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ",
+        time.gmtime(epoch_s) if epoch_s is not None else time.gmtime(),
+    )
+
+
+def git_rev(cwd: Optional[str] = None) -> Optional[str]:
+    """Current git commit of the source tree, or None outside a repo."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        )
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else None
+    except Exception:
+        return None
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort conversion to JSON-serializable builtins (numpy/jax
+    scalars -> float/int, arrays -> lists only when tiny, else shape)."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
+        return value.item()
+    if hasattr(value, "shape"):
+        return {"shape": list(value.shape), "dtype": str(value.dtype)}
+    return str(value)
+
+
+def device_topology() -> Dict[str, Any]:
+    """Device/process topology as manifest data. Tolerates an
+    uninitialized jax (pure-host tooling reading logs)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "device_kind": devices[0].device_kind if devices else None,
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+    except Exception:
+        return {"backend": None, "device_count": 0}
+
+
+class EventLog:
+    """Append-only JSONL sink for one run.
+
+    ``emit`` is a no-op on non-primary hosts (see module docstring), so
+    call sites need no rank guards. Flush policy: ``step`` events are
+    buffered (a flushed syscall per hot-loop dispatch would serialize
+    file I/O against sub-ms device steps) and flushed every
+    ``flush_every`` records; every other kind — manifest, epoch, error,
+    run_end — flushes immediately, so a crashed run loses at most the
+    last few step lines, never the milestone records."""
+
+    def __init__(
+        self, path: str, *, primary_only: bool = True,
+        flush_every: int = 32,
+    ):
+        self.path = path
+        self._active = is_primary_host() or not primary_only
+        self._fh = None
+        self._manifest_written = False
+        self._flush_every = max(int(flush_every), 1)
+        self._unflushed = 0
+        if self._active:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a")
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        if not self._active or self._fh is None:
+            return
+        record = {"v": SCHEMA_VERSION, "kind": kind, "ts": utc_now()}
+        record.update({k: _jsonable(v) for k, v in fields.items()})
+        self._fh.write(json.dumps(record) + "\n")
+        self._unflushed += 1
+        if kind != "step" or self._unflushed >= self._flush_every:
+            self._fh.flush()
+            self._unflushed = 0
+
+    def manifest(
+        self, config: Optional[Dict[str, Any]] = None,
+        mesh: Any = None, **extra: Any,
+    ) -> None:
+        """Emit the run manifest (once; later calls are ignored so
+        resume/retry paths can call unconditionally)."""
+        if self._manifest_written:
+            return
+        self._manifest_written = True
+        mesh_info = None
+        if mesh is not None:
+            try:
+                mesh_info = {
+                    "axis_names": list(mesh.axis_names),
+                    "shape": {
+                        str(k): int(v) for k, v in dict(mesh.shape).items()
+                    },
+                }
+            except Exception:
+                mesh_info = str(mesh)
+        try:
+            import jax
+
+            jax_version = jax.__version__
+        except Exception:
+            jax_version = None
+        self.emit(
+            MANIFEST_KIND,
+            config=config or {},
+            topology=device_topology(),
+            mesh=mesh_info,
+            jax_version=jax_version,
+            python_version=sys.version.split()[0],
+            hostname=socket.gethostname(),
+            pid=os.getpid(),
+            git_rev=git_rev(),
+            argv=list(sys.argv),
+            **extra,
+        )
+
+    def error(self, exc: BaseException, **fields: Any) -> None:
+        self.emit(
+            "error",
+            error_type=type(exc).__name__,
+            error=str(exc)[:2000],
+            **fields,
+        )
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_events(path: str) -> Iterator[Dict[str, Any]]:
+    """Stream a JSONL event log; malformed lines (a crash mid-write) are
+    skipped rather than poisoning the whole read."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    return list(read_events(path))

@@ -1,0 +1,211 @@
+"""Analytic FLOPs accounting and MFU — the single source for chip peaks
+and model-FLOPs estimates (bench.py delegates here, the trainer's
+step-level telemetry records from here; previously this logic lived only
+inside bench.py).
+
+Convention: training FLOPs per step = 3 x forward-GEMM FLOPs
+(fwd = 2*MACs; backward costs ~2x fwd for the dL/dW and dL/dx GEMMs per
+layer) — the standard MFU numerator, which deliberately excludes
+optimizer/elementwise noise. XLA's cost_analysis is NOT used: it is
+unavailable through remote-compile tunnel backends and counts the noise
+the convention excludes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+# Per-chip bf16 peak (dense MXU FLOPs/s) by device_kind substring, most
+# specific first. Sources: public TPU spec sheets (v5e 197 TF, v5p 459 TF,
+# v4 275 TF, v6e 918 TF, v3 123 TF, v2 45 TF bf16 per chip).
+PEAKS_BF16: Tuple[Tuple[str, float], ...] = (
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+# int8 MXU peak relative to bf16: 2x on v5e/v5p/v6 (the generations with
+# a doubled int8 pipeline), 1x on v4 and earlier.
+INT8_MULT: Tuple[Tuple[str, float], ...] = (
+    ("v5", 2.0), ("v6", 2.0), ("trillium", 2.0),
+    ("v4", 1.0), ("v3", 1.0), ("v2", 1.0),
+)
+
+# Nominal dense peak for hosts with no spec-sheet entry (CPU smoke runs,
+# unknown accelerators): ~100 GFLOP/s, a round order-of-magnitude for a
+# few vectorized cores. MFU against it is a *relative* utilization signal
+# only — telemetry marks it peak_precision="nominal" so a reader never
+# mistakes a CPU number for a TPU one.
+NOMINAL_HOST_PEAK = 1e11
+
+
+def _device_kind(device: Any) -> str:
+    return (getattr(device, "device_kind", "") or str(device)).lower()
+
+
+def chip_peak_bf16(device: Any) -> Optional[float]:
+    kind = _device_kind(device)
+    for sub, peak in PEAKS_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def chip_peak(device: Any, backend: str = "bf16") -> Tuple[Optional[float], str]:
+    """Precision-matched MXU peak for MFU accounting: the int8 pipeline's
+    peak for the int8 backend, the dense bf16 peak for everything else
+    (the xnor/pallas_xnor backends run on the VPU but are still scored
+    against the bf16 MXU peak — that IS the machine's dense capability
+    the kernel is competing with). Returns (peak or None, precision)."""
+    peak = chip_peak_bf16(device)
+    if peak is None:
+        return None, "unknown"
+    if backend == "int8":
+        kind = _device_kind(device)
+        mult = next((m for sub, m in INT8_MULT if sub in kind), 1.0)
+        return peak * mult, "int8"
+    return peak, "bf16"
+
+
+def device_peak_flops(
+    device: Any, backend: str = "bf16",
+) -> Tuple[float, str]:
+    """``chip_peak`` with a nominal-host fallback so step telemetry can
+    always report an MFU estimate (marked "nominal" off the spec table —
+    see NOMINAL_HOST_PEAK)."""
+    peak, precision = chip_peak(device, backend)
+    if peak is None:
+        return NOMINAL_HOST_PEAK, "nominal"
+    return peak, precision
+
+
+def dense_macs_per_example(params: Any) -> int:
+    """Analytic per-example MAC count of every Dense kernel in the model
+    (rank-2 (in, out) kernels contribute in*out MACs per example). Exact
+    for the MLP/QNN families where all FLOPs are in Dense layers."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        if getattr(leaf, "ndim", 0) == 2:
+            total += int(leaf.shape[0]) * int(leaf.shape[1])
+    return total
+
+
+def jaxpr_macs_per_example(apply_fn, variables: Any, input_shape) -> int:
+    """Analytic conv+dense MAC count of one forward pass, by walking the
+    shaped jaxpr for conv_general_dilated / dot_general primitives — the
+    conv-family counterpart of ``dense_macs_per_example`` (convs put most
+    FLOPs outside rank-2 kernels, so the dense count undercounts)."""
+    import jax
+    import jax.numpy as jnp
+
+    macs = [0]
+
+    def fwd(v, x):
+        return apply_fn(v, x, train=False)
+
+    jaxpr = jax.make_jaxpr(fwd)(
+        variables, jnp.zeros((1, *input_shape), jnp.float32)
+    )
+
+    def count(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "conv_general_dilated":
+                out = eqn.outvars[0].aval.shape      # (N, H, W, O)
+                rhs = eqn.invars[1].aval.shape       # (Kh, Kw, I, O)
+                macs[0] += (
+                    out[1] * out[2] * out[3]
+                    * rhs[0] * rhs[1] * rhs[2]
+                )
+            elif eqn.primitive.name == "dot_general":
+                shapes = [v.aval.shape for v in eqn.invars]
+                if len(shapes) == 2 and len(shapes[1]) == 2:
+                    m = 1
+                    for d in eqn.outvars[0].aval.shape[:-1]:
+                        m *= d
+                    macs[0] += m * shapes[1][0] * shapes[1][1]
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    count(sub.jaxpr)
+                elif isinstance(sub, (list, tuple)):
+                    for s in sub:
+                        if hasattr(s, "jaxpr"):
+                            count(s.jaxpr)
+
+    count(jaxpr.jaxpr)
+    return macs[0]
+
+
+def train_step_flops(
+    model_name: str,
+    params: Any,
+    batch_size: int,
+    *,
+    apply_fn=None,
+    variables: Any = None,
+    input_shape=None,
+) -> Tuple[Optional[float], str]:
+    """FLOPs of one optimizer step over ``batch_size`` examples, with the
+    estimation method used: "analytic_3x_dense_gemms" for the MLP/QNN
+    families (all FLOPs in rank-2 kernels), else
+    "analytic_3x_conv_and_dense_from_jaxpr" when the forward can be
+    traced, else (None, "unavailable")."""
+    if "mlp" in model_name or "qnn" in model_name:
+        macs = dense_macs_per_example(params)
+        if macs > 0:
+            return 3.0 * 2.0 * macs * batch_size, "analytic_3x_dense_gemms"
+    if apply_fn is not None and variables is not None and input_shape:
+        try:
+            macs = jaxpr_macs_per_example(apply_fn, variables, input_shape)
+            if macs > 0:
+                return (
+                    3.0 * 2.0 * macs * batch_size,
+                    "analytic_3x_conv_and_dense_from_jaxpr",
+                )
+        except Exception:
+            pass
+    return None, "unavailable"
+
+
+def mfu(
+    step_flops: Optional[float],
+    step_time_s: Optional[float],
+    peak: Optional[float],
+    n_devices: int = 1,
+) -> Optional[float]:
+    """Model FLOPs Utilization: achieved model FLOPs/s over the peak of
+    the ``n_devices`` chips the step ran on (BASELINE.md names
+    images/sec/chip and MFU-style utilization as the headline metrics)."""
+    if not step_flops or not step_time_s or not peak or step_time_s <= 0:
+        return None
+    return round(step_flops / step_time_s / (peak * max(n_devices, 1)), 6)
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Per-device HBM usage via ``device.memory_stats()`` where the
+    backend exposes it (TPU/GPU runtimes do, CPU returns None). Returns
+    {device_index: {bytes_in_use, peak_bytes_in_use, bytes_limit}} for
+    local devices, or None when unavailable."""
+    try:
+        import jax
+
+        out = {}
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if not stats:
+                continue
+            out[str(d.id)] = {
+                k: int(v) for k, v in stats.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                         "largest_alloc_size")
+            }
+        return out or None
+    except Exception:
+        return None

@@ -1,0 +1,141 @@
+"""Recompilation tracking — make silent retrace storms a visible counter.
+
+A jitted function recompiles whenever it sees a new input
+shape/dtype/static-arg combination; on a remote-compile backend one
+silent retrace can cost minutes. JAX announces every backend compile
+through ``jax.monitoring`` (the ``/jax/core/compile/backend_compile_duration``
+duration event, fired exactly once per XLA compilation — i.e. per jit
+cache miss); the tracker registers a listener and counts them into the
+metrics registry, so the step-level telemetry (and the ``telemetry``
+CLI) can report "this run compiled N programs, M of them after warmup".
+
+Fallback: when ``jax.monitoring`` is unavailable (stubbed jax, very old
+versions), ``observe_step`` applies a dispatch-time-spike heuristic — a
+step that takes > ``spike_factor`` x the running median is counted as a
+suspected recompile. The heuristic is only consulted when the listener
+could not be installed, so real counts are never mixed with guesses.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+import threading
+from typing import Optional
+
+from .registry import MetricsRegistry, default_registry
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+COMPILES_TOTAL = "jax_backend_compiles_total"
+COMPILE_SECONDS = "jax_backend_compile_seconds"
+
+
+class RecompileTracker:
+    """Counts backend compiles (see module docstring). One instance per
+    process is enough — use ``get_tracker()``."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        *,
+        spike_factor: float = 20.0,
+        window: int = 64,
+    ):
+        self.registry = registry or default_registry()
+        self._lock = threading.Lock()
+        self._count = 0
+        self._compile_seconds = 0.0
+        self._installed = False
+        self.listener_available = False
+        self.spike_factor = spike_factor
+        self._recent = collections.deque(maxlen=window)
+        self._counter = self.registry.counter(
+            COMPILES_TOTAL,
+            "XLA backend compilations observed (jit cache misses; "
+            "suspected-from-latency-spike when kind=suspected)",
+        )
+        self._seconds = self.registry.counter(
+            COMPILE_SECONDS, "cumulative XLA backend compile time",
+        )
+
+    # -- jax.monitoring listener (primary) ----------------------------------
+
+    def _on_duration(self, event: str, duration: float, **kwargs) -> None:
+        if event != BACKEND_COMPILE_EVENT:
+            return
+        with self._lock:
+            self._count += 1
+            self._compile_seconds += float(duration)
+        self._counter.inc(kind="measured")
+        self._seconds.inc(float(duration))
+
+    def install(self) -> "RecompileTracker":
+        """Register the monitoring listener (idempotent). jax.monitoring
+        offers no per-listener unregister on all supported versions, so
+        installation is once-per-process by design."""
+        if self._installed:
+            return self
+        self._installed = True
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(
+                self._on_duration
+            )
+            self.listener_available = True
+        except Exception:
+            self.listener_available = False
+        return self
+
+    # -- dispatch-time-spike fallback ---------------------------------------
+
+    def observe_step(self, step_seconds: float) -> bool:
+        """Feed a measured step time. Only when the monitoring listener
+        is NOT available, a spike above ``spike_factor`` x the running
+        median counts as a suspected recompile. Returns True when a
+        suspected recompile was recorded."""
+        if self.listener_available:
+            return False
+        with self._lock:
+            suspected = (
+                len(self._recent) >= 8
+                and step_seconds
+                > self.spike_factor * statistics.median(self._recent)
+            )
+            self._recent.append(step_seconds)
+            if suspected:
+                self._count += 1
+        if suspected:
+            self._counter.inc(kind="suspected")
+        return suspected
+
+    # -- reads --------------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def compile_seconds(self) -> float:
+        with self._lock:
+            return self._compile_seconds
+
+    def mark(self) -> int:
+        """Snapshot the current count; subtract from a later ``count``
+        to attribute compiles to a region (warmup vs steady-state)."""
+        return self.count
+
+
+_tracker: Optional[RecompileTracker] = None
+_tracker_lock = threading.Lock()
+
+
+def get_tracker() -> RecompileTracker:
+    """Process-wide tracker, installed on first use."""
+    global _tracker
+    with _tracker_lock:
+        if _tracker is None:
+            _tracker = RecompileTracker().install()
+        return _tracker

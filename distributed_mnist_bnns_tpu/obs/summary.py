@@ -1,0 +1,239 @@
+"""Run-log summarization — the read side of the telemetry subsystem.
+
+``summarize(path)`` folds a JSONL event log (obs/events.py schema) into
+one plain dict; ``render_table`` formats it for humans. Both are exact:
+percentiles here come from the per-step latencies recorded in the
+events, not the registry's bucketed estimates (the registry serves the
+live process; the log serves post-hoc analysis).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from .events import MANIFEST_KIND, read_events
+from .heartbeat import read_heartbeats
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q / 100.0 * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def summarize(path: str) -> Dict[str, Any]:
+    """Fold an event log into a summary dict (see OBSERVABILITY.md for
+    the schema). Raises FileNotFoundError for a missing log."""
+    all_events = list(read_events(path))
+    # A reused telemetry dir appends runs to one file; report the LATEST
+    # run (everything from the last manifest on) so a re-run never has
+    # its numbers attributed to an older run's config/git rev. A log
+    # with no manifest (hand-built, tests) aggregates everything.
+    last_manifest = max(
+        (i for i, e in enumerate(all_events)
+         if e.get("kind") == MANIFEST_KIND),
+        default=None,
+    )
+    if last_manifest is not None:
+        events_in_run = all_events[last_manifest:]
+    else:
+        events_in_run = all_events
+
+    manifests: List[Dict] = []
+    latencies: List[float] = []
+    mfus: List[Dict] = []
+    losses: List[float] = []
+    steps_total = 0
+    examples_total = 0
+    latency_weighted_s = 0.0
+    epochs: List[Dict] = []
+    evals: List[Dict] = []
+    checkpoints = 0
+    errors: List[Dict] = []
+    recompiles: Optional[int] = None
+    compile_seconds: Optional[float] = None
+    wall_seconds: Optional[float] = None
+    kinds: Dict[str, int] = {}
+    bench_sections: List[Dict] = []
+    infer_runs: List[Dict] = []
+
+    for ev in events_in_run:
+        kind = ev.get("kind", "?")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == MANIFEST_KIND:
+            manifests.append(ev)
+        elif kind == "step":
+            n = int(ev.get("n_steps", 1) or 1)
+            lat = ev.get("latency_s")
+            if isinstance(lat, (int, float)):
+                latencies.append(float(lat))
+                latency_weighted_s += float(lat) * n
+                if isinstance(ev.get("mfu"), (int, float)):
+                    mfus.append({"mfu": float(ev["mfu"]),
+                                 "w": float(lat) * n})
+            if isinstance(ev.get("loss"), (int, float)):
+                losses.append(float(ev["loss"]))
+            steps_total += n
+            examples_total += n * int(ev.get("batch_size", 0) or 0)
+        elif kind == "epoch":
+            epochs.append(ev)
+            if isinstance(ev.get("recompiles_total"), int):
+                recompiles = ev["recompiles_total"]
+        elif kind == "eval":
+            evals.append(ev)
+        elif kind == "checkpoint":
+            checkpoints += 1
+        elif kind == "error":
+            errors.append(ev)
+        elif kind == "run_end":
+            if isinstance(ev.get("recompiles_total"), int):
+                recompiles = ev["recompiles_total"]
+            compile_seconds = ev.get("compile_seconds")
+            wall_seconds = ev.get("wall_seconds")
+        elif kind == "bench":
+            bench_sections.append(ev)
+        elif kind == "infer":
+            infer_runs.append(ev)
+
+    latencies.sort()
+    manifest = manifests[0] if manifests else {}
+    summary: Dict[str, Any] = {
+        "path": path,
+        "schema_versions": sorted({
+            m.get("v") for m in manifests
+        }) if manifests else [],
+        "manifest_count": len(manifests),
+        "run": {
+            "model": (manifest.get("config") or {}).get("model"),
+            "started": manifest.get("ts"),
+            "git_rev": manifest.get("git_rev"),
+            "jax_version": manifest.get("jax_version"),
+            "backend": (manifest.get("topology") or {}).get("backend"),
+            "device_kind": (
+                manifest.get("topology") or {}
+            ).get("device_kind"),
+            "device_count": (
+                manifest.get("topology") or {}
+            ).get("device_count"),
+            "wall_seconds": wall_seconds,
+        },
+        "steps": {
+            "count": steps_total,
+            "examples": examples_total,
+            "latency_s": {
+                "p50": _percentile(latencies, 50),
+                "p95": _percentile(latencies, 95),
+                "p99": _percentile(latencies, 99),
+                "min": latencies[0] if latencies else None,
+                "max": latencies[-1] if latencies else None,
+            },
+            # Aggregates weight by recorded time so they telescope: on
+            # async backends individual dispatch latencies are bimodal
+            # (dispatch-only vs sync-drain), but their SUM is the loop's
+            # wall time, making these ratios exact where a mean of
+            # per-step ratios would be dominated by the tiny
+            # dispatch-only entries.
+            "examples_per_sec_mean": (
+                examples_total / latency_weighted_s
+                if latency_weighted_s > 0 else None
+            ),
+            "mfu_mean": (
+                sum(m["mfu"] * m["w"] for m in mfus)
+                / sum(m["w"] for m in mfus)
+                if mfus and sum(m["w"] for m in mfus) > 0 else None
+            ),
+            "mfu_max": max((m["mfu"] for m in mfus), default=None),
+            "final_loss": losses[-1] if losses else None,
+        },
+        "recompiles_total": recompiles,
+        "compile_seconds": compile_seconds,
+        "epochs": len(epochs),
+        "evals": len(evals),
+        "best_test_acc": max(
+            (e.get("test_acc") for e in evals
+             if isinstance(e.get("test_acc"), (int, float))),
+            default=None,
+        ),
+        "checkpoints": checkpoints,
+        "errors": [
+            {"ts": e.get("ts"), "type": e.get("error_type"),
+             "error": e.get("error")}
+            for e in errors
+        ],
+        "event_counts": kinds,
+    }
+    if bench_sections:
+        summary["bench_events"] = len(bench_sections)
+    if infer_runs:
+        summary["infer_events"] = len(infer_runs)
+    heartbeats = read_heartbeats(os.path.dirname(path) or ".")
+    if heartbeats:
+        summary["heartbeats"] = {
+            str(idx): {"ts": hb.get("ts"), "beat": hb.get("beat")}
+            for idx, hb in sorted(heartbeats.items())
+        }
+    return summary
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.2f} ms" if v < 1.0 else f"{v:.3f} s"
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_table(summary: Dict[str, Any]) -> str:
+    """Human-readable run summary (the `telemetry` CLI's default)."""
+    run = summary["run"]
+    st = summary["steps"]
+    lat = st["latency_s"]
+    rows = [
+        ("model", _fmt(run.get("model"))),
+        ("started", _fmt(run.get("started"))),
+        ("backend / device", f"{_fmt(run.get('backend'))} / "
+                             f"{_fmt(run.get('device_kind'))} "
+                             f"x{_fmt(run.get('device_count'))}"),
+        ("jax / git", f"{_fmt(run.get('jax_version'))} / "
+                      f"{_fmt((run.get('git_rev') or '')[:12] or None)}"),
+        ("steps / examples", f"{st['count']} / {st['examples']}"),
+        ("step latency p50", _fmt_s(lat["p50"])),
+        ("step latency p95", _fmt_s(lat["p95"])),
+        ("step latency p99", _fmt_s(lat["p99"])),
+        ("examples/sec (mean)", _fmt(st["examples_per_sec_mean"])),
+        ("MFU mean / max", f"{_fmt(st['mfu_mean'])} / "
+                           f"{_fmt(st['mfu_max'])}"),
+        ("final train loss", _fmt(st["final_loss"])),
+        ("recompiles total", _fmt(summary.get("recompiles_total"))),
+        ("epochs / evals", f"{summary['epochs']} / {summary['evals']}"),
+        ("best test acc", _fmt(summary.get("best_test_acc"))),
+        ("checkpoints", _fmt(summary.get("checkpoints"))),
+        ("errors", str(len(summary.get("errors", [])))),
+    ]
+    if "heartbeats" in summary:
+        beats = ", ".join(
+            f"p{idx}@{hb.get('ts')}"
+            for idx, hb in summary["heartbeats"].items()
+        )
+        rows.append(("last heartbeats", beats))
+    width = max(len(k) for k, _ in rows)
+    lines = [f"telemetry summary: {summary['path']}"]
+    lines += [f"  {k.ljust(width)}  {v}" for k, v in rows]
+    for err in summary.get("errors", [])[:5]:
+        lines.append(
+            f"  ! {err.get('ts')} {err.get('type')}: {err.get('error')}"
+        )
+    return "\n".join(lines)

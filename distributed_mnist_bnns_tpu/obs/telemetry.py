@@ -1,0 +1,241 @@
+"""Telemetry facade — one object bundling the metrics registry, the
+JSONL event sink, the recompile tracker and the multi-host heartbeat,
+with the derived step metrics (examples/sec, latency percentiles, MFU)
+computed in one place.
+
+The Trainer, the infer paths and bench.py all talk to this class; the
+legacy consumers (AverageMeter wall-time logging, ResultsLog CSV rows)
+keep their outputs unchanged and simply read alongside.
+
+Disabled mode: ``Telemetry()`` with no run directory still maintains the
+in-process metrics registry (cheap) but emits no files — call sites need
+no ``if telemetry:`` guards.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .events import EventLog
+from .flops import device_memory_stats, device_peak_flops, mfu
+from .heartbeat import Heartbeat
+from .recompile import RecompileTracker, get_tracker
+from .registry import MetricsRegistry
+
+EVENTS_FILE = "events.jsonl"
+
+STEP_SECONDS = "train_step_seconds"
+EXAMPLES_TOTAL = "train_examples_total"
+STEPS_TOTAL = "train_steps_total"
+
+
+class Telemetry:
+    """Per-run telemetry. ``run_dir=None`` disables all file outputs.
+
+    The recompile tracker is a process-wide singleton by default
+    (compiles are a process property, not a run property). The registry
+    holding the run's OWN instruments (step histogram, step/example
+    counters) is per-instance by default — a second Trainer in the same
+    process must not report the first run's steps in its epoch events;
+    the process-wide ``default_registry()`` keeps serving the layers
+    whose metrics genuinely span runs (placement timing, decode
+    counters, compiles)."""
+
+    def __init__(
+        self,
+        run_dir: Optional[str] = None,
+        *,
+        registry: Optional[MetricsRegistry] = None,
+        tracker: Optional[RecompileTracker] = None,
+        heartbeat_interval_s: float = 30.0,
+        heartbeat: bool = True,
+    ):
+        self.run_dir = run_dir
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracker = tracker if tracker is not None else get_tracker()
+        self.events: Optional[EventLog] = None
+        self.heartbeat: Optional[Heartbeat] = None
+        self._t0 = time.time()
+        self._last_step_payload: Dict[str, Any] = {}
+        self.step_hist = self.registry.histogram(
+            STEP_SECONDS, "per-optimizer-step wall latency"
+        )
+        self.examples = self.registry.counter(
+            EXAMPLES_TOTAL, "training examples processed"
+        )
+        self.steps = self.registry.counter(
+            STEPS_TOTAL, "optimizer steps run"
+        )
+        if run_dir is not None:
+            os.makedirs(run_dir, exist_ok=True)
+            self.events = EventLog(os.path.join(run_dir, EVENTS_FILE))
+            if heartbeat:
+                self.heartbeat = Heartbeat(
+                    run_dir,
+                    interval_s=heartbeat_interval_s,
+                    payload_fn=lambda: dict(self._last_step_payload),
+                ).start()
+
+    @property
+    def enabled(self) -> bool:
+        return self.run_dir is not None
+
+    # -- lifecycle events ---------------------------------------------------
+
+    def manifest(
+        self, config: Optional[Dict[str, Any]] = None, mesh: Any = None,
+        **extra: Any,
+    ) -> None:
+        if self.events is not None:
+            self.events.manifest(config=config, mesh=mesh, **extra)
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        if self.events is not None:
+            self.events.emit(kind, **fields)
+
+    def error(self, exc: BaseException, **fields: Any) -> None:
+        self.registry.counter(
+            "run_errors_total", "exceptions recorded by telemetry"
+        ).inc(kind=type(exc).__name__)
+        if self.events is not None:
+            self.events.error(exc, **fields)
+
+    def close(self, **final_fields: Any) -> None:
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+            self.heartbeat = None
+        if self.events is not None:
+            self.events.emit(
+                "run_end",
+                wall_seconds=round(time.time() - self._t0, 3),
+                recompiles_total=self.tracker.count,
+                compile_seconds=round(self.tracker.compile_seconds, 3),
+                **final_fields,
+            )
+            self.events.close()
+            self.events = None
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.error(exc)
+        self.close()
+
+    # -- step-level derived metrics -----------------------------------------
+
+    def record_step(
+        self,
+        latency_s: float,
+        *,
+        batch_size: int,
+        n_steps: int = 1,
+        step: Optional[int] = None,
+        step_flops: Optional[float] = None,
+        peak_flops: Optional[float] = None,
+        n_devices: int = 1,
+        metrics: Optional[Dict[str, float]] = None,
+        emit_event: bool = True,
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        """Record one dispatch covering ``n_steps`` optimizer steps of
+        ``batch_size`` examples each, ``latency_s`` being the amortized
+        PER-STEP latency. Updates the histogram/counters, feeds the
+        recompile fallback heuristic, and (when enabled) emits a ``step``
+        event with the derived examples/sec and MFU."""
+        self.step_hist.observe(latency_s)
+        self.steps.inc(n_steps)
+        self.examples.inc(n_steps * batch_size)
+        self.tracker.observe_step(latency_s)
+        examples_per_sec = (
+            batch_size / latency_s if latency_s > 0 else None
+        )
+        payload: Dict[str, Any] = {
+            "latency_s": round(latency_s, 6),
+            "examples_per_sec": (
+                round(examples_per_sec, 2) if examples_per_sec else None
+            ),
+            "n_steps": n_steps,
+            "batch_size": batch_size,
+        }
+        if step is not None:
+            payload["step"] = int(step)
+        step_mfu = mfu(step_flops, latency_s, peak_flops, n_devices)
+        if step_mfu is not None:
+            payload["mfu"] = step_mfu
+        if metrics:
+            payload.update({
+                k: round(float(v), 6) for k, v in metrics.items()
+            })
+        payload.update(extra)
+        self._last_step_payload = {
+            k: payload[k]
+            for k in ("step", "latency_s", "examples_per_sec")
+            if k in payload
+        }
+        if emit_event:
+            self.emit("step", **payload)
+        return payload
+
+    # -- aggregates ---------------------------------------------------------
+
+    def latency_percentiles(self) -> Dict[str, Optional[float]]:
+        return {
+            "p50": self.step_hist.percentile(50),
+            "p95": self.step_hist.percentile(95),
+            "p99": self.step_hist.percentile(99),
+        }
+
+    def epoch(
+        self, epoch: int, metrics: Optional[Dict[str, float]] = None,
+        **extra: Any,
+    ) -> None:
+        """Per-epoch aggregate event: latency percentiles so far, device
+        memory stats where the backend exposes them, and the recompile
+        count (cumulative — a growing number across same-shape epochs is
+        the retrace-storm signal)."""
+        fields: Dict[str, Any] = {
+            "epoch": int(epoch),
+            "latency": {
+                k: round(v, 6) if v is not None else None
+                for k, v in self.latency_percentiles().items()
+            },
+            "steps_total": int(self.steps.total()),
+            "examples_total": int(self.examples.total()),
+            "recompiles_total": self.tracker.count,
+        }
+        mem = device_memory_stats()
+        if mem is not None:
+            fields["device_memory"] = mem
+            for dev, stats in mem.items():
+                if "bytes_in_use" in stats:
+                    self.registry.gauge(
+                        "device_hbm_bytes_in_use", "live HBM per device"
+                    ).set(stats["bytes_in_use"], device=dev)
+        if metrics:
+            fields.update({
+                k: round(float(v), 6) for k, v in metrics.items()
+            })
+        fields.update(extra)
+        self.emit("epoch", **fields)
+
+    def checkpoint(self, epoch: int, path: str, *, best: bool) -> None:
+        self.registry.counter(
+            "checkpoints_total", "checkpoint saves"
+        ).inc()
+        self.emit("checkpoint", epoch=int(epoch), path=path, best=best)
+
+
+def peak_for_default_device(backend: str = "bf16"):
+    """(peak FLOP/s, precision-label) of local device 0 — the MFU
+    denominator per chip (``mfu`` multiplies by n_devices)."""
+    try:
+        import jax
+
+        return device_peak_flops(jax.devices()[0], backend)
+    except Exception:
+        return None, "unknown"

@@ -26,6 +26,7 @@ single-device step, so the Trainer/benchmarks can swap them in freely.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Tuple
 
 import jax
@@ -34,8 +35,18 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import default_registry
 from ..ops.losses import cross_entropy_loss
 from ..train.trainer import TrainState, clamp_latent, make_step_body
+
+# Host-side placement cost per step (device_put dispatch / multi-process
+# global-array assembly) — the piece of DP step time the device profiler
+# cannot see. Feeds the obs registry so the `telemetry` snapshot shows
+# when input placement, not compute, is the bottleneck.
+_place_hist = default_registry().histogram(
+    "host_placement_seconds",
+    "host-side batch placement (shard/replicate/assemble) per call",
+)
 
 
 def _assemble_global(tree: Any, sharding: NamedSharding) -> Any:
@@ -43,12 +54,15 @@ def _assemble_global(tree: Any, sharding: NamedSharding) -> Any:
     contributes the rows its own data pipeline produced (batch_iterator's
     host_id-strided shard); jax stitches them into one global array laid
     out per ``sharding`` without any cross-host copy of the data itself."""
-    return jax.tree.map(
+    t0 = time.perf_counter()
+    out = jax.tree.map(
         lambda x: jax.make_array_from_process_local_data(
             sharding, np.asarray(x)
         ),
         tree,
     )
+    _place_hist.observe(time.perf_counter() - t0, path="assemble_global")
+    return out
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
@@ -81,7 +95,12 @@ def shard_batch(
     sharding = NamedSharding(mesh, P(*([None] * batch_dim), axis))
     if jax.process_count() > 1:
         return _assemble_global(tree, sharding)
-    return jax.device_put(tree, sharding)
+    t0 = time.perf_counter()
+    out = jax.device_put(tree, sharding)
+    # device_put is async: this is the host dispatch cost, the part that
+    # serializes against the python loop.
+    _place_hist.observe(time.perf_counter() - t0, path="shard_batch")
+    return out
 
 
 def make_dp_train_step(

@@ -17,11 +17,14 @@ covered by tests/test_pipeline.py against a single-device loop.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..obs import default_registry
 
 
 def make_pipeline_fn(
@@ -165,10 +168,40 @@ def make_pipeline_fn(
         out_specs=x_spec,
         check_vma=False,
     )
+    # Pipeline-shape telemetry: the schedule's static geometry as gauges
+    # (the analytic bubble is the number a profiler trace should confirm)
+    # plus a per-call counter. Direct callers count dispatches; embedded
+    # in an outer jit (the trainer's pipelined apply) the counter ticks
+    # per TRACE — a growing count across same-shape steps is the retrace
+    # signal. Each call is also a named profiler region.
+    reg = default_registry()
+    reg.gauge("pipeline_stages", "GPipe stage count").set(
+        n_stages, axis=axis
+    )
+    reg.gauge("pipeline_microbatches", "microbatches per step").set(
+        n_micro, axis=axis
+    )
+    reg.gauge(
+        "pipeline_bubble_fraction", "analytic GPipe bubble fraction"
+    ).set(pipeline_bubble_fraction(n_stages, n_micro), axis=axis)
+    calls = reg.counter(
+        "pipeline_calls_total",
+        "pipeline invocations (dispatches, or traces under an outer jit)",
+    )
+
     if stage_takes_rng:
-        return jax.jit(fn)
-    _dummy = jax.random.PRNGKey(0)
-    return jax.jit(lambda p, x: fn(p, x, _dummy))
+        jitted = jax.jit(fn)
+    else:
+        _dummy = jax.random.PRNGKey(0)
+        jitted = jax.jit(lambda p, x: fn(p, x, _dummy))
+
+    @functools.wraps(jitted)
+    def instrumented(*args, **kwargs):
+        calls.inc(axis=axis)
+        with jax.profiler.TraceAnnotation("pipeline_dispatch"):
+            return jitted(*args, **kwargs)
+
+    return instrumented
 
 
 def sequential_reference(

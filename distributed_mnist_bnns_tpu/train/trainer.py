@@ -535,6 +535,11 @@ class TrainConfig:
                                    # scan_steps when set
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     profile_steps: int = 5
+    telemetry_dir: Optional[str] = None  # structured run telemetry (obs/):
+                                   # JSONL events (manifest, step, epoch,
+                                   # checkpoint, error), per-process
+                                   # heartbeats, recompile tracking.
+                                   # None = registry-only (no files).
 
 
 def _prefetch_chunks(items, size: int = 2):
@@ -643,6 +648,7 @@ class Trainer:
             self._setup_data_parallel(loss_fn)
         self.results = ResultsLog(config.results_path or "results.csv")
         self.batch_meter = AverageMeter()
+        self._setup_telemetry(input_shape)
         self._profiled = False  # trace the first epoch this trainer runs
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
@@ -691,6 +697,76 @@ class Trainer:
                     raise
                 mk.pop(bad)
                 log.warning("model %r does not take %r; ignored", name, bad)
+
+    def _setup_telemetry(self, input_shape) -> None:
+        """Wire the run into the obs/ telemetry layer: event sink +
+        heartbeats under ``telemetry_dir`` (registry-only when unset),
+        the analytic step-FLOPs estimate for MFU accounting, and the run
+        manifest (config + mesh topology + versions). Runs after the
+        parallel setup so the manifest records the actual mesh."""
+        import dataclasses
+
+        from ..obs import Telemetry, peak_for_default_device, train_step_flops
+
+        cfg = self.config
+        self.telemetry = Telemetry(cfg.telemetry_dir)
+        # Global batch: each process feeds batch_size examples per step
+        # (the DistributedSampler shard contract of batch_iterator).
+        self._global_batch = cfg.batch_size * jax.process_count()
+        # The jaxpr MAC walk (conv families) costs a forward trace; only
+        # pay it when telemetry files were requested. Registry-only mode
+        # keeps the cheap dense-MAC estimate (exact for MLP/QNN, the
+        # families the headline MFU claims are made on).
+        trace_kwargs = (
+            dict(
+                apply_fn=self.model.apply,
+                variables={
+                    "params": self.state.params,
+                    "batch_stats": self.state.batch_stats,
+                },
+                input_shape=input_shape,
+            )
+            if cfg.telemetry_dir is not None
+            else {}
+        )
+        self._step_flops, self._flops_method = train_step_flops(
+            cfg.model,
+            self.state.params,
+            self._global_batch,
+            **trace_kwargs,
+        )
+        peak_backend = "int8" if cfg.backend == "int8" else "bf16"
+        self._peak_flops, self._peak_precision = peak_for_default_device(
+            peak_backend
+        )
+        self._n_devices = (
+            int(self.mesh.devices.size) if self.mesh is not None
+            else jax.device_count() if jax.process_count() > 1 else 1
+        )
+        self.telemetry.manifest(
+            config=dataclasses.asdict(cfg),
+            mesh=self.mesh,
+            step_flops=self._step_flops,
+            flops_method=self._flops_method,
+            peak_flops=self._peak_flops,
+            peak_precision=self._peak_precision,
+        )
+
+    def _record_step(self, per_step_s: float, n: int, seen: int,
+                     metrics: Optional[Dict[str, float]] = None) -> None:
+        """Step-level derived telemetry: examples/sec, latency histogram,
+        MFU, recompile-fallback feed — one ``step`` event per dispatch
+        (n > 1: a scan chunk, latency amortized as everywhere else)."""
+        self.telemetry.record_step(
+            per_step_s,
+            batch_size=self._global_batch,
+            n_steps=n,
+            step=seen,
+            step_flops=self._step_flops,
+            peak_flops=self._peak_flops,
+            n_devices=self._n_devices,
+            metrics=metrics,
+        )
 
     def _setup_pipeline_parallel(self, loss_fn) -> None:
         """Switch the model's apply to the GPipe pipelined forward over a
@@ -1163,6 +1239,21 @@ class Trainer:
             self._dump_timing_csvs(
                 epoch, [per_batch] * n_batches, epoch_time
             )
+        # One dispatch = the whole epoch: step telemetry is the epoch
+        # time amortized (same convention as the timing CSVs above).
+        self._record_step(
+            per_batch, n_batches, n_batches,
+            {"loss": metrics["loss"], "accuracy": metrics["accuracy"]},
+        )
+        self.telemetry.epoch(
+            epoch,
+            metrics={
+                "train_loss": metrics["loss"],
+                "train_acc": metrics["accuracy"],
+            },
+            epoch_time_s=round(epoch_time, 3),
+            dispatches=1,
+        )
         return {
             "train_loss": metrics["loss"],
             "train_acc": metrics["accuracy"],
@@ -1346,9 +1437,11 @@ class Trainer:
                 )
                 first = seen == 0
                 seen += n
+                synced_metrics = None
                 if first or seen % max(cfg.log_interval, 1) < n:
                     # sync only at log boundaries to keep the pipeline full
                     metrics = jax.tree.map(lambda x: float(x), metrics)
+                    synced_metrics = metrics
                     losses.update(metrics["loss"], n * cfg.batch_size)
                     accs.update(metrics["accuracy"], n * cfg.batch_size)
                     if jax.process_index() == 0:
@@ -1363,6 +1456,7 @@ class Trainer:
                 dt = time.perf_counter() - t0
                 self.batch_meter.update(dt / n, n)
                 batch_times.extend([dt / n] * n)
+                self._record_step(dt / n, n, seen, synced_metrics)
                 # Stop the trace outside the timed region so the sync +
                 # trace-dump I/O doesn't pollute the recorded batch time.
                 if profiling and seen >= cfg.profile_steps:
@@ -1376,6 +1470,11 @@ class Trainer:
         epoch_time = time.perf_counter() - epoch_start
         if cfg.timing_csv_prefix and jax.process_index() == 0:
             self._dump_timing_csvs(epoch, batch_times, epoch_time)
+        self.telemetry.epoch(
+            epoch,
+            metrics={"train_loss": losses.avg, "train_acc": accs.avg},
+            epoch_time_s=round(epoch_time, 3),
+        )
         return {
             "train_loss": losses.avg,
             "train_acc": accs.avg,
@@ -1547,51 +1646,74 @@ class Trainer:
         start_epoch = self.try_resume() if self.config.resume else 0
         for epoch in range(start_epoch, self.config.epochs):
             row: Dict[str, float] = {"epoch": epoch}
-            row.update(train_fn(epoch))
-            if eval_fn is not None and eval_every and (
-                (epoch + 1) % eval_every == 0
-            ):
-                row.update(eval_fn())
-            history.append(row)
-            if self.config.checkpoint_dir:
-                acc = row.get("test_acc", 0.0)
-                is_best = acc > self.best_acc
-                self.best_acc = max(self.best_acc, acc)
-                save = (
-                    self._checkpointer.save
-                    if self._checkpointer is not None
-                    else save_checkpoint
-                )
-                save(
-                    self.state,
-                    self.config.checkpoint_dir,
-                    is_best=is_best,
-                    epoch=epoch,
-                    save_all=self.config.save_all_epochs,
-                    extra_meta={"best_acc": self.best_acc, **{
-                        k: v for k, v in row.items() if isinstance(v, float)
-                    }},
-                )
-                if (
-                    self._checkpointer is not None
-                    and not self.config.async_checkpoint
+            try:
+                row.update(train_fn(epoch))
+                if eval_fn is not None and eval_every and (
+                    (epoch + 1) % eval_every == 0
                 ):
-                    # orbax saves are natively async; without the
-                    # --async-checkpoint opt-in, keep blocking semantics.
-                    self._checkpointer.wait()
-            if jax.process_index() == 0:
-                log.info(
-                    "epoch %d done: %s", epoch,
-                    {k: round(v, 4) for k, v in row.items() if k != "epoch"},
-                )
-                self.results.add(**row)
-                if self.config.results_path:
-                    self.results.save()
+                    eval_row = eval_fn()
+                    row.update(eval_row)
+                    self.telemetry.emit("eval", epoch=epoch, **eval_row)
+                history.append(row)
+                if self.config.checkpoint_dir:
+                    acc = row.get("test_acc", 0.0)
+                    is_best = acc > self.best_acc
+                    self.best_acc = max(self.best_acc, acc)
+                    save = (
+                        self._checkpointer.save
+                        if self._checkpointer is not None
+                        else save_checkpoint
+                    )
+                    save(
+                        self.state,
+                        self.config.checkpoint_dir,
+                        is_best=is_best,
+                        epoch=epoch,
+                        save_all=self.config.save_all_epochs,
+                        extra_meta={"best_acc": self.best_acc, **{
+                            k: v for k, v in row.items()
+                            if isinstance(v, float)
+                        }},
+                    )
+                    self.telemetry.checkpoint(
+                        epoch, self.config.checkpoint_dir, best=is_best
+                    )
+                    if (
+                        self._checkpointer is not None
+                        and not self.config.async_checkpoint
+                    ):
+                        # orbax saves are natively async; without the
+                        # --async-checkpoint opt-in, keep blocking
+                        # semantics.
+                        self._checkpointer.wait()
+                if jax.process_index() == 0:
+                    log.info(
+                        "epoch %d done: %s", epoch,
+                        {k: round(v, 4) for k, v in row.items()
+                         if k != "epoch"},
+                    )
+                    self.results.add(**row)
+                    if self.config.results_path:
+                        self.results.save()
+            except Exception as e:
+                # Bank the failure in the event log (post-mortem trail)
+                # and seal it — close() stops the heartbeat thread, so a
+                # crashed run stops reporting "alive" the moment it dies
+                # — before the crash propagates; fit's error contract is
+                # unchanged. The whole epoch body is covered: a
+                # checkpoint-save or results-IO failure must leave the
+                # same trail as a train-step one.
+                self.telemetry.error(e, epoch=epoch)
+                self.telemetry.close(crashed=True, epochs=len(history))
+                raise
         if self._checkpointer is not None:
             # Join the last async write (and re-raise any IO error) before
             # reporting the run finished — fit's contract is "checkpoints
             # on disk", async or not.
             self._checkpointer.wait()
+        # Seal the event log: run_end carries the final recompile count
+        # and wall time; heartbeats stop with one last beat.
+        self.telemetry.close(epochs=len(history))
         return history
 
     def _dump_timing_csvs(self, epoch, batch_times, epoch_time) -> None:

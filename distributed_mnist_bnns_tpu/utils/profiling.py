@@ -45,11 +45,23 @@ class StepTimer:
 
     sync=False measures dispatch time only (keeps the device pipeline
     full — the right default in a hot loop); sync=True blocks on the given
-    arrays for true step latency (use at log boundaries / benchmarks)."""
+    arrays for true step latency (use at log boundaries / benchmarks).
 
-    def __init__(self) -> None:
+    ``metric``: a metric name feeds every stop() into the obs registry's
+    histogram of that name (obs/registry.py), so ad-hoc timers and the
+    telemetry layer read from one store — percentiles included."""
+
+    def __init__(self, metric: Optional[str] = None, **labels: str) -> None:
         self.meter = AverageMeter()
         self._t0: Optional[float] = None
+        self._hist = None
+        self._labels = labels
+        if metric is not None:
+            from ..obs import default_registry
+
+            self._hist = default_registry().histogram(
+                metric, "StepTimer wall-clock latency"
+            )
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
@@ -59,6 +71,8 @@ class StepTimer:
             jax.block_until_ready(sync_on)
         dt = time.perf_counter() - (self._t0 or time.perf_counter())
         self.meter.update(dt)
+        if self._hist is not None:
+            self._hist.observe(dt, **self._labels)
         return dt
 
     @property

@@ -113,9 +113,11 @@ def main() -> None:
                         "training banks immediately, so a run killed "
                         "mid-study (window close, watchdog) resumes "
                         "from the completed variants instead of "
-                        "retraining them. Keyed on config only — after "
-                        "a model/training code change pass --cache '' "
-                        "(disables) or delete the file to remeasure")
+                        "retraining them. Keyed on config + a sha256 of "
+                        "the corpus content + a fingerprint of the "
+                        "model/eval code, so corpus edits and code "
+                        "changes miss instead of replaying stale "
+                        "results; pass --cache '' to disable")
     args = p.parse_args()
     if args.context < 1 or args.context >= args.seq_len:
         p.error(
@@ -136,16 +138,34 @@ def main() -> None:
     from distributed_mnist_bnns_tpu.models.transformer import BinarizedLM
     from distributed_mnist_bnns_tpu.train import clamp_latent
 
-    data = np.frombuffer(open(CORPUS, "rb").read(), np.uint8)
+    raw = open(CORPUS, "rb").read()
+    data = np.frombuffer(raw, np.uint8)
     split = int(len(data) * 0.9)
     train, valid = data[:split], data[split:]
     t = args.seq_len
 
+    import hashlib
+
+    import distributed_mnist_bnns_tpu.models.transformer as _tf_mod
+
+    # Cache-key integrity: a byte-length-only corpus identity silently
+    # replays stale results after an equal-length corpus edit, and no
+    # code identity replays them after a model change. Hash the corpus
+    # CONTENT and fingerprint the model + eval code (the two files whose
+    # edits change the numbers); the git rev alone would miss dirty-tree
+    # runs.
+    corpus_sha = hashlib.sha256(raw).hexdigest()[:16]
+    code_fp = hashlib.sha256()
+    for src in (_tf_mod.__file__, os.path.abspath(__file__)):
+        with open(src, "rb") as f:
+            code_fp.update(f.read())
     cfg_key = json.dumps(
         {"embed_dim": args.embed_dim, "depth": args.depth, "seq_len": t,
          "steps": args.steps, "batch": args.batch, "lr": args.lr,
          "heads": args.num_heads, "seed": args.seed,
-         "context": args.context, "corpus_bytes": int(len(data))},
+         "context": args.context, "corpus_bytes": int(len(data)),
+         "corpus_sha256": corpus_sha,
+         "code_fingerprint": code_fp.hexdigest()[:16]},
         sort_keys=True,
     )
     cache = {}

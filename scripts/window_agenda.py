@@ -206,12 +206,10 @@ def _run_step(name: str, argv: list, timeout_s: float) -> tuple:
 # Sections a partial bench record can contribute independently of its
 # headline number (the serving-only tier may post a lower headline than
 # the headline tier but carry the only serving block). Every other key
-# is headline block, replaced as a unit by a better headline — no
-# second whitelist to keep in sync with bench.py's record shape.
-_MERGE_KEYS = (
-    "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
-    "device_resident_epoch", "train_step_per_backend",
-)
+# is headline block, replaced as a unit by a better headline. The tuple
+# itself lives in bench.py (SECTION_MERGE_KEYS) so this merge and
+# bench.py's dead-endpoint carry-over can never drift apart again.
+_MERGE_KEYS = bench.SECTION_MERGE_KEYS
 
 
 def _keep_best_bench(stdout: str):

@@ -1,0 +1,384 @@
+"""Telemetry-subsystem tests (obs/): registry semantics, JSONL event
+round-trip + manifest contents, recompile tracking through a forced
+retrace, heartbeat rotation, the `telemetry` CLI summary, and the
+trainer acceptance smoke (manifest + step events with latency /
+examples-per-sec / MFU). CPU-only, fast."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_mnist_bnns_tpu.obs import (
+    EventLog,
+    Heartbeat,
+    MetricsRegistry,
+    RecompileTracker,
+    Telemetry,
+    get_tracker,
+    load_events,
+    mfu,
+    read_heartbeats,
+    summarize,
+    train_step_flops,
+)
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_counter_gauge_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs", "requests")
+    c.inc()
+    c.inc(2.0, backend="xla")
+    assert c.value() == 1.0
+    assert c.value(backend="xla") == 2.0
+    assert c.total() == 3.0
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+    g = reg.gauge("hbm", "bytes")
+    g.set(5.0, device="0")
+    g.set(7.0, device="0")  # gauge: last write wins
+    assert g.value(device="0") == 7.0
+    assert g.value(device="1") is None
+    # get-or-create returns the same instrument; kind conflicts raise
+    assert reg.counter("reqs") is c
+    with pytest.raises(ValueError):
+        reg.gauge("reqs")
+
+
+def test_registry_histogram_percentiles_and_snapshot():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=[0.01, 0.1, 1.0, 10.0])
+    for v in [0.02] * 90 + [5.0] * 10:
+        h.observe(v)
+    assert h.count() == 100
+    assert h.mean() == pytest.approx(0.02 * 0.9 + 5.0 * 0.1)
+    p50, p99 = h.percentile(50), h.percentile(99)
+    assert 0.01 <= p50 <= 0.1     # inside the bucket holding the median
+    assert 1.0 <= p99 <= 5.0      # tail capped by the exact max
+    snap = reg.snapshot()
+    assert snap["lat"]["type"] == "histogram"
+    series = snap["lat"]["series"][0]
+    assert series["count"] == 100 and sum(series["bucket_counts"]) == 100
+    assert snap["lat"]["buckets"] == [0.01, 0.1, 1.0, 10.0]
+
+
+def test_registry_thread_safety():
+    import threading
+
+    reg = MetricsRegistry()
+    c = reg.counter("n")
+    h = reg.histogram("t", buckets=[1.0])
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total() == 8000
+    assert h.count() == 8000
+
+
+# -- events ------------------------------------------------------------------
+
+
+def test_event_log_roundtrip_and_manifest(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as ev:
+        ev.manifest(config={"model": "bnn-mlp-small", "batch_size": 32})
+        ev.manifest(config={"model": "other"})  # ignored: manifest-once
+        ev.emit("step", step=1, latency_s=0.01, loss=0.5)
+        ev.error(ValueError("boom"), epoch=0)
+    events = load_events(path)
+    assert [e["kind"] for e in events] == ["run_manifest", "step", "error"]
+    man = events[0]
+    assert man["v"] == 1 and man["ts"].endswith("Z")
+    assert man["config"]["model"] == "bnn-mlp-small"
+    assert man["jax_version"] == jax.__version__
+    assert man["topology"]["backend"] == "cpu"
+    assert man["topology"]["local_device_count"] == 8
+    assert "python_version" in man and "hostname" in man
+    step = events[1]
+    assert step["step"] == 1 and step["latency_s"] == 0.01
+    err = events[2]
+    assert err["error_type"] == "ValueError" and "boom" in err["error"]
+
+
+def test_event_log_skips_malformed_lines(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as ev:
+        ev.emit("step", step=1)
+    with open(path, "a") as f:
+        f.write('{"kind": "step", "trunc')  # crash mid-write
+    assert [e["kind"] for e in load_events(path)] == ["step"]
+
+
+def test_event_log_primary_only(tmp_path, monkeypatch):
+    import distributed_mnist_bnns_tpu.obs.events as events_mod
+
+    monkeypatch.setattr(events_mod, "is_primary_host", lambda: False)
+    path = str(tmp_path / "events.jsonl")
+    ev = EventLog(path)
+    ev.emit("step", step=1)
+    ev.close()
+    assert not os.path.exists(path)  # non-primary: no file at all
+
+
+# -- recompile tracking ------------------------------------------------------
+
+
+def test_recompile_tracker_counts_forced_retrace():
+    reg = MetricsRegistry()
+    tracker = RecompileTracker(registry=reg).install()
+    assert tracker.listener_available  # jax.monitoring present
+    before = tracker.mark()
+
+    @jax.jit
+    def f(x):
+        return (x * 2.0).sum()
+
+    f(jnp.ones((4, 4)))               # compile 1
+    mid = tracker.count
+    assert mid >= before + 1
+    f(jnp.ones((4, 4)))               # cache hit: no new compile
+    assert tracker.count == mid
+    f(jnp.ones((8, 8)))               # shape change forces a retrace
+    assert tracker.count >= mid + 1
+    assert tracker.compile_seconds > 0
+    assert reg.counter("jax_backend_compiles_total").total() \
+        == tracker.count
+
+
+def test_recompile_spike_fallback():
+    tracker = RecompileTracker(registry=MetricsRegistry(),
+                               spike_factor=10.0)
+    # listener never installed -> heuristic active
+    assert not tracker.listener_available
+    for _ in range(10):
+        assert not tracker.observe_step(0.01)
+    assert tracker.observe_step(1.0)  # 100x median: suspected recompile
+    assert tracker.count == 1
+    # with a live listener the heuristic must stay silent
+    live = RecompileTracker(registry=MetricsRegistry())
+    live.listener_available = True
+    for _ in range(10):
+        live.observe_step(0.01)
+    assert not live.observe_step(5.0)
+    assert live.count == 0
+
+
+# -- heartbeat ---------------------------------------------------------------
+
+
+def test_heartbeat_files_and_rotation(tmp_path):
+    hb = Heartbeat(str(tmp_path), payload_fn=lambda: {"step": 7},
+                   max_lines=5)
+    for _ in range(20):
+        hb.beat()
+    state = json.load(open(hb.state_path))
+    assert state["kind"] == "heartbeat" and state["beat"] == 20
+    assert state["step"] == 7 and state["process_index"] == 0
+    lines = open(hb.history_path).read().splitlines()
+    assert len(lines) <= 2 * 5       # rotated: bounded history
+    assert json.loads(lines[-1])["beat"] == 20  # newest survives
+    latest = read_heartbeats(str(tmp_path))
+    assert latest[0]["beat"] == 20
+
+
+def test_heartbeat_thread_start_stop(tmp_path):
+    hb = Heartbeat(str(tmp_path), interval_s=0.01)
+    with hb:
+        pass
+    assert os.path.exists(hb.state_path)  # stop() takes a final beat
+
+
+# -- telemetry facade --------------------------------------------------------
+
+
+def test_telemetry_record_step_derives_metrics(tmp_path):
+    reg = MetricsRegistry()
+    tel = Telemetry(str(tmp_path), registry=reg, heartbeat=False)
+    payload = tel.record_step(
+        0.05, batch_size=64, n_steps=1, step=3,
+        step_flops=1e9, peak_flops=1e12,
+        metrics={"loss": 0.5},
+    )
+    assert payload["examples_per_sec"] == pytest.approx(1280.0)
+    assert payload["mfu"] == pytest.approx(1e9 / 0.05 / 1e12, rel=1e-3)
+    assert payload["loss"] == 0.5
+    tel.epoch(0, metrics={"train_loss": 0.4})
+    tel.close()
+    events = load_events(str(tmp_path / "events.jsonl"))
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["step", "epoch", "run_end"]
+    assert events[1]["latency"]["p50"] is not None
+    assert isinstance(events[1]["recompiles_total"], int)
+    assert "recompiles_total" in events[2]
+    assert reg.counter("train_examples_total").total() == 64
+
+
+def test_telemetry_disabled_mode_is_nofile():
+    reg = MetricsRegistry()
+    tel = Telemetry(None, registry=reg)
+    tel.manifest(config={})
+    tel.record_step(0.01, batch_size=8)
+    tel.close()
+    assert reg.counter("train_steps_total").total() == 1
+
+
+def test_mfu_and_flops_helpers():
+    assert mfu(1e9, 1e-3, 1e12) == pytest.approx(1.0)
+    assert mfu(None, 1e-3, 1e12) is None
+    assert mfu(1e9, 1e-3, 1e12, n_devices=2) == pytest.approx(0.5)
+    import numpy as np
+
+    params = {"a": {"kernel": np.zeros((4, 8)), "bias": np.zeros(8)}}
+    flops, method = train_step_flops("bnn-mlp-x", params, 16)
+    assert flops == 3.0 * 2.0 * 32 * 16
+    assert method == "analytic_3x_dense_gemms"
+
+
+def test_step_timer_feeds_registry():
+    from distributed_mnist_bnns_tpu.obs import default_registry
+    from distributed_mnist_bnns_tpu.utils.profiling import StepTimer
+
+    t = StepTimer(metric="test_obs_timer_seconds", phase="unit")
+    t.start()
+    t.stop()
+    h = default_registry().histogram("test_obs_timer_seconds")
+    assert h.count(phase="unit") >= 1
+
+
+# -- summary + CLI -----------------------------------------------------------
+
+
+def _write_synthetic_log(tmp_path) -> str:
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as ev:
+        ev.manifest(config={"model": "bnn-mlp-small"})
+        for i in range(10):
+            ev.emit("step", step=i + 1, latency_s=0.01 * (i + 1),
+                    examples_per_sec=1000.0, mfu=0.25, batch_size=32,
+                    n_steps=1, loss=1.0 / (i + 1))
+        ev.emit("epoch", epoch=0, recompiles_total=3)
+        ev.emit("eval", epoch=0, test_acc=97.5)
+        ev.emit("checkpoint", epoch=0, path="ck", best=True)
+        ev.emit("run_end", recompiles_total=3, wall_seconds=1.5)
+    return path
+
+
+def test_summarize_synthetic_log(tmp_path):
+    path = _write_synthetic_log(tmp_path)
+    s = summarize(path)
+    assert s["manifest_count"] == 1
+    assert s["steps"]["count"] == 10
+    assert s["steps"]["examples"] == 320
+    assert s["steps"]["latency_s"]["p50"] == pytest.approx(0.055)
+    assert s["steps"]["latency_s"]["p95"] == pytest.approx(0.0955)
+    assert s["steps"]["mfu_mean"] == pytest.approx(0.25)
+    assert s["recompiles_total"] == 3
+    assert s["best_test_acc"] == 97.5
+    assert s["checkpoints"] == 1
+    assert s["steps"]["final_loss"] == pytest.approx(0.1)
+
+
+def test_summarize_reports_latest_run_and_weighted_rates(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as ev:
+        ev.manifest(config={"model": "old"})
+        ev.emit("step", latency_s=1.0, batch_size=1, n_steps=1, mfu=0.9)
+        ev.emit("run_end", recompiles_total=9)
+    with EventLog(path) as ev2:  # reused dir: second run appends
+        ev2.manifest(config={"model": "new"})
+        ev2.emit("step", latency_s=0.5, batch_size=2, n_steps=1, mfu=0.2)
+        ev2.emit("step", latency_s=1.5, batch_size=2, n_steps=1, mfu=0.1)
+        ev2.emit("run_end", recompiles_total=1)
+    s = summarize(path)
+    # latest run only: the old run's config/steps must not bleed in
+    assert s["run"]["model"] == "new"
+    assert s["steps"]["count"] == 2 and s["steps"]["examples"] == 4
+    assert s["recompiles_total"] == 1
+    # rates weight by recorded time (telescoping), not mean-of-ratios
+    assert s["steps"]["examples_per_sec_mean"] == pytest.approx(4 / 2.0)
+    assert s["steps"]["mfu_mean"] == pytest.approx(
+        (0.2 * 0.5 + 0.1 * 1.5) / 2.0
+    )
+
+
+def test_cli_telemetry_table_and_json(tmp_path, capsys):
+    from distributed_mnist_bnns_tpu.cli import main
+
+    path = _write_synthetic_log(tmp_path)
+    assert main(["telemetry", path]) == 0
+    out = capsys.readouterr().out
+    assert "step latency p50" in out and "55.00 ms" in out
+    assert "step latency p95" in out
+    assert "recompiles total" in out and " 3" in out
+    # directory form resolves to events.jsonl inside it
+    assert main(["telemetry", str(tmp_path), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["steps"]["count"] == 10 and s["recompiles_total"] == 3
+    assert main(["telemetry", str(tmp_path / "missing.jsonl")]) == 2
+
+
+# -- trainer acceptance smoke ------------------------------------------------
+
+
+def test_trainer_telemetry_end_to_end(tmp_path, capsys):
+    """The ISSUE acceptance criterion: a 1-epoch tiny-MLP CPU run writes
+    a JSONL log with exactly one run manifest plus per-step events
+    carrying latency, examples/sec and a nonzero MFU; the telemetry CLI
+    summarizes it; and a forced shape change bumps the recompile
+    counter."""
+    from distributed_mnist_bnns_tpu.cli import main
+    from distributed_mnist_bnns_tpu.data import load_mnist
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    td = str(tmp_path / "telemetry")
+    data = load_mnist("/nonexistent", synthetic_sizes=(128, 32))
+    trainer = Trainer(
+        TrainConfig(model="bnn-mlp-small", epochs=1, batch_size=32,
+                    backend="xla", telemetry_dir=td, log_interval=1)
+    )
+    trainer.fit(data)
+    path = os.path.join(td, "events.jsonl")
+    events = load_events(path)
+    manifests = [e for e in events if e["kind"] == "run_manifest"]
+    assert len(manifests) == 1
+    assert manifests[0]["config"]["model"] == "bnn-mlp-small"
+    assert manifests[0]["step_flops"] > 0
+    steps = [e for e in events if e["kind"] == "step"]
+    assert len(steps) == 4  # 128 examples / batch 32
+    for s in steps:
+        assert s["latency_s"] > 0
+        assert s["examples_per_sec"] > 0
+        assert s["mfu"] > 0
+    assert any(e["kind"] == "epoch" for e in events)
+    assert events[-1]["kind"] == "run_end"
+    # heartbeats: per-process liveness files exist alongside the log
+    assert read_heartbeats(td)[0]["beat"] >= 1
+
+    # CLI summary over the real run
+    assert main(["telemetry", td]) == 0
+    out = capsys.readouterr().out
+    assert "step latency p50" in out and "recompiles total" in out
+
+    # a shape change through the live tracker forces a retrace
+    tracker = get_tracker()
+    before = tracker.count
+    trainer.train_step(
+        trainer.state,
+        jnp.zeros((16, 28, 28, 1), jnp.float32),  # batch 16 != 32
+        jnp.zeros((16,), jnp.int32),
+        trainer.rng,
+    )
+    assert tracker.count > before

@@ -155,3 +155,8 @@ def test_persistent_compilation_cache_repo_root_default(monkeypatch):
         assert os.environ["JAX_COMPILATION_CACHE_DIR"] == got
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
+        # The helper set the env var directly (not via monkeypatch), so
+        # drop it here or it leaks into every later test when it was
+        # originally unset; when it WAS set, monkeypatch's teardown
+        # restores the original value after this pop.
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
