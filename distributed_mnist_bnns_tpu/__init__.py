@@ -22,6 +22,12 @@ utils     : logging, meters, results CSV/HTML, (async) checkpointing,
             recovery, profiling, accuracy.
 native    : C++ data runtime (idx/CIFAR decode, bitpack, threaded
             BatchPool) via ctypes.
+obs       : unified telemetry — metrics registry, JSONL run events,
+            MFU accounting, recompile tracking, heartbeats
+            (OBSERVABILITY.md).
+analysis  : JAX-footgun linter (cli lint, rules JG001-JG006) and
+            runtime sanitizer fences (recompile budget, transfer
+            guard, NaN fence — ANALYSIS.md).
 infer     : frozen packed-weight serving — MLP/conv (XNOR-net
             BN-threshold folding) and transformer families (vit + causal
             LM with KV-cache incremental decoding); export/load

@@ -1,0 +1,34 @@
+"""Static analysis + runtime sanitizers for JAX footguns.
+
+Two halves (ANALYSIS.md is the user-facing catalog):
+
+* ``analysis.lint`` — an AST linter with repo-tailored rules
+  (JG001-JG006): host syncs inside traced functions, PRNG-key hygiene,
+  jit-boundary hygiene (donation, static-arg hashability, shard_map
+  closures), python control flow on tracers, silent broad excepts, and
+  direct ``jax.shard_map`` use bypassing the version shim. Run it via
+  ``python -m distributed_mnist_bnns_tpu.cli lint``; CI fails on any
+  unsuppressed finding.
+
+* ``analysis.guards`` — opt-in runtime ``Sanitizer``: a recompile fence
+  (obs/recompile counts over budget become hard errors), a transfer
+  guard (``jax.transfer_guard('disallow')`` around the jitted step), and
+  a NaN/inf fence on the loss. Threaded through ``TrainConfig.sanitize``
+  and the ``JG_SANITIZE`` env var (how CI runs tier-1).
+"""
+
+from .guards import (
+    NaNFenceError,
+    RecompileFenceError,
+    Sanitizer,
+    SanitizerConfig,
+    SanitizerError,
+)
+
+__all__ = [
+    "NaNFenceError",
+    "RecompileFenceError",
+    "Sanitizer",
+    "SanitizerConfig",
+    "SanitizerError",
+]

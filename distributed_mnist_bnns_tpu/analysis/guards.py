@@ -1,0 +1,275 @@
+"""Runtime sanitizers — promote silent performance/correctness rot into
+hard, attributed errors. Opt-in (training pays nothing by default).
+
+Three fences, composable via ``SanitizerConfig`` / ``JG_SANITIZE``:
+
+* **recompile fence** — obs/recompile already *counts* XLA backend
+  compiles; the fence marks a baseline once the step functions have
+  warmed up and raises ``RecompileFenceError`` when post-warmup compiles
+  exceed a budget. A shape-polymorphic step that silently retraces every
+  batch is a minutes-per-step disaster on a remote-compile backend; in
+  tests/CI it should fail loudly instead (OBSERVABILITY.md documents the
+  budget convention).
+* **transfer guard** — wraps the jitted step dispatch in
+  ``jax.transfer_guard("disallow")`` so an implicit host->device
+  transfer (a numpy batch leaking into the hot path, a closure constant
+  being re-uploaded) raises instead of quietly serializing PCIe/ICI
+  against the step.
+* **NaN fence** — every ``nan_check_every`` steps, checks the step's
+  loss/metrics (and optionally any pytree via ``check_finite``) for
+  NaN/inf, emitting a structured ``sanitizer_trip`` obs event before
+  raising ``NaNFenceError`` — the post-mortem trail shows *when* the
+  loss went bad, not just that a later checkpoint was garbage.
+
+Every trip increments the ``sanitizer_trips_total`` counter and (when a
+telemetry sink is attached) emits a ``sanitizer_trip`` event before
+raising, so a fenced CI failure is diagnosable from the event log alone.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Any, Iterator, Mapping, Optional
+
+TRIPS_TOTAL = "sanitizer_trips_total"
+
+_ENV_ENABLE = "JG_SANITIZE"          # e.g. "recompile,transfer,nan"
+_ENV_BUDGET = "JG_RECOMPILE_BUDGET"  # int, post-warmup compile budget
+_ENV_NAN_EVERY = "JG_NAN_EVERY"      # int, NaN-fence stride
+
+
+class SanitizerError(RuntimeError):
+    """Base class for sanitizer trips."""
+
+
+class RecompileFenceError(SanitizerError):
+    pass
+
+
+class NaNFenceError(SanitizerError):
+    pass
+
+
+@dataclasses.dataclass
+class SanitizerConfig:
+    recompile_fence: bool = False
+    recompile_budget: int = 16  # post-warmup compiles allowed per run
+    warmup_steps: int = 3       # compiles before this step are free
+    transfer_guard: bool = False
+    nan_fence: bool = False
+    nan_check_every: int = 50
+
+    @property
+    def enabled(self) -> bool:
+        return self.recompile_fence or self.transfer_guard or self.nan_fence
+
+    @classmethod
+    def from_spec(
+        cls,
+        spec: Optional[str],
+        *,
+        recompile_budget: Optional[int] = None,
+        nan_check_every: Optional[int] = None,
+    ) -> "SanitizerConfig":
+        """Parse a comma list like ``"recompile,transfer,nan"`` (empty /
+        None -> all fences off)."""
+        cfg = cls()
+        for item in (spec or "").split(","):
+            item = item.strip().lower()
+            if not item:
+                continue
+            if item in ("recompile", "recompiles", "recompile_fence"):
+                cfg.recompile_fence = True
+            elif item in ("transfer", "transfers", "transfer_guard"):
+                cfg.transfer_guard = True
+            elif item in ("nan", "nans", "nan_fence"):
+                cfg.nan_fence = True
+            else:
+                raise ValueError(
+                    f"unknown sanitizer {item!r} "
+                    "(have: recompile, transfer, nan)"
+                )
+        if recompile_budget is not None:
+            cfg.recompile_budget = int(recompile_budget)
+        if nan_check_every is not None:
+            cfg.nan_check_every = max(int(nan_check_every), 1)
+        return cfg
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] = os.environ) -> "SanitizerConfig":
+        """The CI/tests activation path: ``JG_SANITIZE=recompile`` turns
+        the fence on for every Trainer in the process without touching
+        call sites."""
+        return cls.from_spec(
+            env.get(_ENV_ENABLE),
+            recompile_budget=(
+                int(env[_ENV_BUDGET]) if env.get(_ENV_BUDGET) else None
+            ),
+            nan_check_every=(
+                int(env[_ENV_NAN_EVERY]) if env.get(_ENV_NAN_EVERY) else None
+            ),
+        )
+
+
+class Sanitizer:
+    """Per-run guard state. Thread one instance through a training run
+    (the Trainer builds its own from ``TrainConfig.sanitize``, falling
+    back to ``SanitizerConfig.from_env()``)."""
+
+    def __init__(
+        self,
+        config: Optional[SanitizerConfig] = None,
+        *,
+        telemetry: Any = None,
+        registry: Any = None,
+    ):
+        self.config = config or SanitizerConfig()
+        self.telemetry = telemetry
+        if registry is None:
+            from ..obs import default_registry
+
+            registry = default_registry()
+        self._trips = registry.counter(
+            TRIPS_TOTAL, "sanitizer fence trips (kind=recompile|nan)"
+        )
+        self._tracker = None
+        self._baseline: Optional[int] = None
+        self._steps = 0
+        if self.config.recompile_fence:
+            from ..obs import get_tracker
+
+            self._tracker = get_tracker()
+
+    @property
+    def active(self) -> bool:
+        return self.config.enabled
+
+    # -- transfer guard -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def guard_transfers(self) -> Iterator[None]:
+        """``jax.transfer_guard_host_to_device("disallow")`` while
+        enabled, else a no-op. Wrap ONLY the jitted dispatch with
+        device-resident arguments — host reads of the results belong
+        outside. Device-to-device stays allowed: GSPMD resharding (e.g.
+        placing a fresh state onto the mesh on the first step) is a
+        legitimate, one-off transfer; the footgun this fence exists for
+        is host batches/constants leaking into the hot path."""
+        if not self.config.transfer_guard:
+            yield
+            return
+        import jax
+
+        with jax.transfer_guard_host_to_device("disallow"):
+            yield
+
+    # -- step-driven fences (recompile + NaN) --------------------------------
+
+    def after_step(
+        self,
+        step: Optional[int] = None,
+        metrics: Any = None,
+        *,
+        n_steps: int = 1,
+    ) -> None:
+        """Feed one finished dispatch covering ``n_steps`` optimizer
+        steps (a scan chunk / whole-epoch program advances by its chunk
+        size). ``step`` defaults to an internal counter; ``metrics`` is
+        the step's metrics dict (device scalars are fine — they are only
+        synced on NaN-check strides)."""
+        n_steps = max(int(n_steps), 1)
+        self._steps += n_steps
+        step = self._steps if step is None else int(step)
+        cfg = self.config
+        if cfg.recompile_fence and self._tracker is not None:
+            if self._baseline is None:
+                if step >= cfg.warmup_steps:
+                    self._baseline = self._tracker.count
+            else:
+                excess = self._tracker.count - self._baseline
+                if excess > cfg.recompile_budget:
+                    self._trip(
+                        "recompile",
+                        RecompileFenceError(
+                            f"{excess} backend compiles after warmup "
+                            f"(step {step}) exceed the budget of "
+                            f"{cfg.recompile_budget} — a shape/static-arg "
+                            "leak is retracing the hot path (see obs/"
+                            "recompile + OBSERVABILITY.md)"
+                        ),
+                        step=step,
+                        excess=excess,
+                        budget=cfg.recompile_budget,
+                    )
+        # Stride test is "did this dispatch cross a check boundary" (the
+        # trainer's log-interval idiom), not exact divisibility — a scan
+        # chunk advancing by S would otherwise only check on multiples
+        # of lcm(S, stride), i.e. possibly never.
+        if (
+            cfg.nan_fence
+            and metrics is not None
+            and step % max(cfg.nan_check_every, 1) < n_steps
+        ):
+            self.check_finite(metrics, step=step)
+
+    def check_finite(self, tree: Any, *, step: Optional[int] = None) -> None:
+        """Raise ``NaNFenceError`` if any float leaf of ``tree`` holds a
+        NaN/inf. Forces a host sync — that is the point; call it on the
+        fence stride, not every step."""
+        if not self.config.nan_fence:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        bad = []
+        for path, leaf in _named_leaves(tree):
+            try:
+                arr = jnp.asarray(leaf)
+            except (TypeError, ValueError):
+                continue
+            if not jnp.issubdtype(arr.dtype, jnp.inexact):
+                continue
+            if not bool(jax.device_get(jnp.all(jnp.isfinite(arr)))):
+                bad.append(path or "<value>")
+        if bad:
+            self._trip(
+                "nan",
+                NaNFenceError(
+                    f"non-finite value(s) at step {step}: "
+                    f"{', '.join(bad[:8])}"
+                    + (" …" if len(bad) > 8 else "")
+                    + " — loss/grads went NaN/inf (check LR, loss scale, "
+                    "binarization clamp)"
+                ),
+                step=step,
+                leaves=bad[:8],
+            )
+
+    # -- shared trip path ----------------------------------------------------
+
+    def _trip(self, kind: str, error: SanitizerError, **fields: Any) -> None:
+        self._trips.inc(kind=kind)
+        if self.telemetry is not None:
+            try:
+                self.telemetry.emit(
+                    "sanitizer_trip", fence=kind,
+                    error=str(error)[:500], **fields,
+                )
+            except (AttributeError, OSError, TypeError, ValueError):
+                pass  # the trip error itself must still propagate
+        raise error
+
+
+def _named_leaves(tree: Any, prefix: str = "") -> Iterator[tuple]:
+    """(dotted-path, leaf) pairs without requiring jax tree utils on
+    plain dict/list metrics."""
+    if isinstance(tree, Mapping):
+        for k, v in tree.items():
+            yield from _named_leaves(v, f"{prefix}.{k}" if prefix else str(k))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _named_leaves(v, f"{prefix}[{i}]")
+    else:
+        yield prefix, tree

@@ -1,0 +1,23 @@
+"""AST-based JAX-footgun linter (rules JG001-JG006). See ANALYSIS.md."""
+
+from .core import (
+    Finding,
+    LintModule,
+    fix_suppressions,
+    format_human,
+    format_json,
+    run_paths,
+    run_source,
+)
+from .rules import RULES
+
+__all__ = [
+    "Finding",
+    "LintModule",
+    "RULES",
+    "fix_suppressions",
+    "format_human",
+    "format_json",
+    "run_paths",
+    "run_source",
+]

@@ -1,0 +1,414 @@
+"""Linter engine: AST analysis shared by the rules, suppression comments,
+file walking and output formatting.
+
+The rules themselves live in ``rules.py``; this module gives them a
+parsed, pre-analyzed view of one source file (``LintModule``) with the
+JAX-specific groundwork done once:
+
+* a parent map over the AST,
+* per-scope name -> FunctionDef/Lambda/assignment resolution,
+* the set of *traced* function definitions — functions that run under a
+  trace (``jax.jit``/``pmap``/``shard_map`` wrapping or decoration,
+  ``lax.scan``/``fori_loop``/``while_loop``/``cond`` bodies), plus
+  everything lexically nested inside one.
+
+Suppression syntax (checked by tests/test_analysis.py)::
+
+    x = float(y)  # jg: disable=JG001 -- y is a static python scalar here
+
+A ``# jg: disable=...`` comment suppresses the listed rules (or ``all``)
+on its own line; a comment-only line suppresses them on the next code
+line. The ``--`` reason is mandatory — an unexplained suppression is
+itself reported as unsuppressable ``JG000``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*jg:\s*disable=(?P<rules>[A-Za-z0-9,* ]+?)"
+    r"\s*(?:--\s*(?P<reason>.*\S))?\s*$"
+)
+
+#: callables whose function-valued arguments run under a trace:
+#: name-of-last-dotted-segment -> indices of the traced arguments.
+TRACING_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,),
+    "pmap": (0,),
+    "shard_map": (0,),
+    "scan": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "cond": (1, 2),
+    "switch": (1, 2, 3, 4, 5),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "vmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        if self.reason is None:
+            d.pop("reason")
+        return d
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.random.PRNGKey' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def parse_suppressions(lines: Sequence[str]) -> Dict[int, Tuple[Set[str], Optional[str]]]:
+    """1-based line -> (rule ids or {'all'}, reason). A comment-only
+    suppression line covers the next line as well."""
+    out: Dict[int, Tuple[Set[str], Optional[str]]] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {
+            r.strip().upper() if r.strip() != "all" else "all"
+            for r in m.group("rules").replace("*", "all").split(",")
+            if r.strip()
+        }
+        entry = (rules, m.group("reason"))
+        out[i] = entry
+        if raw.lstrip().startswith("#"):  # standalone: covers next line
+            out[i + 1] = entry
+    return out
+
+
+class LintModule:
+    """One parsed source file plus the shared analyses rules consume."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = parse_suppressions(self.lines)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._index_scopes()
+        self._find_traced()
+
+    # -- scopes and name resolution -----------------------------------------
+
+    def _index_scopes(self) -> None:
+        """Map each function/module scope to its locally-bound callables
+        and simple assignments (last lexical binding wins)."""
+        self.scope_defs: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        self.scope_assigns: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            scope = self.enclosing_scope(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.scope_defs.setdefault(scope, {})[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    if isinstance(node.value, ast.Lambda):
+                        self.scope_defs.setdefault(scope, {})[
+                            tgt.id
+                        ] = node.value
+                    self.scope_assigns.setdefault(scope, {})[
+                        tgt.id
+                    ] = node.value
+
+    def enclosing_scope(self, node: ast.AST) -> ast.AST:
+        """Nearest enclosing function scope (or the module)."""
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+        ):
+            cur = self.parents.get(cur)
+        return cur if cur is not None else self.tree
+
+    def enclosing_scopes(self, node: ast.AST) -> Iterable[ast.AST]:
+        scope = self.enclosing_scope(node)
+        while True:
+            yield scope
+            if isinstance(scope, ast.Module):
+                return
+            scope = self.enclosing_scope(scope)
+
+    def resolve_callable(self, node: ast.AST) -> Optional[ast.AST]:
+        """Resolve an expression used as a function value to its
+        FunctionDef/Lambda: direct lambdas, names bound in an enclosing
+        scope, and a one-hop look-through of ``name = shard_map(f, ...)``
+        / ``name = jax.jit(f, ...)`` style wrapper assignments."""
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+        if not isinstance(node, ast.Name):
+            return None
+        for scope in self.enclosing_scopes(node):
+            if node.id in self.scope_defs.get(scope, {}):
+                return self.scope_defs[scope][node.id]
+            if node.id in self.scope_assigns.get(scope, {}):
+                value = self.scope_assigns[scope][node.id]
+                if (
+                    isinstance(value, ast.Call)
+                    and last_segment(value.func) in TRACING_WRAPPERS
+                    and value.args
+                ):
+                    inner = value.args[0]
+                    if isinstance(inner, ast.Lambda):
+                        return inner
+                    if isinstance(inner, ast.Name) and inner.id != node.id:
+                        return self._lookup_from(scope, inner.id)
+                return None
+        return None
+
+    def _lookup_from(self, scope: ast.AST, name: str) -> Optional[ast.AST]:
+        while True:
+            if name in self.scope_defs.get(scope, {}):
+                return self.scope_defs[scope][name]
+            if isinstance(scope, ast.Module):
+                return None
+            scope = self.enclosing_scope(scope)
+
+    # -- traced-function analysis -------------------------------------------
+
+    def _find_traced(self) -> None:
+        """Mark FunctionDefs/Lambdas that run under a JAX trace."""
+        traced: Set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec
+                    if isinstance(dec, ast.Call):
+                        # functools.partial(jax.jit, ...) or jax.jit(...)
+                        if last_segment(dec.func) == "partial" and dec.args:
+                            target = dec.args[0]
+                        else:
+                            target = dec.func
+                    if last_segment(target) in ("jit", "pmap"):
+                        traced.add(node)
+            elif isinstance(node, ast.Call):
+                seg = last_segment(node.func)
+                if seg in TRACING_WRAPPERS:
+                    for idx in TRACING_WRAPPERS[seg]:
+                        if idx < len(node.args):
+                            fn = self.resolve_callable(node.args[idx])
+                            if fn is not None:
+                                traced.add(fn)
+        self.traced = traced
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """True when ``node`` executes under a trace: inside (or being)
+        a traced def, including lexical nesting."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in self.traced:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def nearest_def(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            cur = self.parents.get(cur)
+        return cur
+
+    def is_test_file(self) -> bool:
+        base = os.path.basename(self.path)
+        return base.startswith(("test_", "conftest")) or (
+            os.sep + "tests" + os.sep
+        ) in self.path
+
+
+def apply_suppressions(module: LintModule, findings: List[Finding]) -> List[Finding]:
+    """Mark suppressed findings. A disable with no ``-- reason``, or a
+    placeholder ``TODO`` reason (what ``--fix-suppressions`` writes),
+    does NOT suppress — the finding stays active and a companion
+    ``JG000`` records the bad suppression itself, so the gate cannot be
+    neutralized without writing a real justification."""
+    extra: List[Finding] = []
+    for f in findings:
+        entry = module.suppressions.get(f.line)
+        if entry is None:
+            continue
+        rules, reason = entry
+        if "all" in rules or f.rule in rules:
+            if not reason or reason.strip().upper().startswith("TODO"):
+                what = "without a '-- reason'" if not reason else (
+                    "with a placeholder TODO reason"
+                )
+                extra.append(
+                    Finding(
+                        rule="JG000", path=f.path, line=f.line, col=f.col,
+                        message=(
+                            f"suppression of {f.rule} {what} does not "
+                            "suppress — write the actual justification"
+                        ),
+                    )
+                )
+                continue
+            f.suppressed = True
+            f.reason = reason
+    return findings + extra
+
+
+def run_source(
+    source: str,
+    path: str = "<string>",
+    rule_ids: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one source string. Returns findings with suppressions applied
+    (suppressed ones included, flagged)."""
+    from .rules import RULES
+
+    module = LintModule(path, source)
+    selected = (
+        {r.upper() for r in rule_ids} if rule_ids else set(RULES.keys())
+    )
+    findings: List[Finding] = []
+    for rule_id, rule in RULES.items():
+        if rule_id not in selected:
+            continue
+        findings.extend(rule.check(module))
+    # A rule may visit the same node through two traced roots: dedup.
+    seen = set()
+    unique = []
+    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
+        key = (f.rule, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return apply_suppressions(module, unique)
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".venv")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def run_paths(
+    paths: Sequence[str],
+    rule_ids: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            findings.extend(run_source(source, path, rule_ids))
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    rule="JG000", path=path, line=e.lineno or 0, col=0,
+                    message=f"could not parse: {e.msg}",
+                )
+            )
+    return findings
+
+
+def format_human(findings: Sequence[Finding], *, show_suppressed: bool = False) -> str:
+    out: List[str] = []
+    shown = 0
+    for f in findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        shown += 1
+        tag = f" (suppressed: {f.reason})" if f.suppressed else ""
+        out.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}{tag}")
+    n_sup = sum(1 for f in findings if f.suppressed)
+    n_active = len(findings) - n_sup
+    out.append(
+        f"{n_active} finding(s), {n_sup} suppressed"
+        + ("" if show_suppressed or not n_sup else " (hidden)")
+    )
+    return "\n".join(out)
+
+
+def format_json(findings: Sequence[Finding]) -> str:
+    n_sup = sum(1 for f in findings if f.suppressed)
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in findings],
+            "unsuppressed": len(findings) - n_sup,
+            "suppressed": n_sup,
+        },
+        indent=2,
+    )
+
+
+def fix_suppressions(findings: Sequence[Finding]) -> int:
+    """Append a TODO suppression comment to every unsuppressed finding's
+    line (skipping lines that already carry a jg: comment). Returns the
+    number of edited lines. An annotator for burning down a large
+    backlog: TODO reasons deliberately do NOT suppress (the finding
+    stays active plus a JG000 for the placeholder), so the gate only
+    goes green once every reason is actually written."""
+    by_file: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if not f.suppressed and f.rule != "JG000":
+            by_file.setdefault(f.path, []).append(f)
+    edited = 0
+    for path, file_findings in by_file.items():
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines(keepends=True)
+        rules_by_line: Dict[int, Set[str]] = {}
+        for f in file_findings:
+            rules_by_line.setdefault(f.line, set()).add(f.rule)
+        for lineno, rules in rules_by_line.items():
+            idx = lineno - 1
+            if idx >= len(lines) or "jg:" in lines[idx]:
+                continue
+            body = lines[idx].rstrip("\n")
+            lines[idx] = (
+                f"{body}  # jg: disable={','.join(sorted(rules))} "
+                "-- TODO: justify or fix\n"
+            )
+            edited += 1
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.writelines(lines)
+    return edited

@@ -1,0 +1,552 @@
+"""The repo-tailored JAX-footgun rules.
+
+Each rule is pure AST analysis over one ``LintModule``; none of them
+import jax. They are deliberately conservative — a rule that cries wolf
+gets suppressed wholesale and teaches nothing — so each encodes the
+narrow shape of a footgun this codebase (or its reference) actually hit.
+ANALYSIS.md carries the catalog with rationale and examples.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+from .core import Finding, LintModule, dotted_name, last_segment
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    description: str
+    check: Callable[[LintModule], List[Finding]]
+
+
+def _finding(module: LintModule, rule_id: str, node: ast.AST, msg: str) -> Finding:
+    return Finding(
+        rule=rule_id,
+        path=module.path,
+        line=getattr(node, "lineno", 0),
+        col=getattr(node, "col_offset", 0),
+        message=msg,
+    )
+
+
+# --------------------------------------------------------------------------
+# JG001 — host sync inside a traced function
+# --------------------------------------------------------------------------
+
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_SYNC_ATTRS = {"item", "block_until_ready", "tolist", "copy_to_host_async"}
+
+
+def check_host_sync(module: LintModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call) or not module.is_traced(node):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "float" and node.args:
+            out.append(
+                _finding(
+                    module, "JG001", node,
+                    "float() on a traced value — host sync / trace-time "
+                    "concretization inside a jitted function",
+                )
+            )
+        elif isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+            out.append(
+                _finding(
+                    module, "JG001", node,
+                    f".{func.attr}() inside a traced function forces a "
+                    "device->host sync (or fails to trace)",
+                )
+            )
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("asarray", "array")
+            and isinstance(func.value, ast.Name)
+            and func.value.id in _NUMPY_ALIASES
+        ):
+            out.append(
+                _finding(
+                    module, "JG001", node,
+                    f"{func.value.id}.{func.attr}() inside a traced "
+                    "function pulls the value to host numpy — use jnp",
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# JG002 — PRNG key hygiene
+# --------------------------------------------------------------------------
+
+_SAMPLERS = {
+    "normal", "uniform", "randint", "bernoulli", "categorical",
+    "permutation", "choice", "gumbel", "truncated_normal", "laplace",
+    "exponential", "poisson", "gamma", "beta", "dirichlet", "cauchy",
+    "rademacher", "bits", "ball", "loggamma", "maxwell", "t",
+}
+
+
+def _in_test_function(module: LintModule, node: ast.AST) -> bool:
+    cur = module.nearest_def(node)
+    while cur is not None:
+        if getattr(cur, "name", "").startswith("test"):
+            return True
+        cur = module.nearest_def(cur)
+    return False
+
+
+def _jax_random_names(module: LintModule):
+    """(dotted-prefix aliases of jax.random, bare names imported from
+    it) — so `random.uniform(lo, hi)` from the *stdlib* is never
+    mistaken for a PRNG sampler. `import jax` always contributes the
+    canonical 'jax.random' prefix."""
+    prefixes = {"jax.random"}
+    bare = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    prefixes.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        prefixes.add(a.asname or "random")
+            elif node.module == "jax.random":
+                for a in node.names:
+                    bare.add(a.asname or a.name)
+    return prefixes, bare
+
+
+def check_prng_hygiene(module: LintModule) -> List[Finding]:
+    if module.is_test_file():
+        return []
+    jr_prefixes, jr_bare = _jax_random_names(module)
+    out: List[Finding] = []
+    # (a) hardcoded seeds
+    for node in ast.walk(module.tree):
+        if (
+            isinstance(node, ast.Call)
+            and last_segment(node.func) == "PRNGKey"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, int)
+            and not _in_test_function(module, node)
+        ):
+            out.append(
+                _finding(
+                    module, "JG002", node,
+                    f"hardcoded PRNGKey({node.args[0].value}) in library "
+                    "code — accept or derive the seed (split/fold_in) so "
+                    "runs are reproducible *and* controllable",
+                )
+            )
+    # (b) key reuse: the same name fed to >= 2 sampling calls with no
+    # rebinding in between (per scope, lexical order)
+    uses: Dict[tuple, List[int]] = {}
+    rebinds: Dict[tuple, List[int]] = {}
+    for node in ast.walk(module.tree):
+        scope = module.enclosing_scope(node)
+        if isinstance(node, ast.Call):
+            seg = last_segment(node.func)
+            dn = dotted_name(node.func) or ""
+            from_jax_random = (
+                any(dn == f"{p}.{seg}" for p in jr_prefixes)
+                or (dn == seg and seg in jr_bare)
+            )
+            if (
+                seg in _SAMPLERS
+                and from_jax_random
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                uses.setdefault((scope, node.args[0].id), []).append(
+                    node.lineno
+                )
+        for tgt_name, lineno in _assigned_names(node):
+            rebinds.setdefault((scope, tgt_name), []).append(lineno)
+    for (scope, name), lines in uses.items():
+        lines = sorted(lines)
+        bind_lines = sorted(rebinds.get((scope, name), []))
+        for prev, cur in zip(lines, lines[1:]):
+            if not any(prev < b <= cur for b in bind_lines):
+                out.append(
+                    Finding(
+                        rule="JG002", path=module.path, line=cur, col=0,
+                        message=(
+                            f"PRNG key {name!r} reused by a second "
+                            f"sampling call (first use line {prev}) "
+                            "without split/fold_in — identical randomness"
+                        ),
+                    )
+                )
+    return out
+
+
+def _assigned_names(node: ast.AST):
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name):
+                    yield n.id, node.lineno
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and isinstance(
+        node.target, ast.Name
+    ):
+        yield node.target.id, node.lineno
+    elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+        yield node.target.id, node.lineno
+
+
+# --------------------------------------------------------------------------
+# JG003 — jit-boundary hygiene
+# --------------------------------------------------------------------------
+
+_ARRAY_MAKERS = {
+    "zeros", "ones", "arange", "asarray", "array", "full", "linspace",
+    "eye", "normal", "uniform", "PRNGKey",
+}
+
+
+def _is_train_step_shaped(name: Optional[str], fn: Optional[ast.AST]) -> bool:
+    """The shapes we insist donate their input state: a 'step' that is
+    explicitly a *train/update* step, or whose first parameter is the
+    optimizer-carrying ``state``. Eval steps are excluded — their state
+    argument is reused across batches and must NOT be donated."""
+    label = (name or "").lower()
+    first_param = None
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if not name:
+            label = fn.name.lower()
+        if fn.args.args:
+            first_param = fn.args.args[0].arg
+    if "eval" in label or "step" not in label:
+        return False
+    return first_param == "state" or "train" in label or "update" in label
+
+
+def check_jit_boundary(module: LintModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = last_segment(node.func)
+        if seg == "jit" and node.args:
+            arg = node.args[0]
+            arg_name = arg.id if isinstance(arg, ast.Name) else None
+            fn = module.resolve_callable(arg)
+            kwarg_names = {k.arg for k in node.keywords}
+            if (
+                _is_train_step_shaped(arg_name, fn)
+                and "donate_argnums" not in kwarg_names
+                and "donate_argnames" not in kwarg_names
+            ):
+                out.append(
+                    _finding(
+                        module, "JG003", node,
+                        f"jit of train-step-shaped {arg_name or 'function'!s} "
+                        "without donate_argnums — the old state buffer "
+                        "stays live, doubling param+opt memory",
+                    )
+                )
+            # non-hashable defaults behind static_argnums/names
+            if fn is not None and isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                out.extend(_check_static_hashable(module, node, fn))
+        elif seg == "shard_map" and node.args:
+            out.extend(_check_shardmap_closure(module, node))
+    return out
+
+
+def _check_static_hashable(
+    module: LintModule, call: ast.Call, fn: ast.FunctionDef
+) -> List[Finding]:
+    out: List[Finding] = []
+    params = [a.arg for a in fn.args.args]
+    defaults = fn.args.defaults
+    default_by_param = dict(zip(params[len(params) - len(defaults):], defaults))
+    static: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    static.append(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(params):
+                        static.append(params[n.value])
+    for name in static:
+        default = default_by_param.get(name)
+        if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            out.append(
+                _finding(
+                    module, "JG003", call,
+                    f"static arg {name!r} defaults to an unhashable "
+                    f"{type(default).__name__.lower()} — jit static args "
+                    "must be hashable (use a tuple/frozenset)",
+                )
+            )
+    return out
+
+
+def _check_shardmap_closure(module: LintModule, call: ast.Call) -> List[Finding]:
+    """Array values captured by a shard_map body from an enclosing
+    function become replicated closure constants — usually an unintended
+    broadcast (and a silent resharding hazard)."""
+    fn = module.resolve_callable(call.args[0])
+    if fn is None or isinstance(fn, ast.Lambda):
+        body = fn.body if fn is not None else None
+        params = {a.arg for a in fn.args.args} if fn is not None else set()
+        body_nodes = list(ast.walk(body)) if body is not None else []
+    elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        params = {a.arg for a in fn.args.args}
+        body_nodes = [n for stmt in fn.body for n in ast.walk(stmt)]
+    else:
+        return []
+    if not body_nodes:
+        return []
+    # names bound from array-creating calls in enclosing function scopes
+    array_names: Dict[str, int] = {}
+    scope = module.enclosing_scope(fn)
+    while not isinstance(scope, ast.Module):
+        for name, value in module.scope_assigns.get(scope, {}).items():
+            if (
+                isinstance(value, ast.Call)
+                and last_segment(value.func) in _ARRAY_MAKERS
+            ):
+                dn = dotted_name(value.func) or ""
+                root = dn.split(".")[0]
+                if root in ("jnp", "np", "numpy", "jax") or dn.startswith(
+                    "jax.random"
+                ):
+                    array_names.setdefault(name, value.lineno)
+        scope = module.enclosing_scope(scope)
+    if not array_names:
+        return []
+    locals_bound = set(params)
+    for n in body_nodes:
+        for name, _ in _assigned_names(n):
+            locals_bound.add(name)
+    out = []
+    seen = set()
+    for n in body_nodes:
+        if (
+            isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)
+            and n.id in array_names
+            and n.id not in locals_bound
+            and n.id not in seen
+        ):
+            seen.add(n.id)
+            out.append(
+                _finding(
+                    module, "JG003", n,
+                    f"shard_map body closes over array {n.id!r} (built at "
+                    f"line {array_names[n.id]}) — closure constants are "
+                    "replicated to every device; pass it as an argument "
+                    "with an explicit in_spec",
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# JG004 — Python control flow on traced values
+# --------------------------------------------------------------------------
+
+
+def check_tracer_control_flow(module: LintModule) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in module.traced:
+        if isinstance(fn, ast.Lambda):
+            continue  # lambdas cannot contain statements
+        params = {a.arg for a in fn.args.args}
+        params |= {a.arg for a in fn.args.kwonlyargs}
+        own_nodes = [
+            n for stmt in fn.body for n in ast.walk(stmt)
+            if module.nearest_def(n) is fn
+        ]
+        for n in own_nodes:
+            if not isinstance(n, (ast.If, ast.While)):
+                continue
+            bad = _tracer_names_in_test(n.test, params)
+            if bad:
+                kind = "if" if isinstance(n, ast.If) else "while"
+                out.append(
+                    _finding(
+                        module, "JG004", n,
+                        f"python `{kind}` on traced argument(s) "
+                        f"{sorted(bad)} — this branches at trace time "
+                        "(ConcretizationTypeError or silent "
+                        "specialization); use lax.cond/select, or mark "
+                        "the arg static",
+                    )
+                )
+    return out
+
+
+def _tracer_names_in_test(test: ast.AST, params: set) -> set:
+    """Bare parameter names whose runtime *value* steers the branch.
+    `x is None`, `isinstance(x, ...)`, and attribute probes like
+    `x.ndim == 3` are trace-time-static idioms and excluded."""
+    if isinstance(test, ast.Compare):
+        ops_static = all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        )
+        if ops_static:
+            return set()
+    bad = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            seg = last_segment(n.func)
+            if seg in ("isinstance", "len", "getattr", "hasattr", "callable"):
+                return set()
+        if isinstance(n, ast.Name) and n.id in params:
+            parent_attr = False
+            # attribute probes (x.ndim / x.shape / x.dtype) are static
+            # under jit; walking from the test we can't see parents, so
+            # re-scan: a Name that only appears as an Attribute value
+            # with a static attr is fine.
+            for m in ast.walk(test):
+                if (
+                    isinstance(m, ast.Attribute)
+                    and m.value is n
+                    and m.attr in ("shape", "ndim", "dtype", "size", "sharding")
+                ):
+                    parent_attr = True
+            if not parent_attr:
+                bad.add(n.id)
+    return bad
+
+
+# --------------------------------------------------------------------------
+# JG005 — silent broad except
+# --------------------------------------------------------------------------
+
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+}
+
+
+def check_silent_except(module: LintModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None or (
+            last_segment(node.type) in ("Exception", "BaseException")
+        )
+        if not broad:
+            continue
+        body_nodes = [n for stmt in node.body for n in ast.walk(stmt)]
+        reraises = any(isinstance(n, ast.Raise) for n in body_nodes)
+        logs = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in _LOG_METHODS
+            for n in body_nodes
+        )
+        uses_exc = node.name is not None and any(
+            isinstance(n, ast.Name) and n.id == node.name
+            for n in body_nodes
+        )
+        if not (reraises or logs or uses_exc):
+            what = (
+                "bare except" if node.type is None
+                else f"except {last_segment(node.type)}"
+            )
+            out.append(
+                _finding(
+                    module, "JG005", node,
+                    f"{what} swallows the error (no re-raise, no logging, "
+                    "exception unused) — narrow the type or log why "
+                    "ignoring is safe",
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# JG006 — direct jax.shard_map access (version-compat shim exists)
+# --------------------------------------------------------------------------
+
+
+def check_shard_map_compat(module: LintModule) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Attribute):
+            dn = dotted_name(node)
+            if dn in ("jax.shard_map", "jax.experimental.shard_map"):
+                out.append(
+                    _finding(
+                        module, "JG006", node,
+                        f"direct {dn} access breaks across jax versions "
+                        "(moved in 0.5, kwarg renamed) — import "
+                        "parallel.compat.shard_map instead",
+                    )
+                )
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = (
+                [node.module] if isinstance(node, ast.ImportFrom)
+                else [a.name for a in node.names]
+            )
+            for name in names:
+                if name and name.startswith("jax.experimental.shard_map"):
+                    out.append(
+                        _finding(
+                            module, "JG006", node,
+                            "import of jax.experimental.shard_map — gone "
+                            "on newer jax; import "
+                            "parallel.compat.shard_map instead",
+                        )
+                    )
+    return out
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in [
+        Rule(
+            "JG001", "host-sync-in-trace",
+            "float()/np.asarray/.item()/.block_until_ready inside a "
+            "jitted / shard_mapped / scanned function",
+            check_host_sync,
+        ),
+        Rule(
+            "JG002", "prng-hygiene",
+            "hardcoded PRNGKey(literal) in library code; key reuse "
+            "across sampling calls without split/fold_in",
+            check_prng_hygiene,
+        ),
+        Rule(
+            "JG003", "jit-boundary",
+            "train-step jits without donate_argnums; unhashable static "
+            "args; shard_map bodies closing over arrays",
+            check_jit_boundary,
+        ),
+        Rule(
+            "JG004", "tracer-control-flow",
+            "python if/while on traced argument values",
+            check_tracer_control_flow,
+        ),
+        Rule(
+            "JG005", "silent-except",
+            "broad except that neither re-raises, logs, nor uses the "
+            "exception",
+            check_silent_except,
+        ),
+        Rule(
+            "JG006", "shard-map-compat",
+            "direct jax.shard_map / jax.experimental.shard_map use "
+            "instead of the version shim",
+            check_shard_map_compat,
+        ),
+    ]
+}

@@ -68,6 +68,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "per-process heartbeats, recompile counts "
                              "(OBSERVABILITY.md); read back with the "
                              "`telemetry` subcommand")
+        sp.add_argument("--sanitize", default=None, metavar="FENCES",
+                        help="arm runtime fences (ANALYSIS.md): comma "
+                             "list of 'recompile' (hard-error when "
+                             "post-warmup XLA compiles exceed the "
+                             "budget), 'transfer' (disallow implicit "
+                             "host<->device transfers around the jitted "
+                             "step), 'nan' (loss NaN/inf fence). "
+                             "Default: the JG_SANITIZE env var")
+        sp.add_argument("--recompile-budget", type=int, default=None,
+                        help="post-warmup compile budget for "
+                             "--sanitize recompile (default 16)")
+        sp.add_argument("--nan-check-every", type=int, default=None,
+                        help="NaN-fence stride in steps for "
+                             "--sanitize nan (each check syncs; "
+                             "default 50)")
         sp.add_argument("--loss", default="ce",
                         choices=["ce", "hinge", "sqrt_hinge"])
         sp.add_argument("--label-smoothing", type=float, default=0.0,
@@ -235,6 +250,27 @@ def build_parser() -> argparse.ArgumentParser:
     tm.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
                          "of a table")
+    ln = sub.add_parser(
+        "lint",
+        help="run the JAX-footgun linter (rules JG001-JG006, "
+             "ANALYSIS.md) over the package (or given paths); exit 1 "
+             "on any unsuppressed finding",
+    )
+    ln.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: the "
+                         "installed package source)")
+    ln.add_argument("--rule", action="append", default=None,
+                    metavar="JGXXX",
+                    help="restrict to the given rule id(s); repeatable")
+    ln.add_argument("--format", default="human",
+                    choices=["human", "json"])
+    ln.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (with their "
+                         "reasons)")
+    ln.add_argument("--fix-suppressions", action="store_true",
+                    help="append a TODO suppression comment to every "
+                         "unsuppressed finding line (backlog burndown; "
+                         "reasons still have to be written by hand)")
     return p
 
 
@@ -285,6 +321,9 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         tensor_parallel=args.tp,
         profile_dir=args.profile_dir,
         telemetry_dir=args.telemetry_dir,
+        sanitize=args.sanitize,
+        recompile_budget=args.recompile_budget,
+        nan_check_every=args.nan_check_every,
         remat=args.remat,
         grad_accum=args.grad_accum,
         scan_steps=args.scan_steps,
@@ -317,6 +356,32 @@ def main(argv=None) -> int:
     repin_failed = _honor_platform_env()
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if args.cmd == "lint":
+        # Pure host-side AST analysis: no jax backend, no logging setup.
+        import os
+
+        from .analysis.lint import (
+            fix_suppressions,
+            format_human,
+            format_json,
+            run_paths,
+        )
+
+        paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+        findings = run_paths(paths, rule_ids=args.rule)
+        if args.fix_suppressions:
+            edited = fix_suppressions(findings)
+            print(f"annotated {edited} line(s) with TODO suppressions",
+                  file=sys.stderr)
+            findings = run_paths(paths, rule_ids=args.rule)
+        if args.format == "json":
+            print(format_json(findings))
+        else:
+            print(format_human(
+                findings, show_suppressed=args.show_suppressed
+            ))
+        return 1 if any(not f.suppressed for f in findings) else 0
 
     if args.cmd == "telemetry":
         # Pure host-side log reading: no jax backend, no logging setup

@@ -90,8 +90,8 @@ def _load_bin_batches(d: str) -> Tuple[np.ndarray, ...] | None:
             decoded = native.cifar_bin_decode_native(path, n)
             if decoded is not None:
                 return decoded
-        except Exception:  # pragma: no cover - fall through to numpy
-            pass
+        except Exception as e:  # pragma: no cover - fall through to numpy
+            log.debug("native cifar decode failed (%s); numpy reader", e)
         rec = np.fromfile(path, np.uint8).reshape(-1, 3073)
         return _rows_to_nhwc(rec[:, 1:]), rec[:, 0].astype(np.int32)
 

@@ -57,8 +57,8 @@ def load_idx(path: str) -> np.ndarray:
             arr = native.load_idx_native(path)
             if arr is not None:
                 return arr
-        except Exception:  # pragma: no cover - fall through to python
-            pass
+        except Exception as e:  # pragma: no cover - fall through to python
+            log.debug("native idx decode failed (%s); python parser", e)
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">I", f.read(4))[0]

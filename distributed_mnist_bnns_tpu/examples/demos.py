@@ -47,8 +47,9 @@ log = logging.getLogger(__name__)
 def _toy_state(lr=0.01, seed=0):
     model = bnn_mlp_small(backend="xla")
     x = jnp.zeros((1, 784))
+    init_rng, dropout_rng = jax.random.split(jax.random.PRNGKey(seed))
     variables = model.init(
-        {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(1)},
+        {"params": init_rng, "dropout": dropout_rng},
         x,
         train=True,
     )
@@ -64,65 +65,67 @@ def _toy_state(lr=0.01, seed=0):
     return state, latent_clamp_mask(variables["params"])
 
 
-def _toy_batch(n=64):
-    x = jax.random.normal(jax.random.PRNGKey(2), (n, 784))
-    y = jax.random.randint(jax.random.PRNGKey(3), (n,), 0, 10)
+def _toy_batch(n=64, seed=0):
+    # distinct streams for data/labels, both derived from the one seed
+    kx, ky = jax.random.split(jax.random.fold_in(jax.random.PRNGKey(seed), 1))
+    x = jax.random.normal(kx, (n, 784))
+    y = jax.random.randint(ky, (n,), 0, 10)
     return x, y
 
 
-def demo_basic() -> float:
+def demo_basic(seed: int = 0) -> float:
     """One data-parallel train step on synthetic data (ref demo_basic)."""
-    state, mask = _toy_state()
+    state, mask = _toy_state(seed=seed)
     mesh = make_mesh()
     step = make_dp_train_step(mask, mesh, donate=False)
-    x, y = _toy_batch()
+    x, y = _toy_batch(seed=seed)
     state = replicate(state, mesh)
     _, metrics = step(
         state, shard_batch(x, mesh), shard_batch(y, mesh),
-        replicate(jax.random.PRNGKey(0), mesh),
+        replicate(jax.random.PRNGKey(seed), mesh),
     )
     loss = float(metrics["loss"])
     log.info("demo_basic: loss=%.4f over mesh %s", loss, mesh.devices.shape)
     return loss
 
 
-def demo_checkpoint(ckpt_dir: str | None = None) -> float:
+def demo_checkpoint(ckpt_dir: str | None = None, seed: int = 0) -> float:
     """Save (single-writer + barrier), restore, then train a step —
     the DDP-correct checkpoint pattern (ref demo_checkpoint)."""
-    state, mask = _toy_state()
+    state, mask = _toy_state(seed=seed)
     with tempfile.TemporaryDirectory() as tmp:
         path = ckpt_dir or os.path.join(tmp, "ck")
         save_checkpoint(state, path, epoch=0)
         restored = load_checkpoint(state, path)
         mesh = make_mesh()
         step = make_dp_train_step(mask, mesh, donate=False)
-        x, y = _toy_batch()
+        x, y = _toy_batch(seed=seed)
         restored = replicate(restored, mesh)
         _, metrics = step(
             restored, shard_batch(x, mesh), shard_batch(y, mesh),
-            replicate(jax.random.PRNGKey(0), mesh),
+            replicate(jax.random.PRNGKey(seed), mesh),
         )
     loss = float(metrics["loss"])
     log.info("demo_checkpoint: post-restore loss=%.4f", loss)
     return loss
 
 
-def demo_model_parallel() -> float:
+def demo_model_parallel(seed: int = 0) -> float:
     """Train step with params sharded over the 'model' axis (the
     declarative version of Net(dev0, dev1); ref demo_model_parallel)."""
     n = jax.device_count()
     model_par = 2 if n % 2 == 0 and n >= 2 else 1
     mesh = make_mesh(data=n // model_par, model=model_par)
-    state, mask = _toy_state()
+    state, mask = _toy_state(seed=seed)
     specs = bnn_mlp_tp_rules(state.params)
     base = make_train_step(mask, donate=False)
-    step, placed = make_tp_train_step(base, mesh, state, specs)
-    x, y = _toy_batch(32)
+    step, placed = make_tp_train_step(base, mesh, state, specs, donate=False)
+    x, y = _toy_batch(32, seed=seed)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     xb = jax.device_put(x, NamedSharding(mesh, P("data")))
     yb = jax.device_put(y, NamedSharding(mesh, P("data")))
-    rng = jax.device_put(jax.random.PRNGKey(0), NamedSharding(mesh, P()))
+    rng = jax.device_put(jax.random.PRNGKey(seed), NamedSharding(mesh, P()))
     _, metrics = step(placed, xb, yb, rng)
     loss = float(metrics["loss"])
     log.info(

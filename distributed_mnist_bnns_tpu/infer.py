@@ -333,7 +333,9 @@ def make_sharded_predictor(
     # one outer jit, same as the repo's other shard_map wrappers.
     local_fn = _build_any(frozen, interpret)
     local_fn = getattr(local_fn, "__wrapped__", local_fn)
-    shmapped = jax.shard_map(
+    from .parallel.compat import shard_map
+
+    shmapped = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(P(axis),),

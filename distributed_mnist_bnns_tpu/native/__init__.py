@@ -240,6 +240,7 @@ class BatchPool:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
+        # jg: disable=JG005 -- teardown-time close; globals may be gone
         except Exception:
             pass
 

@@ -57,7 +57,7 @@ def git_rev(cwd: Optional[str] = None) -> Optional[str]:
         )
         rev = out.stdout.strip()
         return rev if out.returncode == 0 and rev else None
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
         return None
 
 
@@ -92,7 +92,7 @@ def device_topology() -> Dict[str, Any]:
             "process_index": jax.process_index(),
             "process_count": jax.process_count(),
         }
-    except Exception:
+    except (ImportError, RuntimeError):
         return {"backend": None, "device_count": 0}
 
 
@@ -154,13 +154,13 @@ class EventLog:
                         str(k): int(v) for k, v in dict(mesh.shape).items()
                     },
                 }
-            except Exception:
+            except (AttributeError, TypeError, ValueError):
                 mesh_info = str(mesh)
         try:
             import jax
 
             jax_version = jax.__version__
-        except Exception:
+        except ImportError:
             jax_version = None
         self.emit(
             MANIFEST_KIND,

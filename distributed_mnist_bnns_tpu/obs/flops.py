@@ -13,7 +13,10 @@ the convention excludes.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 # Per-chip bf16 peak (dense MXU FLOPs/s) by device_kind substring, most
 # specific first. Sources: public TPU spec sheets (v5e 197 TF, v5p 459 TF,
@@ -169,8 +172,10 @@ def train_step_flops(
                     3.0 * 2.0 * macs * batch_size,
                     "analytic_3x_conv_and_dense_from_jaxpr",
                 )
-        except Exception:
-            pass
+        except Exception as e:
+            # A model whose forward cannot be abstractly traced (custom
+            # calls, data-dependent shapes) simply gets no MFU figure.
+            log.debug("jaxpr MAC walk failed (%s); flops unavailable", e)
     return None, "unavailable"
 
 
@@ -207,5 +212,5 @@ def device_memory_stats() -> Optional[dict]:
                          "largest_alloc_size")
             }
         return out or None
-    except Exception:
+    except (ImportError, RuntimeError, TypeError, ValueError):
         return None

@@ -84,7 +84,7 @@ class RecompileTracker:
                 self._on_duration
             )
             self.listener_available = True
-        except Exception:
+        except (ImportError, AttributeError):
             self.listener_available = False
         return self
 

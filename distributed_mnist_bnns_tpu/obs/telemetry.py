@@ -237,5 +237,5 @@ def peak_for_default_device(backend: str = "bf16"):
         import jax
 
         return device_peak_flops(jax.devices()[0], backend)
-    except Exception:
+    except (ImportError, RuntimeError, IndexError):
         return None, "unknown"

@@ -1,3 +1,4 @@
+from .compat import shard_map
 from .fsdp import (
     fsdp_shardings,
     fsdp_state_shardings,
@@ -50,6 +51,7 @@ from .expert_parallel import (
 )
 
 __all__ = [
+    "shard_map",
     "make_mesh",
     "make_hybrid_mesh",
     "fsdp_shardings",

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..obs import default_registry
 from ..ops.losses import cross_entropy_loss
 from ..train.trainer import TrainState, clamp_latent, make_step_body
+from .compat import shard_map
 
 # Host-side placement cost per step (device_put dispatch / multi-process
 # global-array assembly) — the piece of DP step time the device profiler
@@ -185,7 +186,7 @@ def make_shardmap_dp_train_step(
         )
         return new_state, {"loss": loss, "accuracy": acc}
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P()),

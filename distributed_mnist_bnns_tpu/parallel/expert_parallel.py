@@ -38,6 +38,7 @@ from ..ops.routing import (  # canonical defs: ops/routing.py (re-exported)
     topk_dispatch,
 )
 from ..ops.xnor_gemm import binary_matmul
+from .compat import shard_map
 
 __all__ = [
     "top1_dispatch",
@@ -151,7 +152,7 @@ def make_expert_parallel_moe(
         return jnp.einsum("tec,ecd->td", combine, ex_out)
 
     params_spec = P(axis)   # leading (expert) dim sharded on every leaf
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(params_spec, P(), P(axis)),

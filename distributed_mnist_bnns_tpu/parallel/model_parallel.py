@@ -218,6 +218,7 @@ def make_tp_train_step(
     param_specs: Any,
     *,
     data_axis: str = "data",
+    donate: bool = True,
 ) -> tuple[Callable, TrainState]:
     """Jit a train step with tensor-parallel params + data-parallel batch.
 
@@ -225,7 +226,10 @@ def make_tp_train_step(
     stay replicated (XLA reshards on the fly where the update touches
     sharded params). Returns (jitted_step, state placed onto the mesh) —
     the combined dp x mp configuration, the superset of the reference's
-    DDP (data axis) and its 2-device layer-split demo (model axis)."""
+    DDP (data axis) and its 2-device layer-split demo (model axis).
+    ``donate`` releases the incoming state's buffers to the update (the
+    functional-update training pattern; pass False to keep stepping the
+    same placed state repeatedly, e.g. ablations)."""
     repl = NamedSharding(mesh, P())
     st_sh = tp_state_shardings(mesh, state, param_specs)
     placed = jax.device_put(state, st_sh)
@@ -234,5 +238,6 @@ def make_tp_train_step(
         base_train_step,
         in_shardings=(st_sh, data_sh, data_sh, repl),
         out_shardings=(st_sh, repl),
+        donate_argnums=(0,) if donate else (),
     )
     return step, placed

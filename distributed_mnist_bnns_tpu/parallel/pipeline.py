@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..obs import default_registry
+from .compat import shard_map
 
 
 def make_pipeline_fn(
@@ -37,6 +38,7 @@ def make_pipeline_fn(
     stage_takes_rng: bool = False,
     stage_remat: bool = False,
     param_specs=None,
+    seed: int = 0,
 ):
     """Build f(stage_params, x[, rng]) -> y running the stage chain as a
     pipeline.
@@ -158,7 +160,7 @@ def make_pipeline_fn(
                     f"{axis!r} at dim 0"
                 )
     x_spec = P(batch_axis) if batch_axis else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(
@@ -192,7 +194,10 @@ def make_pipeline_fn(
     if stage_takes_rng:
         jitted = jax.jit(fn)
     else:
-        _dummy = jax.random.PRNGKey(0)
+        # The shard_map signature is uniform (params, x, rng); stages
+        # that take no rng get a key derived from ``seed`` that they
+        # never consume.
+        _dummy = jax.random.PRNGKey(seed)
         jitted = jax.jit(lambda p, x: fn(p, x, _dummy))
 
     @functools.wraps(jitted)

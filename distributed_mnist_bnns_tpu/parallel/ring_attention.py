@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .compat import shard_map
+
 
 def attention_reference(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *, causal: bool = False
@@ -133,7 +135,7 @@ def _finalize_ring(local_fn, mesh: Mesh, axis: str):
         (a for a in mesh.axis_names if a == "data" and a != axis), None
     )
     seq_sharded = P(batch_axis, axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(seq_sharded,) * 3,

@@ -540,6 +540,20 @@ class TrainConfig:
                                    # checkpoint, error), per-process
                                    # heartbeats, recompile tracking.
                                    # None = registry-only (no files).
+    sanitize: Optional[str] = None  # runtime fences (analysis/guards):
+                                   # comma list of "recompile" (hard-
+                                   # error on over-budget retraces),
+                                   # "transfer" (disallow implicit
+                                   # transfers around the jitted step),
+                                   # "nan" (loss NaN/inf fence). None =
+                                   # consult the JG_SANITIZE env var
+                                   # (how CI arms the fences repo-wide).
+    recompile_budget: Optional[int] = None  # post-warmup compiles allowed
+                                   # before the recompile fence trips
+                                   # (None = sanitizer default; see
+                                   # OBSERVABILITY.md budget convention)
+    nan_check_every: Optional[int] = None  # NaN-fence stride in steps
+                                   # (each check is a host sync)
 
 
 def _prefetch_chunks(items, size: int = 2):
@@ -604,7 +618,7 @@ class Trainer:
         init_rng, self.data_rng = jax.random.split(self.rng)
         dummy = jnp.zeros((1, *input_shape), jnp.float32)
         variables = self.model.init(
-            {"params": init_rng, "dropout": jax.random.PRNGKey(0)},
+            {"params": init_rng, "dropout": jax.random.fold_in(init_rng, 1)},
             dummy,
             train=True,
         )
@@ -649,6 +663,7 @@ class Trainer:
         self.results = ResultsLog(config.results_path or "results.csv")
         self.batch_meter = AverageMeter()
         self._setup_telemetry(input_shape)
+        self._setup_sanitizer()
         self._profiled = False  # trace the first epoch this trainer runs
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
@@ -751,6 +766,31 @@ class Trainer:
             peak_flops=self._peak_flops,
             peak_precision=self._peak_precision,
         )
+
+    def _setup_sanitizer(self) -> None:
+        """Build the runtime fences (analysis/guards). Explicit config
+        wins; with ``sanitize=None`` the ``JG_SANITIZE`` env var decides
+        — that's how CI arms the recompile fence for every Trainer in a
+        test process without touching call sites."""
+        from ..analysis import Sanitizer, SanitizerConfig
+
+        cfg = self.config
+        if cfg.sanitize is not None:
+            san = SanitizerConfig.from_spec(
+                cfg.sanitize,
+                recompile_budget=cfg.recompile_budget,
+                nan_check_every=cfg.nan_check_every,
+            )
+        else:
+            san = SanitizerConfig.from_env()
+            # Explicit per-run tuning still applies when the fences were
+            # armed by the environment (JG_SANITIZE) rather than the
+            # config — `--recompile-budget 2` must not be dropped.
+            if cfg.recompile_budget is not None:
+                san.recompile_budget = int(cfg.recompile_budget)
+            if cfg.nan_check_every is not None:
+                san.nan_check_every = max(int(cfg.nan_check_every), 1)
+        self.sanitizer = Sanitizer(san, telemetry=self.telemetry)
 
     def _record_step(self, per_step_s: float, n: int, seen: int,
                      metrics: Optional[Dict[str, float]] = None) -> None:
@@ -907,12 +947,14 @@ class Trainer:
         rng_global = _make_rng_replicator(mesh)
 
         def step(state, images, labels, rng):
-            return base_step(
-                state,
-                shard_batch(images, mesh),
-                shard_batch(labels, mesh),
-                rng_global(rng),
-            )
+            # Placement (host->device) happens OUTSIDE the transfer
+            # guard: only the jitted dispatch itself must be
+            # transfer-free.
+            xb = shard_batch(images, mesh)
+            yb = shard_batch(labels, mesh)
+            rg = rng_global(rng)
+            with self.sanitizer.guard_transfers():
+                return base_step(state, xb, yb, rg)
 
         return step
 
@@ -1105,12 +1147,11 @@ class Trainer:
             rng_global = _make_rng_replicator(mesh)
 
             def wrapped(state, images, labels, rng):
-                return scan(
-                    state,
-                    shard_batch(images, mesh, batch_dim=1),
-                    shard_batch(labels, mesh, batch_dim=1),
-                    rng_global(rng),
-                )
+                xb = shard_batch(images, mesh, batch_dim=1)
+                yb = shard_batch(labels, mesh, batch_dim=1)
+                rg = rng_global(rng)
+                with self.sanitizer.guard_transfers():
+                    return scan(state, xb, yb, rg)
 
             self._train_scan = wrapped
         else:
@@ -1220,11 +1261,28 @@ class Trainer:
         else:
             rng_arg = self.rng
         epoch_start = time.perf_counter()
-        self.state, metrics = epoch_fn(
-            self.state, images_all, labels_all,
-            self._place_index_matrix(idx), rng_arg,
-        )
+        # Index placement is a deliberate per-epoch host->device upload;
+        # it stays OUTSIDE the transfer guard, which covers only the
+        # epoch dispatch itself (dataset/state/rng are device-resident).
+        idx_dev = self._place_index_matrix(idx)
+        with self.sanitizer.guard_transfers():
+            self.state, metrics = epoch_fn(
+                self.state, images_all, labels_all, idx_dev, rng_arg,
+            )
         metrics = jax.tree.map(float, metrics)  # host fetch = device sync
+        # Whole-epoch dispatch: feed the recompile fence the TRUE step
+        # count (an epoch = n_batches optimizer steps — counting it as
+        # one step would stretch warmup/stride into epochs), and NaN-
+        # check the epoch means directly every epoch (already on host;
+        # the stride is meaningless inside a device-resident loop).
+        # NOTE for fenced device_data runs: post-warmup this path should
+        # compile ~nothing (one eval program, regime rebuilds), so a
+        # retrace-per-epoch leak surfaces after `recompile_budget`
+        # epochs — arm a small --recompile-budget to catch it early.
+        self.sanitizer.after_step(
+            n_batches * (epoch + 1), n_steps=n_batches
+        )
+        self.sanitizer.check_finite(metrics, step=n_batches * (epoch + 1))
         epoch_time = time.perf_counter() - epoch_start
         per_batch = epoch_time / max(n_batches, 1)
         self.batch_meter.update(per_batch, n_batches)
@@ -1432,9 +1490,19 @@ class Trainer:
                     # no host round-trip through the default device.
                     images, labels = jnp.asarray(images), jnp.asarray(labels)
                 step_fn = scan_step if n > 1 else self.train_step
-                self.state, metrics = step_fn(
-                    self.state, images, labels, self.rng,
-                )
+                if self.mesh is None:
+                    # single-device: inputs are already on device (the
+                    # jnp.asarray above), so the whole dispatch runs
+                    # under the transfer guard; the mesh paths guard
+                    # inside their wrappers, after shard_batch.
+                    with self.sanitizer.guard_transfers():
+                        self.state, metrics = step_fn(
+                            self.state, images, labels, self.rng,
+                        )
+                else:
+                    self.state, metrics = step_fn(
+                        self.state, images, labels, self.rng,
+                    )
                 first = seen == 0
                 seen += n
                 synced_metrics = None
@@ -1457,6 +1525,11 @@ class Trainer:
                 self.batch_meter.update(dt / n, n)
                 batch_times.extend([dt / n] * n)
                 self._record_step(dt / n, n, seen, synced_metrics)
+                # Fences: recompile budget + NaN stride (analysis/guards;
+                # raises inside the epoch try, so telemetry banks the
+                # error event before the crash propagates). n_steps keeps
+                # the stride honest under scan chunks.
+                self.sanitizer.after_step(seen, metrics, n_steps=n)
                 # Stop the trace outside the timed region so the sync +
                 # trace-dump I/O doesn't pollute the recorded batch time.
                 if profiling and seen >= cfg.profile_steps:

@@ -18,7 +18,7 @@ def is_primary_host() -> bool:
         import jax
 
         return jax.process_index() == 0
-    except Exception:
+    except (ImportError, RuntimeError):
         return True
 
 

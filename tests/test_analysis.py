@@ -1,0 +1,590 @@
+"""analysis/ subsystem: one positive + one negative fixture per lint
+rule (JG001-JG006), suppression-comment handling, and the three runtime
+fences (recompile budget, transfer guard, NaN fence) tripping on
+deliberately bad programs — plus the acceptance gate: the repo itself
+lints clean."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.analysis import (
+    NaNFenceError,
+    RecompileFenceError,
+    Sanitizer,
+    SanitizerConfig,
+)
+from distributed_mnist_bnns_tpu.analysis.lint import run_paths, run_source
+
+PKG_DIR = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+) + "/distributed_mnist_bnns_tpu"
+
+
+def active(findings, rule=None):
+    return [
+        f for f in findings
+        if not f.suppressed and (rule is None or f.rule == rule)
+    ]
+
+
+# --------------------------------------------------------------------------
+# JG001 — host sync in traced code
+# --------------------------------------------------------------------------
+
+
+def test_jg001_flags_host_sync_inside_jit():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    a = float(x.sum())\n"
+        "    b = np.asarray(x)\n"
+        "    c = x.item()\n"
+        "    x.block_until_ready()\n"
+        "    return a, b, c\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG001")) == 4
+
+
+def test_jg001_flags_scan_body_and_ignores_host_code():
+    scan_src = (
+        "import jax\n"
+        "def outer(xs):\n"
+        "    def body(c, x):\n"
+        "        return c, float(x)\n"
+        "    return jax.lax.scan(body, 0.0, xs)\n"
+    )
+    assert len(active(run_source(scan_src, "lib.py"), "JG001")) == 1
+    host_src = (
+        "import jax, numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return jnp.asarray(x).sum()\n"
+        "def host(x):\n"
+        "    return float(x) + np.asarray(x).mean()\n"
+    )
+    assert not active(run_source(host_src, "lib.py"), "JG001")
+
+
+# --------------------------------------------------------------------------
+# JG002 — PRNG hygiene
+# --------------------------------------------------------------------------
+
+
+def test_jg002_flags_hardcoded_seed_and_key_reuse():
+    src = (
+        "import jax\n"
+        "key = jax.random.PRNGKey(0)\n"
+        "def sample(rng, n):\n"
+        "    a = jax.random.normal(rng, (n,))\n"
+        "    b = jax.random.uniform(rng, (n,))\n"
+        "    return a + b\n"
+    )
+    found = active(run_source(src, "lib.py"), "JG002")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 2
+    assert "hardcoded" in msgs and "reused" in msgs
+
+
+def test_jg002_stdlib_random_is_not_a_prng_key():
+    stdlib = (
+        "import random\n"
+        "def pick(items):\n"
+        "    a = random.choice(items)\n"
+        "    b = random.uniform(0.0, 1.0)\n"
+        "    c = random.choice(items)\n"
+        "    return a, b, c\n"
+    )
+    assert not active(run_source(stdlib, "lib.py"), "JG002")
+    # ...but `from jax import random` (and jax.random aliases) still count
+    jaxish = (
+        "from jax import random\n"
+        "def sample(rng, n):\n"
+        "    a = random.normal(rng, (n,))\n"
+        "    b = random.uniform(rng, (n,))\n"
+        "    return a + b\n"
+    )
+    assert len(active(run_source(jaxish, "lib.py"), "JG002")) == 1
+
+
+def test_jg002_allows_derived_seeds_split_and_tests():
+    src = (
+        "import jax\n"
+        "def make(seed):\n"
+        "    key = jax.random.PRNGKey(seed)\n"
+        "    k1, k2 = jax.random.split(key)\n"
+        "    a = jax.random.normal(k1, (3,))\n"
+        "    k1 = jax.random.fold_in(k1, 1)\n"
+        "    b = jax.random.normal(k1, (3,))\n"
+        "    return a + b\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG002")
+    # test files are exempt from the hardcoded-seed rule entirely
+    assert not active(
+        run_source("import jax\nk = jax.random.PRNGKey(0)\n", "test_x.py"),
+        "JG002",
+    )
+
+
+# --------------------------------------------------------------------------
+# JG003 — jit-boundary hygiene
+# --------------------------------------------------------------------------
+
+
+def test_jg003_flags_train_step_without_donation():
+    src = (
+        "import jax\n"
+        "def make():\n"
+        "    def train_step(state, batch):\n"
+        "        return state\n"
+        "    return jax.jit(train_step)\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG003")) == 1
+
+
+def test_jg003_negative_donated_and_eval_steps():
+    src = (
+        "import jax\n"
+        "def make():\n"
+        "    def train_step(state, batch):\n"
+        "        return state\n"
+        "    def eval_step(state, batch):\n"
+        "        return state\n"
+        "    return (jax.jit(train_step, donate_argnums=(0,)),\n"
+        "            jax.jit(eval_step))\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG003")
+
+
+def test_jg003_flags_unhashable_static_default():
+    src = (
+        "import jax\n"
+        "def f(x, opts=[1, 2]):\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnames=('opts',))\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG003")) == 1
+    ok = src.replace("[1, 2]", "(1, 2)")
+    assert not active(run_source(ok, "lib.py"), "JG003")
+
+
+def test_jg003_flags_shard_map_closure_array():
+    src = (
+        "import jax.numpy as jnp\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def make(mesh, spec):\n"
+        "    table = jnp.zeros((8, 8))\n"
+        "    def body(x):\n"
+        "        return x @ table\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(spec,),\n"
+        "                     out_specs=spec)\n"
+    )
+    found = active(run_source(src, "lib.py"), "JG003")
+    assert len(found) == 1 and "table" in found[0].message
+    ok = (
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def make(mesh, spec, table):\n"
+        "    def body(x, table):\n"
+        "        return x @ table\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(spec, spec),\n"
+        "                     out_specs=spec)\n"
+    )
+    assert not active(run_source(ok, "lib.py"), "JG003")
+
+
+# --------------------------------------------------------------------------
+# JG004 — python control flow on tracers
+# --------------------------------------------------------------------------
+
+
+def test_jg004_flags_branch_on_traced_arg():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    found = active(run_source(src, "lib.py"), "JG004")
+    assert len(found) == 1 and "'x'" in found[0].message
+
+
+def test_jg004_allows_static_idioms():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, y=None):\n"
+        "    if y is None:\n"
+        "        y = x\n"
+        "    if x.ndim == 3:\n"
+        "        y = y.sum()\n"
+        "    if isinstance(y, tuple):\n"
+        "        y = y[0]\n"
+        "    return x + y\n"
+        "def host(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG004")
+
+
+# --------------------------------------------------------------------------
+# JG005 — silent broad except
+# --------------------------------------------------------------------------
+
+
+def test_jg005_flags_silent_swallow():
+    src = (
+        "def f(g):\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except:\n"
+        "        return None\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG005")) == 2
+
+
+def test_jg005_negative_logged_reraised_narrow_or_used():
+    src = (
+        "import logging\n"
+        "log = logging.getLogger(__name__)\n"
+        "def f(g):\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except Exception:\n"
+        "        log.warning('g failed')\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except Exception:\n"
+        "        raise RuntimeError('wrapped')\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except (OSError, ValueError):\n"
+        "        pass\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except Exception as e:\n"
+        "        return repr(e)\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG005")
+
+
+# --------------------------------------------------------------------------
+# JG006 — shard_map compat shim
+# --------------------------------------------------------------------------
+
+
+def test_jg006_flags_direct_jax_shard_map():
+    src = (
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map as sm\n"
+        "def f(body, mesh, spec):\n"
+        "    return jax.shard_map(body, mesh=mesh, in_specs=spec,\n"
+        "                         out_specs=spec)\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG006")) == 2
+
+
+def test_jg006_negative_shim_import():
+    src = (
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def f(body, mesh, spec):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=spec,\n"
+        "                     out_specs=spec)\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG006")
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+# --------------------------------------------------------------------------
+
+SILENT = (
+    "def f(g):\n"
+    "    try:\n"
+    "        return g()\n"
+    "    {comment}\n"
+    "    except Exception:{trailing}\n"
+    "        pass\n"
+)
+
+
+def test_suppression_trailing_comment_with_reason():
+    src = SILENT.format(
+        comment="# a normal comment",
+        trailing="  # jg: disable=JG005 -- demo: error is expected here",
+    )
+    (f,) = run_source(src, "lib.py")
+    assert f.suppressed and f.reason.startswith("demo:")
+
+
+def test_suppression_standalone_line_covers_next_line():
+    src = SILENT.format(
+        comment="# jg: disable=JG005 -- covered from the line above",
+        trailing="",
+    )
+    (f,) = run_source(src, "lib.py")
+    assert f.suppressed
+
+
+def test_suppression_requires_reason_and_matching_rule():
+    no_reason = SILENT.format(
+        comment="#", trailing="  # jg: disable=JG005"
+    )
+    fs = run_source(no_reason, "lib.py")
+    assert any(f.rule == "JG005" and not f.suppressed for f in fs)
+    assert any(
+        f.rule == "JG000" and "reason" in f.message for f in fs
+    )
+    wrong_rule = SILENT.format(
+        comment="#", trailing="  # jg: disable=JG001 -- wrong rule"
+    )
+    (f,) = run_source(wrong_rule, "lib.py")
+    assert not f.suppressed
+
+
+def test_suppression_todo_placeholder_does_not_suppress():
+    """--fix-suppressions annotations are debt markers, not green CI:
+    the original finding stays active and JG000 flags the placeholder."""
+    src = SILENT.format(
+        comment="#", trailing="  # jg: disable=JG005 -- TODO: justify or fix"
+    )
+    fs = run_source(src, "lib.py")
+    assert any(f.rule == "JG005" and not f.suppressed for f in fs)
+    assert any(f.rule == "JG000" and "TODO" in f.message for f in fs)
+
+
+def test_suppression_all_keyword():
+    src = SILENT.format(
+        comment="#", trailing="  # jg: disable=all -- kill everything here"
+    )
+    (f,) = run_source(src, "lib.py")
+    assert f.suppressed
+
+
+# --------------------------------------------------------------------------
+# the repo itself is clean (the CI gate, as a test)
+# --------------------------------------------------------------------------
+
+
+def test_package_lints_clean():
+    findings = run_paths([PKG_DIR])
+    bad = active(findings)
+    assert not bad, "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in bad
+    )
+    # and the suppressions that do exist all carry reasons
+    assert all(f.reason for f in findings if f.suppressed)
+
+
+def test_cli_lint_json_exit_zero(capsys):
+    import json
+
+    from distributed_mnist_bnns_tpu.cli import main
+
+    rc = main(["lint", "--format", "json", PKG_DIR])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["unsuppressed"] == 0
+
+
+def test_cli_lint_rule_filter_and_failure_exit(tmp_path, capsys):
+    bad = tmp_path / "lib.py"
+    bad.write_text(
+        "import jax\nk = jax.random.PRNGKey(0)\n"
+        "def f(g):\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    from distributed_mnist_bnns_tpu.cli import main
+
+    rc = main(["lint", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "JG002" in out and "JG005" in out
+    rc = main(["lint", "--rule", "JG005", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "JG002" not in out
+
+
+# --------------------------------------------------------------------------
+# runtime sanitizers
+# --------------------------------------------------------------------------
+
+
+class _EventCapture:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+
+
+def test_recompile_fence_trips_on_shape_polymorphic_jit():
+    cap = _EventCapture()
+    s = Sanitizer(
+        SanitizerConfig(
+            recompile_fence=True, recompile_budget=2, warmup_steps=1
+        ),
+        telemetry=cap,
+    )
+    f = jax.jit(lambda x: x.sum())
+    with pytest.raises(RecompileFenceError, match="exceed the budget"):
+        for n in range(2, 12):  # every call is a fresh shape -> recompile
+            f(jnp.ones((n,)))
+            s.after_step()
+    assert cap.events and cap.events[0]["kind"] == "sanitizer_trip"
+    assert cap.events[0]["fence"] == "recompile"
+
+
+def test_recompile_fence_quiet_on_stable_shapes():
+    s = Sanitizer(
+        SanitizerConfig(
+            recompile_fence=True, recompile_budget=0, warmup_steps=1
+        )
+    )
+    f = jax.jit(lambda x: x * 2)
+    for _ in range(10):  # one compile, then cache hits: never over budget
+        f(jnp.ones((4,)))
+        s.after_step()
+
+
+def test_transfer_guard_trips_on_host_batch_and_allows_device():
+    s = Sanitizer(SanitizerConfig(transfer_guard=True))
+    f = jax.jit(lambda x: x * 2)
+    host_batch = np.ones((4,), np.float32)
+    with pytest.raises(Exception, match="[Dd]isallowed"):
+        with s.guard_transfers():
+            f(host_batch).block_until_ready()
+    placed = jnp.asarray(host_batch)
+    with s.guard_transfers():
+        f(placed).block_until_ready()
+    # disabled guard is a transparent no-op
+    off = Sanitizer(SanitizerConfig())
+    with off.guard_transfers():
+        f(host_batch).block_until_ready()
+
+
+def test_nan_fence_trips_and_emits_event():
+    cap = _EventCapture()
+    s = Sanitizer(
+        SanitizerConfig(nan_fence=True, nan_check_every=1), telemetry=cap
+    )
+    s.after_step(1, {"loss": jnp.float32(1.0), "accuracy": 50.0})
+    with pytest.raises(NaNFenceError, match="loss"):
+        s.after_step(2, {"loss": jnp.float32(np.nan), "accuracy": 50.0})
+    assert cap.events[-1]["fence"] == "nan"
+    # off-stride steps skip the (syncing) check entirely
+    s2 = Sanitizer(SanitizerConfig(nan_fence=True, nan_check_every=10))
+    s2.after_step(3, {"loss": jnp.float32(np.nan)})
+
+
+def test_nan_fence_stride_crosses_boundary_under_scan_chunks():
+    """A dispatch advancing by a chunk size that never lands exactly on
+    the stride must still check when it CROSSES a stride boundary
+    (7-step chunks, stride 50: steps 49->56 cross 50)."""
+    s = Sanitizer(SanitizerConfig(nan_fence=True, nan_check_every=50))
+    seen = 0
+    with pytest.raises(NaNFenceError):
+        for _ in range(20):
+            seen += 7
+            s.after_step(seen, {"loss": jnp.float32(np.nan)}, n_steps=7)
+    assert seen == 56  # first chunk past the 50-step boundary, not lcm
+
+
+def test_sanitizer_config_from_env(monkeypatch):
+    monkeypatch.setenv("JG_SANITIZE", "recompile,nan")
+    monkeypatch.setenv("JG_RECOMPILE_BUDGET", "7")
+    monkeypatch.setenv("JG_NAN_EVERY", "5")
+    cfg = SanitizerConfig.from_env()
+    assert cfg.recompile_fence and cfg.nan_fence
+    assert not cfg.transfer_guard
+    assert cfg.recompile_budget == 7 and cfg.nan_check_every == 5
+    monkeypatch.delenv("JG_SANITIZE")
+    assert not SanitizerConfig.from_env().enabled
+    with pytest.raises(ValueError, match="unknown sanitizer"):
+        SanitizerConfig.from_spec("bogus")
+
+
+def test_trainer_nan_fence_trips_on_poisoned_loss(tmp_path):
+    """End-to-end: a poisoned run (NaN learning rate -> NaN params ->
+    NaN loss on the next step) is killed by the fence, and the event log
+    carries the sanitizer_trip + error trail."""
+    from distributed_mnist_bnns_tpu.data.common import ImageClassData
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    rng = np.random.default_rng(0)
+    data = ImageClassData(
+        rng.standard_normal((128, 28, 28, 1)).astype(np.float32),
+        rng.integers(0, 10, 128).astype(np.int32),
+        rng.standard_normal((32, 28, 28, 1)).astype(np.float32),
+        rng.integers(0, 10, 32).astype(np.int32),
+        source="synthetic", name="mnist", n_classes=10,
+    )
+    cfg = TrainConfig(
+        model="bnn-mlp-small", epochs=1, batch_size=32,
+        learning_rate=float("nan"), sanitize="nan", nan_check_every=1,
+        telemetry_dir=str(tmp_path), log_interval=1,
+    )
+    with pytest.raises(NaNFenceError):
+        Trainer(cfg).fit(data)
+    events = [
+        __import__("json").loads(line)
+        for line in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    kinds = [e["kind"] for e in events]
+    assert "sanitizer_trip" in kinds and "error" in kinds
+
+
+def test_trainer_runs_clean_with_all_fences(tmp_path):
+    from distributed_mnist_bnns_tpu.data.common import ImageClassData
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    rng = np.random.default_rng(1)
+    data = ImageClassData(
+        rng.standard_normal((96, 28, 28, 1)).astype(np.float32),
+        rng.integers(0, 10, 96).astype(np.int32),
+        rng.standard_normal((32, 28, 28, 1)).astype(np.float32),
+        rng.integers(0, 10, 32).astype(np.int32),
+        source="synthetic", name="mnist", n_classes=10,
+    )
+    cfg = TrainConfig(
+        model="bnn-mlp-small", epochs=2, batch_size=32,
+        sanitize="recompile,transfer,nan", nan_check_every=2,
+    )
+    history = Trainer(cfg).fit(data)
+    assert np.isfinite(history[-1]["train_loss"])
+    # the whole-epoch device-resident path runs under the same fences
+    # (its dispatch is transfer-guarded; index upload stays outside)
+    cfg_dev = TrainConfig(
+        model="bnn-mlp-small", epochs=2, batch_size=32,
+        device_data=True, sanitize="recompile,transfer,nan",
+    )
+    history = Trainer(cfg_dev).fit(data)
+    assert np.isfinite(history[-1]["train_loss"])
+
+
+def test_env_armed_fences_respect_config_budgets(monkeypatch):
+    """JG_SANITIZE arms the fence, but explicit per-run budgets
+    (--recompile-budget / --nan-check-every) must still win."""
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    monkeypatch.setenv("JG_SANITIZE", "recompile,nan")
+    t = Trainer(TrainConfig(
+        model="bnn-mlp-small", recompile_budget=2, nan_check_every=7,
+    ))
+    assert t.sanitizer.config.recompile_fence
+    assert t.sanitizer.config.recompile_budget == 2
+    assert t.sanitizer.config.nan_check_every == 7

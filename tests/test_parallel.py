@@ -197,11 +197,13 @@ def test_hybrid_mesh_dcn_plus_ici_axes():
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from distributed_mnist_bnns_tpu.parallel.compat import shard_map
+
     def f(x):
         return jax.lax.psum(x, "replica") + jax.lax.psum(x, "model")
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=P("replica", "data", "model"),
             out_specs=P("replica", "data", "model"),
