@@ -83,6 +83,21 @@ def build_parser() -> argparse.ArgumentParser:
                         help="NaN-fence stride in steps for "
                              "--sanitize nan (each check syncs; "
                              "default 50)")
+        sp.add_argument("--chaos", default=None, metavar="SPEC",
+                        help="fault-injection spec (RESILIENCE.md): "
+                             "';'-separated kind@k=v entries, e.g. "
+                             "'step_fault@step=5;ckpt_corrupt@epoch=1;"
+                             "preempt@step=12'. Kinds: step_fault, "
+                             "data_io, preempt, slow_host, ckpt_corrupt, "
+                             "ckpt_truncate. Default: the JG_CHAOS env "
+                             "var")
+        sp.add_argument("--checkpoint-keep", type=int, default=3,
+                        help="checkpoint generations kept for corruption "
+                             "rollback (digest-verified on resume)")
+        sp.add_argument("--no-preemption", action="store_true",
+                        help="do NOT turn SIGTERM/SIGINT into a graceful "
+                             "stop + mid-epoch checkpoint + exit 75 "
+                             "(resumable); default is preemption-aware")
         sp.add_argument("--loss", default="ce",
                         choices=["ce", "hinge", "sqrt_hinge"])
         sp.add_argument("--label-smoothing", type=float, default=0.0,
@@ -324,12 +339,32 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         sanitize=args.sanitize,
         recompile_budget=args.recompile_budget,
         nan_check_every=args.nan_check_every,
+        chaos=args.chaos,
+        checkpoint_keep=args.checkpoint_keep,
+        handle_preemption=not args.no_preemption,
         remat=args.remat,
         grad_accum=args.grad_accum,
         scan_steps=args.scan_steps,
         device_data=args.device_data,
     )
     return Trainer(config, input_shape=input_shape)
+
+
+def _fit_resumable(fit_fn):
+    """Run a fit under the preemption contract: a graceful stop maps to
+    the distinct EX_TEMPFAIL exit a supervisor reads as "reschedule me",
+    not "crashed" (RESILIENCE.md). Returns (exit_code, history) —
+    exit_code 0 means the fit ran to completion."""
+    from .resilience import Preempted
+
+    try:
+        return 0, fit_fn()
+    except Preempted as e:
+        log.warning(
+            "%s; state checkpointed — rerun with --resume to continue "
+            "(exit %d)", e, e.exit_code,
+        )
+        return e.exit_code, None
 
 
 def _honor_platform_env() -> str | None:
@@ -561,7 +596,11 @@ def main(argv=None) -> int:
             args, input_shape=(args.image_size, args.image_size, 3),
             num_classes=stream.n_classes,
         )
-        history = trainer.fit_stream(stream, eval_data=eval_data)
+        rc, history = _fit_resumable(
+            lambda: trainer.fit_stream(stream, eval_data=eval_data)
+        )
+        if rc:
+            return rc
         log.info("final: %s", history[-1] if history else {})
         return 0
 
@@ -657,7 +696,9 @@ def main(argv=None) -> int:
     )
 
     if args.cmd == "train":
-        history = trainer.fit(data)
+        rc, history = _fit_resumable(lambda: trainer.fit(data))
+        if rc:
+            return rc
         final = history[-1] if history else {}
         log.info("final: %s", final)
         return 0

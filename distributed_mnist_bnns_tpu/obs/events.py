@@ -16,6 +16,14 @@ Event kinds (schema v1):
   infer          packed-serving run summary
   error          exception type/message before a crash propagates
   heartbeat      liveness records (written per process by obs/heartbeat)
+  fault_injected a resilience/chaos fault fired (kind, point, step/epoch)
+  graceful_stop  preemption honored at a step boundary (mid-epoch
+                 checkpoint state, reason)
+  resume         a run restored checkpoint state before training
+                 (epoch/step/data position, digest_verified flag)
+  rollback       restore skipped corrupt generation(s) (resilience)
+  restart        the retry loop rebuilt the trainer (cause, attempt,
+                 backoff — resilience/policy)
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's

@@ -1,0 +1,57 @@
+"""resilience — fault injection, preemption handling and retry policy.
+
+Production TPU fleets live with preemption and partial failure as the
+common case; this package supplies both halves of surviving them:
+
+  chaos    scripted, seed-deterministic fault injection (the harness
+           that *proves* the recovery machinery works — the find-then-
+           fence pattern of analysis/, applied to process/IO/state
+           faults instead of JAX footguns)
+  preempt  SIGTERM/SIGINT-driven graceful stop at a step boundary,
+           with a distinct resumable exit code
+  policy   jittered-exponential retry with transient-vs-fatal
+           classification and a per-run restart budget
+
+The trainer wires chaos + preempt through ``TrainConfig.chaos`` /
+``--chaos`` / ``JG_CHAOS`` and ``handle_preemption``; the retry loop is
+``run_with_policy`` (``utils/recovery.run_with_recovery`` is the thin
+compat shim). Checkpoint integrity (content digests, generation
+rollback) lives with the writers in utils/checkpoint.py. See
+RESILIENCE.md for the fault catalog, spec grammar and event schema.
+"""
+
+from .chaos import (
+    ChaosController,
+    ChaosFault,
+    ChaosIOError,
+    ChaosStepFault,
+    FaultRule,
+    parse_chaos_spec,
+    reset_fire_counts,
+)
+from .policy import (
+    DEFAULT_FATAL_TYPES,
+    RetryPolicy,
+    TrainingFailure,
+    classify_failure,
+    run_with_policy,
+)
+from .preempt import PREEMPT_EXIT_CODE, Preempted, StopRequest
+
+__all__ = [
+    "ChaosController",
+    "ChaosFault",
+    "ChaosIOError",
+    "ChaosStepFault",
+    "DEFAULT_FATAL_TYPES",
+    "FaultRule",
+    "PREEMPT_EXIT_CODE",
+    "Preempted",
+    "RetryPolicy",
+    "StopRequest",
+    "TrainingFailure",
+    "classify_failure",
+    "parse_chaos_spec",
+    "reset_fire_counts",
+    "run_with_policy",
+]

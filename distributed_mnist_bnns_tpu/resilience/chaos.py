@@ -1,0 +1,344 @@
+"""Fault-injection harness: named, seed-deterministic chaos.
+
+Recovery code that is never exercised is recovery code that does not
+work. This module scripts *exact* failure sequences against a live run
+so tests and CI can assert the resilient path end to end — the same
+find-then-fence idea as the analysis/ linter+sanitizers, applied to
+process/IO/state faults.
+
+Spec grammar (``TrainConfig.chaos`` / ``--chaos`` / ``JG_CHAOS`` env)::
+
+    spec     := entry (";" entry)*
+    entry    := kind ["@" arg ("," arg)*]
+    arg      := key "=" value
+    kind     := step_fault | data_io | preempt | slow_host
+              | ckpt_corrupt | ckpt_truncate
+    key      := step | epoch | p | times | delay_s
+
+``step``/``epoch`` trigger a rule the first time the run reaches that
+global optimizer step / epoch (``>=`` semantics, so scan-chunked
+dispatches that jump several steps at once still fire). ``p`` is a
+per-opportunity probability drawn from a rule-local RNG seeded with
+``(run seed, rule key)`` — deterministic replay for a fixed seed and
+call sequence. ``times`` caps total fires (default 1; ``-1`` =
+unlimited); ``delay_s`` is the slow-host stall length.
+
+Fault points:
+
+  step_fault     transient exception before a train-step dispatch
+                 (:class:`ChaosStepFault`, classified retryable)
+  data_io        batch-IO error at the same point
+                 (:class:`ChaosIOError`)
+  preempt        simulated scheduler preemption: requests a graceful
+                 stop exactly as a SIGTERM would (trainer wires
+                 ``on_preempt`` to its StopRequest; without a callback
+                 a real SIGTERM is sent to this process)
+  slow_host      stalls the host ``delay_s`` seconds (straggler sim)
+  ckpt_corrupt   flips bytes in the just-written checkpoint artifact
+  ckpt_truncate  truncates it to half its length
+
+Fire counts live in a **process-global ledger** keyed by spec entry, so
+a ``times=1`` fault does not re-fire when the retry loop rebuilds the
+Trainer (which re-parses the same spec) and replays the same step.
+Tests isolate themselves with :func:`reset_fire_counts`.
+
+Every fire increments ``faults_injected_total`` (label ``kind``) and,
+with a telemetry sink attached, emits a ``fault_injected`` event before
+the fault takes effect — the post-mortem trail proves which failures
+were scripted.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_SPEC = "JG_CHAOS"
+
+FAULT_KINDS = frozenset({
+    "step_fault", "data_io", "preempt", "slow_host",
+    "ckpt_corrupt", "ckpt_truncate",
+})
+
+FAULTS_TOTAL = "faults_injected_total"
+
+# Process-global fire ledger (see module docstring): rule key -> fires.
+_FIRE_LEDGER: Dict[str, int] = {}
+
+
+def reset_fire_counts() -> None:
+    """Forget all fires — call between independent chaos scenarios."""
+    _FIRE_LEDGER.clear()
+
+
+class ChaosFault(RuntimeError):
+    """Base marker for injected faults (classified transient by
+    resilience.policy)."""
+
+
+class ChaosStepFault(ChaosFault):
+    """Injected transient train-step exception."""
+
+
+class ChaosIOError(ChaosFault, OSError):
+    """Injected data-batch IO error."""
+
+
+@dataclass
+class FaultRule:
+    """One parsed spec entry. ``key`` identifies the entry in the
+    process-global fire ledger (spec text + position)."""
+
+    kind: str
+    step: Optional[int] = None
+    epoch: Optional[int] = None
+    p: float = 0.0
+    times: int = 1
+    delay_s: float = 1.0
+    key: str = ""
+
+
+def parse_chaos_spec(spec: str) -> List[FaultRule]:
+    """Parse the chaos spec grammar (module docstring); raises
+    ``ValueError`` with the offending entry on any malformed input so a
+    typo'd CI spec fails loudly, not silently-no-chaos."""
+    rules: List[FaultRule] = []
+    for i, raw in enumerate(e.strip() for e in spec.split(";")):
+        if not raw:
+            continue
+        kind, _, argstr = raw.partition("@")
+        kind = kind.strip()
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown chaos fault kind {kind!r} in {raw!r} "
+                f"(have: {', '.join(sorted(FAULT_KINDS))})"
+            )
+        rule = FaultRule(kind=kind, key=f"{raw}#{i}")
+        casts = {"step": int, "epoch": int, "p": float, "times": int,
+                 "delay_s": float}
+        for arg in (a.strip() for a in argstr.split(",")):
+            if not arg:
+                continue
+            k, sep, v = arg.partition("=")
+            if not sep:
+                raise ValueError(f"chaos arg {arg!r} in {raw!r} is not k=v")
+            if k not in casts:
+                raise ValueError(
+                    f"unknown chaos key {k!r} in {raw!r} "
+                    "(have: step, epoch, p, times, delay_s)"
+                )
+            try:
+                setattr(rule, k, casts[k](v))
+            except ValueError as e:
+                raise ValueError(
+                    f"bad chaos value {v!r} for {k!r} in {raw!r}"
+                ) from e
+        if rule.step is None and rule.epoch is None and rule.p <= 0:
+            raise ValueError(
+                f"chaos entry {raw!r} needs a trigger: step=, epoch= or p="
+            )
+        rules.append(rule)
+    return rules
+
+
+class ChaosController:
+    """Evaluates the parsed rules at the instrumented fault points.
+
+    Hooks (all cheap no-ops when ``active`` is False):
+      * ``on_step(step=, epoch=)`` — called by the trainer before each
+        dispatch; stalls (slow_host), raises (data_io/step_fault) or
+        requests preemption (preempt), in spec order.
+      * ``on_checkpoint_written(path, step=, epoch=)`` — called by the
+        checkpoint writers after the artifact lands; corrupts or
+        truncates it in place (a directory artifact has its largest
+        file hit).
+    """
+
+    def __init__(
+        self,
+        rules: List[FaultRule],
+        *,
+        seed: int = 0,
+        telemetry: Any = None,
+        spec: str = "",
+    ):
+        self.rules = rules
+        self.seed = seed
+        self.telemetry = telemetry
+        self.spec = spec
+        # Wired by the trainer to StopRequest.request; the fallback
+        # exercises the real signal path.
+        self.on_preempt: Optional[Callable[[str], None]] = None
+        self._rngs = {
+            r.key: random.Random(f"{seed}:{r.key}") for r in rules
+        }
+
+    @classmethod
+    def from_config(
+        cls, spec: Optional[str], *, seed: int = 0, telemetry: Any = None
+    ) -> "ChaosController":
+        """Build from an explicit spec, falling back to the ``JG_CHAOS``
+        env var when ``spec`` is None (how CI arms chaos without
+        touching call sites); empty/unset -> inactive controller."""
+        if spec is None:
+            spec = os.environ.get(ENV_SPEC, "")
+        rules = parse_chaos_spec(spec) if spec else []
+        return cls(rules, seed=seed, telemetry=telemetry, spec=spec or "")
+
+    @property
+    def active(self) -> bool:
+        return bool(self.rules)
+
+    # -- trigger evaluation --------------------------------------------------
+
+    def _should_fire(
+        self, rule: FaultRule, step: Optional[int], epoch: Optional[int]
+    ) -> bool:
+        if 0 <= rule.times <= _FIRE_LEDGER.get(rule.key, 0):
+            return False
+        if rule.step is not None:
+            return step is not None and step >= rule.step
+        if rule.epoch is not None:
+            return epoch is not None and epoch >= rule.epoch
+        return self._rngs[rule.key].random() < rule.p
+
+    def _record(
+        self, rule: FaultRule, point: str,
+        step: Optional[int], epoch: Optional[int], detail: str = "",
+    ) -> None:
+        _FIRE_LEDGER[rule.key] = _FIRE_LEDGER.get(rule.key, 0) + 1
+        from ..obs import default_registry  # lazy: keep import-time light
+
+        registry = (
+            self.telemetry.registry if self.telemetry is not None
+            else default_registry()
+        )
+        registry.counter(
+            FAULTS_TOTAL, "chaos faults fired by kind"
+        ).inc(kind=rule.kind)
+        if self.telemetry is not None:
+            # "fault" not "kind": the envelope already owns the kind
+            # field (= "fault_injected").
+            self.telemetry.emit(
+                "fault_injected", fault=rule.kind, point=point,
+                step=step, epoch=epoch, detail=detail, rule=rule.key,
+            )
+        log.warning(
+            "chaos: injected %s at step=%s epoch=%s%s",
+            rule.kind, step, epoch, f" ({detail})" if detail else "",
+        )
+
+    def mark_reached(
+        self, *, step: Optional[int] = None, epoch: Optional[int] = None
+    ) -> None:
+        """Resume bookkeeping across PROCESS restarts: the in-memory
+        fire ledger dies with the process, but a run that restored to
+        ``step``/``epoch`` only got there because the faults scripted at
+        or before that position already fired in the previous process.
+        Counting them as fired here keeps the exit-75 ``--resume``
+        contract live — without it, ``preempt@step=K`` would refire on
+        the first post-restore step (``>=`` semantics) and the job could
+        never progress past K. Called by the trainer after a successful
+        restore. Step rules at ``<= step`` are exhausted up to their
+        ``times`` cap. Epoch rules depend on the fault point: step-
+        boundary kinds (step_fault/data_io/preempt/slow_host) fire at
+        the START of their epoch, so being resumed AT epoch E means an
+        epoch-``<= E`` rule fired (``preempt@epoch=E`` produced this
+        very resume — it must not refire and relaunch-loop); checkpoint-
+        write kinds fire at the END of their epoch, whose save has only
+        happened for epochs strictly before the resumed one."""
+        for rule in self.rules:
+            fired = _FIRE_LEDGER.get(rule.key, 0)
+            if rule.times < 0 or fired >= rule.times:
+                continue
+            at_save = rule.kind in ("ckpt_corrupt", "ckpt_truncate")
+            hit = (
+                rule.step is not None
+                and step is not None
+                and rule.step <= step
+            ) or (
+                rule.step is None
+                and rule.epoch is not None
+                and epoch is not None
+                and (rule.epoch < epoch if at_save else rule.epoch <= epoch)
+            )
+            if hit:
+                _FIRE_LEDGER[rule.key] = rule.times
+                log.info(
+                    "chaos: rule %s counted as already fired before the "
+                    "restored position (step=%s epoch=%s)",
+                    rule.key, step, epoch,
+                )
+
+    # -- fault points --------------------------------------------------------
+
+    def on_step(
+        self, *, step: Optional[int] = None, epoch: Optional[int] = None
+    ) -> None:
+        """Pre-dispatch fault point (raises for data_io/step_fault)."""
+        for rule in self.rules:
+            if not self._should_fire(rule, step, epoch):
+                continue
+            if rule.kind == "slow_host":
+                self._record(
+                    rule, "step", step, epoch, f"stall {rule.delay_s}s"
+                )
+                time.sleep(rule.delay_s)
+            elif rule.kind == "data_io":
+                self._record(rule, "step", step, epoch)
+                raise ChaosIOError(
+                    f"chaos: injected batch-IO failure at step {step}"
+                )
+            elif rule.kind == "step_fault":
+                self._record(rule, "step", step, epoch)
+                raise ChaosStepFault(
+                    f"chaos: injected transient step fault at step {step}"
+                )
+            elif rule.kind == "preempt":
+                self._record(rule, "step", step, epoch)
+                if self.on_preempt is not None:
+                    self.on_preempt(f"chaos preempt at step {step}")
+                else:
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+    def on_checkpoint_written(
+        self, path: str, *,
+        step: Optional[int] = None, epoch: Optional[int] = None,
+    ) -> None:
+        """Post-write fault point: damage the artifact in place. For a
+        hardlinked latest/generation pair the in-place edit hits both —
+        exactly the "this save's bytes were bad" scenario the
+        generation rollback exists for."""
+        for rule in self.rules:
+            if rule.kind not in ("ckpt_corrupt", "ckpt_truncate"):
+                continue
+            if not self._should_fire(rule, step, epoch):
+                continue
+            victim = path
+            if os.path.isdir(path):
+                files = [
+                    os.path.join(root, f)
+                    for root, _, names in os.walk(path) for f in names
+                ]
+                if not files:
+                    continue
+                victim = max(files, key=os.path.getsize)
+            size = os.path.getsize(victim)
+            if rule.kind == "ckpt_truncate":
+                os.truncate(victim, size // 2)
+                detail = f"{victim}: {size} -> {size // 2} bytes"
+            else:
+                with open(victim, "r+b") as f:
+                    f.seek(size // 2)
+                    chunk = f.read(64) or b"\x00"
+                    f.seek(size // 2)
+                    f.write(bytes(b ^ 0xFF for b in chunk))
+                detail = f"{victim}: flipped {min(64, size)} bytes"
+            self._record(rule, "checkpoint_write", step, epoch, detail)

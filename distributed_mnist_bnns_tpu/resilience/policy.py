@@ -1,0 +1,214 @@
+"""Retry policy: jittered backoff + transient/fatal classification.
+
+The replacement for the constant-backoff retry-everything loop of
+``utils/recovery.py`` (kept as a compat shim over this module):
+
+  * **classification** — a dataset ``FileNotFoundError`` will fail the
+    same way 100 times; retrying it burns the budget and hides the real
+    error. Config/programming errors fail fast; IO/chaos/unknown
+    runtime faults retry; :class:`~.preempt.Preempted` resumes without
+    consuming the failure budget (preemption is the *common case* on a
+    TPU fleet, not a failure).
+  * **jittered exponential backoff** — constant-delay retries from a
+    fleet of restarting workers synchronize into thundering herds on
+    whatever shared service failed (filesystem, coordinator);
+    ``base * factor**n`` capped at ``max_backoff_s``, with the top
+    ``jitter`` fraction uniformly randomized, decorrelates them.
+  * **structured events** — every restart lands in the obs event log
+    (``restart`` kind, ``restarts_total`` counter) so a run that
+    limped through N retries is distinguishable from a clean one.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Tuple, TypeVar
+
+from .chaos import ChaosFault
+from .preempt import Preempted
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+RESTARTS_TOTAL = "restarts_total"
+
+# Exceptions that restarting cannot fix: bad config, missing datasets,
+# programming errors. KeyboardInterrupt/SystemExit are handled apart
+# (never retried, never wrapped).
+DEFAULT_FATAL_TYPES: Tuple[type, ...] = (
+    FileNotFoundError,
+    NotADirectoryError,
+    IsADirectoryError,
+    PermissionError,
+    ValueError,
+    TypeError,
+    AttributeError,
+    KeyError,
+    IndexError,
+    ImportError,
+    NotImplementedError,
+    AssertionError,
+)
+
+
+class TrainingFailure(RuntimeError):
+    """Raised when training keeps failing past the retry budget."""
+
+
+def classify_failure(
+    exc: BaseException,
+    *,
+    fatal_types: Tuple[type, ...] = DEFAULT_FATAL_TYPES,
+    transient_types: Tuple[type, ...] = (),
+) -> str:
+    """``"preempt"`` | ``"transient"`` | ``"fatal"``.
+
+    ``transient_types`` wins over ``fatal_types`` (an overridable
+    escape hatch: e.g. a caller whose dataset lives on a flaky NFS
+    mount may declare ``FileNotFoundError`` transient). Unknown
+    exceptions default to transient — the pre-policy behavior retried
+    everything, and an IO stack can surface almost any type."""
+    if isinstance(exc, Preempted):
+        return "preempt"
+    if isinstance(exc, ChaosFault):
+        return "transient"
+    if transient_types and isinstance(exc, transient_types):
+        return "transient"
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
+        return "fatal"
+    if isinstance(exc, fatal_types):
+        return "fatal"
+    return "transient"
+
+
+@dataclass
+class RetryPolicy:
+    """Restart budget + backoff shape + classification overrides."""
+
+    max_restarts: int = 2          # transient-failure budget
+    max_preemptions: int = 64      # graceful-stop resumes (separate:
+                                   # preemption is routine, not failure)
+    base_backoff_s: float = 1.0
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 60.0
+    jitter: float = 0.5            # top fraction of the delay randomized
+    seed: Optional[int] = None     # None: nondeterministic jitter
+    fatal_types: Tuple[type, ...] = DEFAULT_FATAL_TYPES
+    transient_types: Tuple[type, ...] = ()
+    _rng: random.Random = field(init=False, repr=False)
+
+    def __post_init__(self) -> None:
+        self._rng = random.Random(self.seed)
+
+    def classify(self, exc: BaseException) -> str:
+        return classify_failure(
+            exc,
+            fatal_types=self.fatal_types,
+            transient_types=self.transient_types,
+        )
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before retry ``attempt`` (1-based): capped exponential,
+        uniformly jittered over the top ``jitter`` fraction."""
+        raw = min(
+            self.base_backoff_s * self.backoff_factor ** (attempt - 1),
+            self.max_backoff_s,
+        )
+        if raw <= 0:
+            return 0.0
+        floor = raw * (1.0 - min(max(self.jitter, 0.0), 1.0))
+        return floor + self._rng.random() * (raw - floor)
+
+
+def _note_restart(
+    telemetry: Any, *, cause: str, attempt: int,
+    error: BaseException, backoff_s: float,
+) -> None:
+    from ..obs import default_registry  # lazy: keep import-time light
+
+    registry = (
+        telemetry.registry if telemetry is not None else default_registry()
+    )
+    registry.counter(
+        RESTARTS_TOTAL, "resilient-loop trainer restarts"
+    ).inc(cause=cause)
+    if telemetry is not None:
+        telemetry.emit(
+            "restart", cause=cause, attempt=attempt,
+            error_type=type(error).__name__, error=str(error)[:500],
+            backoff_s=round(backoff_s, 3),
+        )
+
+
+def run_with_policy(
+    make_trainer: Callable[[], Any],
+    run: Callable[[Any], T],
+    *,
+    policy: Optional[RetryPolicy] = None,
+    telemetry: Any = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Execute ``run(make_trainer())`` under the retry policy.
+
+    On a transient failure the trainer is rebuilt (with
+    ``TrainConfig.resume=True`` that restores the latest good
+    checkpoint generation — utils/checkpoint.py verifies digests and
+    rolls back past corrupt ones) and the run retried after a jittered
+    backoff, up to ``policy.max_restarts``. A :class:`Preempted` exit
+    restarts immediately and counts against ``max_preemptions`` only.
+    Fatal failures re-raise at once.
+
+    ``telemetry``: an optional obs Telemetry whose event sink receives
+    the ``restart`` events; pass one sharing the run's telemetry dir so
+    the attempts interleave into the same ``events.jsonl`` the trainers
+    append to (each trainer seals its own log before this loop emits).
+    """
+    policy = policy if policy is not None else RetryPolicy()
+    failures = 0
+    preemptions = 0
+    while True:
+        trainer = make_trainer()
+        try:
+            return run(trainer)
+        except Preempted as e:
+            preemptions += 1
+            if preemptions > policy.max_preemptions:
+                raise TrainingFailure(
+                    f"preempted {preemptions} times; giving up"
+                ) from e
+            _note_restart(
+                telemetry, cause="preemption", attempt=preemptions,
+                error=e, backoff_s=0.0,
+            )
+            log.warning(
+                "resuming after preemption %d/%d (%s)",
+                preemptions, policy.max_preemptions, e,
+            )
+        except BaseException as e:
+            kind = policy.classify(e)
+            if kind == "fatal":
+                log.error(
+                    "fatal failure (%s: %s); not retrying",
+                    type(e).__name__, e,
+                )
+                raise
+            failures += 1
+            if failures > policy.max_restarts:
+                raise TrainingFailure(
+                    f"training failed {failures} times; giving up"
+                ) from e
+            delay = policy.backoff(failures)
+            _note_restart(
+                telemetry, cause="transient", attempt=failures,
+                error=e, backoff_s=delay,
+            )
+            log.warning(
+                "training attempt %d/%d failed (%s: %s); restarting from "
+                "latest checkpoint in %.2fs",
+                failures, policy.max_restarts, type(e).__name__, e, delay,
+            )
+            sleep(delay)

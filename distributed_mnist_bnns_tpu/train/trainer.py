@@ -27,6 +27,8 @@ not per step.
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 import logging
 import time
 import weakref
@@ -42,10 +44,13 @@ from flax import struct
 from ..data import batch_iterator, native_batch_iterator, prefetch_to_device
 from ..models import get_model, latent_clamp_mask
 from ..ops.losses import cross_entropy_loss
+from ..resilience import ChaosController, Preempted, StopRequest
 from ..utils.checkpoint import (
     AsyncCheckpointer,
+    CheckpointCorruptionError,
     latest_exists,
     load_checkpoint,
+    load_checkpoint_resilient,
     read_meta,
     save_checkpoint,
 )
@@ -75,6 +80,17 @@ def _dataset_ref(data: Any) -> Callable[[], Any]:
         return weakref.ref(data)
     except TypeError:
         return lambda: data
+
+
+def _rng_key_ints(key: Any) -> list:
+    """A PRNG key as JSON-able ints for checkpoint meta (mid-epoch
+    resume restores it, guarding against a seed-mismatched relaunch).
+    Handles both raw uint32 keys and new-style typed key arrays."""
+    try:
+        data = jax.random.key_data(key)
+    except (TypeError, ValueError):
+        data = key
+    return [int(x) for x in np.ravel(np.asarray(data))]
 
 
 def clamp_latent(params: Any, mask: Any) -> Any:
@@ -554,6 +570,16 @@ class TrainConfig:
                                    # OBSERVABILITY.md budget convention)
     nan_check_every: Optional[int] = None  # NaN-fence stride in steps
                                    # (each check is a host sync)
+    chaos: Optional[str] = None    # fault-injection spec (resilience/
+                                   # chaos, RESILIENCE.md): scripted
+                                   # seed-deterministic faults for
+                                   # chaos tests/CI. None = consult the
+                                   # JG_CHAOS env var; ""/unset = off.
+    checkpoint_keep: int = 3       # checkpoint generations retained for
+                                   # corruption rollback (resilience)
+    handle_preemption: bool = True  # SIGTERM/SIGINT -> graceful stop at
+                                   # the next step boundary + mid-epoch
+                                   # checkpoint + Preempted (exit 75)
 
 
 def _prefetch_chunks(items, size: int = 2):
@@ -664,6 +690,15 @@ class Trainer:
         self.batch_meter = AverageMeter()
         self._setup_telemetry(input_shape)
         self._setup_sanitizer()
+        # Preemption + chaos (resilience/, RESILIENCE.md): the stop flag
+        # is polled at step boundaries; the chaos controller is inactive
+        # unless TrainConfig.chaos / JG_CHAOS scripts faults. A chaos
+        # "preempt" fault requests a graceful stop exactly like SIGTERM.
+        self.stop = StopRequest()
+        self.chaos = ChaosController.from_config(
+            config.chaos, seed=config.seed, telemetry=self.telemetry
+        )
+        self.chaos.on_preempt = self.stop.request
         self._profiled = False  # trace the first epoch this trainer runs
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
@@ -1260,6 +1295,14 @@ class Trainer:
             rng_arg = self._rng_replicator(self.rng)
         else:
             rng_arg = self.rng
+        if self.chaos.active:
+            # Epoch-granular fault point: a one-dispatch epoch has no
+            # observable step boundaries, so chaos (and graceful stops,
+            # handled at the fit-loop boundary) act between epochs.
+            self.chaos.on_step(
+                step=int(np.asarray(jax.device_get(self.state.step))),
+                epoch=epoch,
+            )
         epoch_start = time.perf_counter()
         # Index placement is a deliberate per-epoch host->device upload;
         # it stays OUTSIDE the transfer guard, which covers only the
@@ -1422,17 +1465,31 @@ class Trainer:
         for images, labels in buf:
             yield images, labels, 1
 
-    def train_epoch(self, data, epoch: int) -> Dict[str, float]:
+    def train_epoch(
+        self, data, epoch: int, start_batch: int = 0
+    ) -> Dict[str, float]:
         """One epoch. With ``scan_steps > 1`` batches are grouped into
         (S, B, ...) chunks and each chunk runs as ONE device program
         (``make_train_scan``); recorded per-batch times are then the chunk
         time amortized over its S steps (the host cannot observe
         individual steps of a device-resident loop), and metric logging /
-        profiling happen at chunk granularity."""
+        profiling happen at chunk granularity.
+
+        ``start_batch > 0`` is the step-granular resume of a preempted
+        epoch: the epoch's (deterministic, seed+epoch-keyed) batch
+        sequence is replayed from that position — the streaming loop
+        runs this partial epoch even under ``device_data`` (a one-
+        dispatch epoch has no mid-epoch entry point; both paths draw
+        the identical shard_indices order, so the trajectory matches)."""
         cfg = self.config
-        if self._device_data_active():
+        if self._device_data_active() and not start_batch:
             self._apply_epoch_regime(epoch)
             return self._train_epoch_device(data, epoch)
+        if start_batch:
+            log.info(
+                "resuming epoch %d mid-epoch at batch %d", epoch,
+                start_batch,
+            )
         it_fn = native_batch_iterator if cfg.native_loader else batch_iterator
         it = it_fn(
             data.train_images,
@@ -1443,16 +1500,24 @@ class Trainer:
             host_id=jax.process_index(),
             num_hosts=jax.process_count(),
         )
-        return self._run_train_epoch(it, epoch)
+        return self._run_train_epoch(it, epoch, start_batch=start_batch)
 
-    def _run_train_epoch(self, it, epoch: int) -> Dict[str, float]:
+    def _run_train_epoch(
+        self, it, epoch: int, start_batch: int = 0
+    ) -> Dict[str, float]:
         """The streaming epoch loop over any (images, labels) batch
         iterator — shared by the in-memory path (``train_epoch``) and the
         streaming-dataset path (``fit_stream``). Applies the epoch
         regime itself (every epoch entry point must; keeping it here
-        means a future caller cannot forget the LR schedule)."""
+        means a future caller cannot forget the LR schedule).
+
+        ``start_batch``: batches of this epoch already consumed by a
+        preempted predecessor — skipped off the front of ``it`` (the
+        restored ``state.step`` already accounts for them)."""
         cfg = self.config
         self._apply_epoch_regime(epoch)
+        if start_batch:
+            it = itertools.islice(it, start_batch, None)
         S = self._effective_scan_steps()
         scan_step = self._get_train_scan() if S > 1 else None
         losses, accs = AverageMeter(), AverageMeter()
@@ -1480,9 +1545,33 @@ class Trainer:
             self._profiled = True
             jax.profiler.start_trace(cfg.profile_dir)
         epoch_start = time.perf_counter()
-        seen = 0  # batches (= optimizer steps) run so far this epoch
+        seen = start_batch  # batches (= optimizer steps) done this epoch
+        # Global optimizer step for chaos triggers: one host sync per
+        # epoch, paid only when a chaos spec is active.
+        chaos_base = (
+            int(np.asarray(jax.device_get(self.state.step))) - seen
+            if self.chaos.active else 0
+        )
         try:
             for images, labels, n in items:
+                if self.chaos.active:
+                    # Pre-dispatch fault point: may stall, raise a
+                    # transient fault, or request preemption
+                    # (resilience/chaos, RESILIENCE.md).
+                    self.chaos.on_step(step=chaos_base + seen, epoch=epoch)
+                # Step boundary: honor a pending graceful-stop request
+                # (SIGTERM/SIGINT or chaos preempt) BEFORE the next
+                # dispatch — a stop landing on the epoch's final batch
+                # then falls through to the fit loop's epoch-boundary
+                # stop instead of checkpointing a fully-trained epoch
+                # as "in progress" (resilience/preempt). Single-process
+                # only: a signal may reach one host and not its peers,
+                # and a host stopping unilaterally would strand the
+                # others in the next collective — multi-process runs
+                # stop at the epoch boundary, where _stop_boundary
+                # reaches cross-host agreement first.
+                if self.stop.requested and jax.process_count() <= 1:
+                    self._graceful_stop(epoch, batches_done=seen)
                 t0 = time.perf_counter()
                 if self.mesh is None:
                     # (prefetched) single-device upload; the mesh paths
@@ -1503,7 +1592,7 @@ class Trainer:
                     self.state, metrics = step_fn(
                         self.state, images, labels, self.rng,
                     )
-                first = seen == 0
+                first = seen == start_batch
                 seen += n
                 synced_metrics = None
                 if first or seen % max(cfg.log_interval, 1) < n:
@@ -1639,18 +1728,123 @@ class Trainer:
             from ..utils.checkpoint_orbax import load_checkpoint_orbax
 
             return load_checkpoint_orbax(self.state, ckpt_dir, best=best)
-        state = load_checkpoint(self.state, ckpt_dir, best=best)
-        if self.config.pipeline_parallel > 1:
-            # msgpack restores host arrays; without this the resumed run
-            # would lose the per-stage placement of block params and
-            # optimizer moments.
+        return self._place_restored_msgpack(
+            load_checkpoint(self.state, ckpt_dir, best=best)
+        )
+
+    def _place_restored_msgpack(self, state: TrainState) -> TrainState:
+        """Post-restore placement shared by ``restore`` and
+        ``try_resume``: msgpack restores host arrays, so a pipeline-
+        parallel run must re-place block params (and optimizer moments)
+        onto its 'pipe' mesh — orbax restores directly onto the
+        template's shardings and passes through untouched."""
+        if (
+            self.config.checkpoint_backend != "orbax"
+            and self.config.pipeline_parallel > 1
+        ):
             from ..parallel import place_pipelined_state
 
             state = place_pipelined_state(state, self._pp_mesh)
         return state
 
-    def try_resume(self) -> int:
-        """Restore the latest checkpoint if present; returns start epoch.
+    def _saver(self) -> Callable:
+        return (
+            self._checkpointer.save if self._checkpointer is not None
+            else save_checkpoint
+        )
+
+    def _stop_boundary(self) -> bool:
+        """Epoch-boundary stop decision. Single-process: the local
+        flag. Multi-process: hosts must AGREE before anyone stops — a
+        SIGTERM that reached only some hosts would otherwise strand the
+        rest in the next epoch's collectives waiting on an exited peer.
+        Every host calls this once per epoch (the agreement is itself a
+        collective, so the call sites must be unconditional), and any
+        single host's pending request stops them all."""
+        if jax.process_count() <= 1:
+            return self.stop.requested
+        from jax.experimental import multihost_utils  # pragma: no cover
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self.stop.requested], np.int32)
+        )
+        if bool(np.asarray(flags).any()):
+            if not self.stop.requested:
+                self.stop.request("preemption on a peer host")
+            return True
+        return False
+
+    def _graceful_stop(self, epoch: int, batches_done: Optional[int] = None,
+                       write_checkpoint: bool = True) -> None:
+        """Stop NOW, cleanly: write a step-granular checkpoint (meta
+        carries the in-progress epoch, the data position and the rng key
+        so ``try_resume`` continues mid-epoch), emit the
+        ``graceful_stop`` event, and raise :class:`Preempted` — fit's
+        distinct, resumable exit (cli maps it to exit code 75;
+        run_with_policy resumes without burning the failure budget).
+
+        ``batches_done=None`` marks an epoch-boundary stop (the regular
+        per-epoch checkpoint, already written by the fit loop when
+        ``write_checkpoint`` is False, is the resume point)."""
+        cfg = self.config
+        if self._checkpointer is not None:
+            # We are exiting: any in-flight async save must land (and,
+            # for orbax, finalize its meta sidecar) before the process
+            # dies or a rebuilt trainer races the same directory.
+            self._checkpointer.wait()
+        # write_checkpoint=False means the fit loop already wrote the
+        # per-epoch checkpoint this stop resumes from.
+        saved = not write_checkpoint and bool(cfg.checkpoint_dir)
+        if write_checkpoint and cfg.checkpoint_dir:
+            extra = {
+                "best_acc": getattr(self, "best_acc", 0.0),
+                "preempted": True,
+                "rng_key": _rng_key_ints(self.rng),
+            }
+            if batches_done is not None:
+                extra["epoch_in_progress"] = int(epoch)
+                extra["batch_in_epoch"] = int(batches_done)
+            # epoch meta records the last COMPLETED epoch (-1: none) so
+            # a digest-only reader resumes at worst a whole epoch back.
+            self._saver()(
+                self.state,
+                cfg.checkpoint_dir,
+                epoch=epoch - 1 if batches_done is not None else epoch,
+                extra_meta=extra,
+                keep_generations=cfg.checkpoint_keep,
+                chaos=self.chaos,
+            )
+            if self._checkpointer is not None:
+                self._checkpointer.wait()  # exiting: the write must land
+            saved = True
+        step = int(np.asarray(jax.device_get(self.state.step)))
+        self.telemetry.registry.counter(
+            "graceful_stops_total", "preemption-driven graceful stops"
+        ).inc()
+        self.telemetry.emit(
+            "graceful_stop", epoch=int(epoch), step=step,
+            batch_in_epoch=batches_done, checkpoint_saved=saved,
+            reason=self.stop.reason,
+        )
+        log.warning(
+            "graceful stop at epoch %d step %d (%s): %s", epoch, step,
+            self.stop.reason,
+            "mid-epoch checkpoint written" if saved else "no checkpoint dir",
+        )
+        raise Preempted(epoch, step, self.stop.reason or "")
+
+    def try_resume(self) -> Tuple[int, int]:
+        """Restore the newest *verified* checkpoint if present; returns
+        ``(start_epoch, start_batch)`` — ``start_batch > 0`` continues a
+        preempted epoch at step granularity.
+
+        msgpack restores go through ``load_checkpoint_resilient``:
+        content digests are verified and a truncated/corrupt latest
+        rolls back to the previous good generation (``rollback`` event);
+        if every generation is damaged the run restarts from scratch
+        rather than crash-looping. Each successful restore emits a
+        ``resume`` event, so a resumed run is distinguishable from a
+        fresh one in the event log.
 
         Checkpoints carry the run's parameter layout: a pipeline-parallel
         run saves the {blocks, rest} stage-major layout (convert with
@@ -1660,25 +1854,95 @@ class Trainer:
             self._checkpointer.wait()  # make any in-flight save visible
         ckpt = self.config.checkpoint_dir
         if not ckpt:
-            return 0
+            return 0, 0
         if self.config.checkpoint_backend == "orbax":
-            from ..utils.checkpoint_orbax import latest_exists_orbax
+            from ..utils.checkpoint_orbax import (
+                latest_exists_orbax,
+                load_checkpoint_orbax_resilient,
+            )
 
             if not latest_exists_orbax(ckpt):
-                return 0
-        elif not latest_exists(ckpt):
-            return 0
-        self.state = self.restore(ckpt)
-        meta = read_meta(ckpt)
+                return 0, 0
+            load = load_checkpoint_orbax_resilient
+        else:
+            if not latest_exists(ckpt) and not read_meta(ckpt).get(
+                "generations"
+            ):
+                return 0, 0
+            load = load_checkpoint_resilient
+        try:
+            state, info = load(self.state, ckpt)
+        except CheckpointCorruptionError as e:
+            log.error(
+                "every checkpoint generation under %s is corrupt "
+                "(%s); starting from scratch", ckpt, e,
+            )
+            self.telemetry.registry.counter(
+                "rollbacks_total", "checkpoint generation rollbacks"
+            ).inc(outcome="fresh_start")
+            self.telemetry.emit(
+                "rollback", path=ckpt, file=None,
+                outcome="fresh_start", error=str(e)[:500],
+            )
+            return 0, 0
+        self.state = self._place_restored_msgpack(state)
+        meta = info.get("meta") or {}
+        if info.get("rolled_back"):
+            self.telemetry.registry.counter(
+                "rollbacks_total", "checkpoint generation rollbacks"
+            ).inc(outcome="generation")
+            self.telemetry.emit(
+                "rollback", path=ckpt, file=info.get("file"),
+                outcome="generation", generation=meta.get("generation"),
+                skipped="; ".join(info.get("errors") or [])[:500],
+            )
         self.best_acc = float(meta.get("best_acc") or 0.0)
-        start = int(meta.get("epoch", -1)) + 1
-        log.info("resumed from %s at epoch %d (step %d)", ckpt, start,
-                 int(self.state.step))
-        return start
+        if meta.get("epoch_in_progress") is not None and meta.get(
+            "batch_in_epoch"
+        ):
+            start = int(meta["epoch_in_progress"])
+            start_batch = int(meta["batch_in_epoch"])
+        else:
+            start = int(meta.get("epoch", -1) if meta.get("epoch") is not
+                        None else -1) + 1
+            start_batch = 0
+        raw_key = meta.get("rng_key")
+        if raw_key:
+            try:
+                self.rng = jnp.asarray(raw_key, jnp.uint32)
+            except (TypeError, ValueError) as e:
+                log.warning(
+                    "could not restore rng key from checkpoint meta "
+                    "(%s); keeping the seed-derived key", e,
+                )
+        if self.chaos.active:
+            # Cross-process resume: faults scripted at or before the
+            # restored position already fired in the previous process
+            # (the in-memory fire ledger did not survive it) — without
+            # this, preempt@step=K would refire immediately after the
+            # exit-75 --resume relaunch it caused.
+            self.chaos.mark_reached(step=meta.get("step"), epoch=start)
+        self.telemetry.registry.counter(
+            "resumes_total", "checkpoint restores before training"
+        ).inc()
+        self.telemetry.emit(
+            "resume", epoch=start, batch_in_epoch=start_batch or None,
+            step=meta.get("step"), path=ckpt, file=info.get("file"),
+            digest_verified=info.get("digest_verified"),
+            rolled_back=bool(info.get("rolled_back")),
+        )
+        log.info(
+            "resumed from %s at epoch %d%s (step %d)", ckpt, start,
+            f" batch {start_batch}" if start_batch else "",
+            int(self.state.step),
+        )
+        return start, start_batch
 
     def fit(self, data, eval_every: int = 1) -> list[Dict[str, float]]:
         return self._fit_loop(
-            lambda epoch: self.train_epoch(data, epoch),
+            lambda epoch, start_batch=0: self.train_epoch(
+                data, epoch, start_batch=start_batch
+            ),
             lambda: self.evaluate(data),
             eval_every,
         )
@@ -1698,13 +1962,13 @@ class Trainer:
         eval_data only the latest (and per-epoch) checkpoints are
         written, never a 'best' copy."""
 
-        def train(epoch: int) -> Dict[str, float]:
+        def train(epoch: int, start_batch: int = 0) -> Dict[str, float]:
             it = stream.batches(
                 self.config.batch_size, epoch=epoch, seed=self.config.seed,
                 host_id=jax.process_index(),
                 num_hosts=jax.process_count(),
             )
-            return self._run_train_epoch(it, epoch)
+            return self._run_train_epoch(it, epoch, start_batch=start_batch)
 
         return self._fit_loop(
             train,
@@ -1716,69 +1980,102 @@ class Trainer:
     def _fit_loop(self, train_fn, eval_fn, eval_every) -> list:
         history = []
         self.best_acc = getattr(self, "best_acc", 0.0)
-        start_epoch = self.try_resume() if self.config.resume else 0
-        for epoch in range(start_epoch, self.config.epochs):
-            row: Dict[str, float] = {"epoch": epoch}
-            try:
-                row.update(train_fn(epoch))
-                if eval_fn is not None and eval_every and (
-                    (epoch + 1) % eval_every == 0
-                ):
-                    eval_row = eval_fn()
-                    row.update(eval_row)
-                    self.telemetry.emit("eval", epoch=epoch, **eval_row)
-                history.append(row)
-                if self.config.checkpoint_dir:
-                    acc = row.get("test_acc", 0.0)
-                    is_best = acc > self.best_acc
-                    self.best_acc = max(self.best_acc, acc)
-                    save = (
-                        self._checkpointer.save
-                        if self._checkpointer is not None
-                        else save_checkpoint
-                    )
-                    save(
-                        self.state,
-                        self.config.checkpoint_dir,
-                        is_best=is_best,
-                        epoch=epoch,
-                        save_all=self.config.save_all_epochs,
-                        extra_meta={"best_acc": self.best_acc, **{
-                            k: v for k, v in row.items()
-                            if isinstance(v, float)
-                        }},
-                    )
-                    self.telemetry.checkpoint(
-                        epoch, self.config.checkpoint_dir, best=is_best
-                    )
-                    if (
-                        self._checkpointer is not None
-                        and not self.config.async_checkpoint
+        start_epoch, start_batch = (
+            self.try_resume() if self.config.resume else (0, 0)
+        )
+        with contextlib.ExitStack() as stack:
+            if self.config.handle_preemption:
+                # SIGTERM/SIGINT -> graceful stop at the next step
+                # boundary (previous handlers restored on exit; no-op
+                # off the main thread).
+                stack.enter_context(self.stop.install())
+            for epoch in range(start_epoch, self.config.epochs):
+                row: Dict[str, float] = {"epoch": epoch}
+                try:
+                    row.update(train_fn(
+                        epoch, start_batch if epoch == start_epoch else 0
+                    ))
+                    if eval_fn is not None and eval_every and (
+                        (epoch + 1) % eval_every == 0
                     ):
-                        # orbax saves are natively async; without the
-                        # --async-checkpoint opt-in, keep blocking
-                        # semantics.
-                        self._checkpointer.wait()
-                if jax.process_index() == 0:
-                    log.info(
-                        "epoch %d done: %s", epoch,
-                        {k: round(v, 4) for k, v in row.items()
-                         if k != "epoch"},
+                        eval_row = eval_fn()
+                        row.update(eval_row)
+                        self.telemetry.emit("eval", epoch=epoch, **eval_row)
+                    history.append(row)
+                    if self.config.checkpoint_dir:
+                        acc = row.get("test_acc", 0.0)
+                        is_best = acc > self.best_acc
+                        self.best_acc = max(self.best_acc, acc)
+                        self._saver()(
+                            self.state,
+                            self.config.checkpoint_dir,
+                            is_best=is_best,
+                            epoch=epoch,
+                            save_all=self.config.save_all_epochs,
+                            extra_meta={"best_acc": self.best_acc, **{
+                                k: v for k, v in row.items()
+                                if isinstance(v, float)
+                            }},
+                            keep_generations=self.config.checkpoint_keep,
+                            chaos=self.chaos,
+                        )
+                        self.telemetry.checkpoint(
+                            epoch, self.config.checkpoint_dir, best=is_best
+                        )
+                        if (
+                            self._checkpointer is not None
+                            and not self.config.async_checkpoint
+                        ):
+                            # orbax saves are natively async; without the
+                            # --async-checkpoint opt-in, keep blocking
+                            # semantics.
+                            self._checkpointer.wait()
+                    if jax.process_index() == 0:
+                        log.info(
+                            "epoch %d done: %s", epoch,
+                            {k: round(v, 4) for k, v in row.items()
+                             if k != "epoch"},
+                        )
+                        self.results.add(**row)
+                        if self.config.results_path:
+                            self.results.save()
+                    # Epoch-boundary graceful stop: the per-epoch
+                    # checkpoint just written (if configured) is the
+                    # resume point — no mid-epoch save needed. Not on
+                    # the final epoch: training is complete, exiting
+                    # "resumable" would tell the supervisor to relaunch
+                    # a finished run (which would then return an empty
+                    # history). The epoch guard is evaluated first so
+                    # every host skips the _stop_boundary collective on
+                    # the last epoch consistently.
+                    if epoch < self.config.epochs - 1 and (
+                        self._stop_boundary()
+                    ):
+                        self._graceful_stop(
+                            epoch, batches_done=None,
+                            write_checkpoint=False,
+                        )
+                except Preempted:
+                    # Not a crash: the graceful_stop event is already in
+                    # the log; seal it and hand the distinct, resumable
+                    # exit to the caller (cli -> exit 75;
+                    # run_with_policy -> resume, budget untouched).
+                    self.telemetry.close(
+                        preempted=True, epochs=len(history)
                     )
-                    self.results.add(**row)
-                    if self.config.results_path:
-                        self.results.save()
-            except Exception as e:
-                # Bank the failure in the event log (post-mortem trail)
-                # and seal it — close() stops the heartbeat thread, so a
-                # crashed run stops reporting "alive" the moment it dies
-                # — before the crash propagates; fit's error contract is
-                # unchanged. The whole epoch body is covered: a
-                # checkpoint-save or results-IO failure must leave the
-                # same trail as a train-step one.
-                self.telemetry.error(e, epoch=epoch)
-                self.telemetry.close(crashed=True, epochs=len(history))
-                raise
+                    raise
+                except Exception as e:
+                    # Bank the failure in the event log (post-mortem
+                    # trail) and seal it — close() stops the heartbeat
+                    # thread, so a crashed run stops reporting "alive"
+                    # the moment it dies — before the crash propagates;
+                    # fit's error contract is unchanged. The whole epoch
+                    # body is covered: a checkpoint-save or results-IO
+                    # failure must leave the same trail as a train-step
+                    # one.
+                    self.telemetry.error(e, epoch=epoch)
+                    self.telemetry.close(crashed=True, epochs=len(history))
+                    raise
         if self._checkpointer is not None:
             # Join the last async write (and re-raise any IO error) before
             # reporting the run finished — fit's contract is "checkpoints

@@ -17,15 +17,26 @@ Serialization is flax.serialization msgpack of the full train-state pytree
 (params incl. fp32 latent masters, batch_stats, optimizer state, step) —
 written atomically (tmp + rename) so a crash mid-write never corrupts the
 latest checkpoint.
+
+Integrity + rollback (resilience/, RESILIENCE.md): every save records a
+sha256 content digest and a monotonically increasing **generation**
+number in ``checkpoint_meta.json``, and hardlinks the artifact to
+``checkpoint_gen_<g>.msgpack`` (metadata-only cost; byte-copy fallback),
+keeping the newest ``keep_generations``. ``load_checkpoint_resilient``
+verifies the digest on restore and falls back generation by generation
+past truncated/corrupt artifacts — atomic rename protects against *our*
+crash mid-write, digests + generations protect against everything else
+(torn NFS writes, bitrot, a chaos-injected corruption).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
 import shutil
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
@@ -36,6 +47,21 @@ log = logging.getLogger(__name__)
 LATEST = "checkpoint.msgpack"
 BEST = "model_best.msgpack"
 META = "checkpoint_meta.json"
+GEN_PREFIX = "checkpoint_gen_"
+DEFAULT_KEEP_GENERATIONS = 3
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """No checkpoint generation under the directory could be verified
+    and deserialized."""
+
+
+class CheckpointTemplateMismatch(ValueError):
+    """A digest-VERIFIED artifact failed to deserialize into the
+    caller's state template — the checkpoint is intact but the
+    model/config changed. A ValueError so the retry policy classifies
+    it fatal: rolling back (or restarting fresh) would silently discard
+    a healthy run's checkpoints."""
 
 
 def _barrier(name: str) -> None:
@@ -51,6 +77,32 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
+def _write_meta(path: str, meta: dict) -> None:
+    """Atomic (tmp+rename) meta-sidecar write: the meta now decides
+    which artifact to trust (digest, generation ledger, mid-epoch
+    resume position), so a kill mid-write must leave the previous
+    sidecar intact, not a truncated one that read_meta degrades to {}
+    — which would silently disable verification/rollback and restart
+    the epoch/generation bookkeeping."""
+    target = os.path.join(path, META)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, target)
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    """Hardlink ``src`` to ``dst`` (content shared, metadata-only cost),
+    replacing any stale ``dst``; byte-copy fallback for filesystems
+    without link support."""
+    try:
+        if os.path.exists(dst):
+            os.remove(dst)
+        os.link(src, dst)
+    except OSError:  # pragma: no cover - FS without hardlinks
+        shutil.copyfile(src, dst)
+
+
 def _write_checkpoint(
     host_state: Any,
     path: str,
@@ -58,32 +110,71 @@ def _write_checkpoint(
     epoch: Optional[int],
     save_all: bool,
     extra_meta: Optional[dict],
+    keep_generations: Optional[int] = None,
+    chaos: Any = None,
 ) -> str:
     """Serialize an already-host-resident state pytree and write it
     atomically (process 0 only). Pure host work — safe to run on a
-    background thread (AsyncCheckpointer) or inline (save_checkpoint)."""
+    background thread (AsyncCheckpointer) or inline (save_checkpoint).
+
+    ``chaos``: an optional resilience.ChaosController whose
+    checkpoint-write fault point runs after the artifact lands — the
+    injection site the integrity/rollback machinery is tested against.
+    """
     os.makedirs(path, exist_ok=True)
     target = os.path.join(path, LATEST)
     if jax.process_index() == 0:
+        keep = (
+            DEFAULT_KEEP_GENERATIONS if keep_generations is None
+            else max(int(keep_generations), 1)
+        )
         data = serialization.to_bytes(host_state)
+        digest = hashlib.sha256(data).hexdigest()
+        prev_meta = read_meta(path)
+        prev_gen = prev_meta.get("generation")
+        generation = int(prev_gen) + 1 if prev_gen is not None else 0
+        step = (
+            int(np.asarray(host_state.step))
+            if hasattr(host_state, "step") else None
+        )
         tmp = target + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, target)  # atomic
         meta = {
             "epoch": epoch,
-            "step": int(np.asarray(host_state.step))
-            if hasattr(host_state, "step") else None,
+            "step": step,
+            "digest": digest,
+            "generation": generation,
         }
         meta.update(extra_meta or {})
-        with open(os.path.join(path, META), "w") as f:
-            json.dump(meta, f)
+        gen_file = f"{GEN_PREFIX}{generation}.msgpack"
+        _link_or_copy(target, os.path.join(path, gen_file))
+        # Generation ledger, newest first: each record is the meta of
+        # its save (digest included) so a rollback restores the right
+        # epoch/step/best_acc bookkeeping, not the latest's.
+        generations = [{"file": gen_file, **meta}]
+        generations += [
+            g for g in (prev_meta.get("generations") or [])
+            if g.get("file") and g["file"] != gen_file
+        ]
+        for stale in generations[keep:]:
+            try:
+                os.remove(os.path.join(path, stale["file"]))
+            except OSError as e:
+                log.warning(
+                    "could not prune generation %s: %s", stale["file"], e
+                )
+        meta["generations"] = generations[:keep]
+        _write_meta(path, meta)
         if is_best:
             shutil.copyfile(target, os.path.join(path, BEST))
         if save_all and epoch is not None:
             shutil.copyfile(
                 target, os.path.join(path, f"checkpoint_epoch_{epoch}.msgpack")
             )
+        if chaos is not None:
+            chaos.on_checkpoint_written(target, epoch=epoch, step=step)
         log.info("saved checkpoint to %s (epoch=%s best=%s)", target, epoch, is_best)
     return target
 
@@ -96,13 +187,16 @@ def save_checkpoint(
     epoch: Optional[int] = None,
     save_all: bool = False,
     extra_meta: Optional[dict] = None,
+    keep_generations: Optional[int] = None,
+    chaos: Any = None,
 ) -> str:
     """Write the latest checkpoint (+ best / per-epoch copies).
 
     Only process 0 writes; every process passes the trailing barrier so no
     one races ahead to read a half-written file."""
     target = _write_checkpoint(
-        _to_host(state), path, is_best, epoch, save_all, extra_meta
+        _to_host(state), path, is_best, epoch, save_all, extra_meta,
+        keep_generations, chaos,
     )
     _barrier("checkpoint_save")
     return target
@@ -146,12 +240,14 @@ class AsyncCheckpointer:
         epoch: Optional[int] = None,
         save_all: bool = False,
         extra_meta: Optional[dict] = None,
+        keep_generations: Optional[int] = None,
+        chaos: Any = None,
     ) -> str:
         self.wait()  # single writer: preserve on-disk ordering
         host_state = _to_host(state)  # sync snapshot; copies off device
         self._inflight = self._executor.submit(
             _write_checkpoint, host_state, path, is_best, epoch, save_all,
-            extra_meta,
+            extra_meta, keep_generations, chaos,
         )
         return os.path.join(path, LATEST)
 
@@ -193,7 +289,139 @@ def read_meta(path: str) -> dict:
             return json.load(f)
     except FileNotFoundError:
         return {}
+    except json.JSONDecodeError as e:
+        # A torn meta write must degrade like a missing meta (epoch-0
+        # bookkeeping), not poison every resume attempt.
+        log.warning("unreadable checkpoint meta under %s: %s", path, e)
+        return {}
 
 
 def latest_exists(path: str) -> bool:
     return os.path.exists(os.path.join(path, LATEST))
+
+
+def file_digest(path: str) -> str:
+    """Streaming sha256 of a file (checkpoints can be many GB)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_checkpoint(
+    path: str, *, file: str = LATEST, digest: Optional[str] = None
+) -> bool:
+    """True iff ``file`` under the checkpoint dir matches ``digest``
+    (default: the digest recorded in the meta sidecar). A missing
+    digest (pre-integrity checkpoint) verifies vacuously True so old
+    artifacts stay loadable."""
+    fpath = os.path.join(path, file)
+    if not os.path.exists(fpath):
+        return False
+    if digest is None:
+        digest = read_meta(path).get("digest")
+    if not digest:
+        return True
+    return file_digest(fpath) == digest
+
+
+def load_checkpoint_resilient(
+    state_template: Any, path: str
+) -> Tuple[Any, dict]:
+    """Restore the newest checkpoint generation that verifies and
+    deserializes, rolling back past truncated/corrupt artifacts.
+
+    Candidates, newest first: the latest artifact (against the
+    top-level meta digest), then each record in the meta's
+    ``generations`` ledger. Digest mismatch or a deserialization error
+    moves on to the next candidate; pre-integrity checkpoints (no
+    digest) skip verification. Assumes the multi-host shared-filesystem
+    contract of this module (all processes see the same bytes, so all
+    roll back to the same generation).
+
+    Returns ``(state, info)`` where ``info`` carries ``file``,
+    ``digest_verified`` (None = no digest recorded), ``rolled_back``,
+    ``errors`` (what was skipped, for the rollback event) and ``meta``
+    (the record of the generation actually restored — its epoch/step,
+    not the corrupt latest's). Raises
+    :class:`CheckpointCorruptionError` when nothing under ``path``
+    loads."""
+    meta = read_meta(path)
+    candidates = []
+    if os.path.exists(os.path.join(path, LATEST)):
+        candidates.append(
+            {"file": LATEST,
+             **{k: v for k, v in meta.items() if k != "generations"}}
+        )
+    for record in meta.get("generations") or []:
+        if record.get("file") and os.path.exists(
+            os.path.join(path, record["file"])
+        ):
+            candidates.append(record)
+    template = _to_host(state_template)
+    errors = []
+    tried: list = []  # inodes already rejected (latest and the newest
+    #                   generation are hardlinks — don't re-hash GBs)
+    for i, record in enumerate(candidates):
+        fname = record["file"]
+        fpath = os.path.join(path, fname)
+        try:
+            if any(os.path.samefile(fpath, t) for t in tried):
+                errors.append(f"{fname}: same file as a rejected candidate")
+                continue
+        except OSError:
+            pass  # racing deletion; the open below reports it
+        # One read serves both the digest check and the deserialize —
+        # checkpoints are GBs and this is the resume hot path; a
+        # streaming-hash-then-reread would double the IO.
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            errors.append(f"{fname}: {type(e).__name__}: {e}")
+            continue
+        digest = record.get("digest")
+        verified: Optional[bool] = None
+        if digest:
+            if hashlib.sha256(data).hexdigest() != digest:
+                errors.append(f"{fname}: digest mismatch")
+                tried.append(fpath)
+                continue
+            verified = True
+        try:
+            restored = serialization.from_bytes(template, data)
+        except Exception as e:
+            if verified:
+                # Intact bytes that don't fit the template: the MODEL
+                # changed, not the file. Falling back would walk every
+                # generation, "succeed" as a fresh start, and let the
+                # next saves prune the healthy checkpoints.
+                raise CheckpointTemplateMismatch(
+                    f"{fname} under {path} is digest-verified but does "
+                    f"not deserialize into the trainer's state template "
+                    f"({type(e).__name__}: {e}) — model/config mismatch "
+                    "with the checkpoint, not corruption"
+                ) from e
+            # Corrupt msgpack surfaces as a zoo of parse/ValueError
+            # types; any of them just means "next generation".
+            errors.append(f"{fname}: {type(e).__name__}: {e}")
+            tried.append(fpath)
+            continue
+        if errors:
+            log.warning(
+                "checkpoint rollback: restored %s after skipping %s",
+                fname, "; ".join(errors),
+            )
+        _barrier("checkpoint_load")
+        return restored, {
+            "file": fname,
+            "digest_verified": verified,
+            "rolled_back": i > 0,
+            "errors": errors,
+            "meta": dict(record),
+        }
+    raise CheckpointCorruptionError(
+        f"no loadable checkpoint under {path}: "
+        + ("; ".join(errors) if errors else "no checkpoint files")
+    )

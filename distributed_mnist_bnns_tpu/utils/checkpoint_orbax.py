@@ -20,11 +20,10 @@ backend-agnostic.
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import shutil
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 
@@ -34,6 +33,7 @@ log = logging.getLogger(__name__)
 
 LATEST_DIR = "orbax_latest"
 BEST_DIR = "orbax_best"
+GEN_DIR_PREFIX = "orbax_gen_"
 META = "checkpoint_meta.json"
 
 
@@ -80,6 +80,8 @@ class OrbaxCheckpointer:
         epoch: Optional[int] = None,
         save_all: bool = False,
         extra_meta: Optional[dict] = None,
+        keep_generations: Optional[int] = None,
+        chaos: Any = None,
     ) -> str:
         self.wait()  # single writer: preserve on-disk ordering
         path = os.path.abspath(path)
@@ -88,18 +90,57 @@ class OrbaxCheckpointer:
         # Every process participates: each writes the shards it owns.
         self._ckptr.save(target, _state_arrays(state), force=True)
         self._pending_meta = (path, is_best, epoch, save_all, extra_meta,
-                              int(jax.device_get(state.step)))
+                              int(jax.device_get(state.step)),
+                              keep_generations, chaos)
         return target
 
     def _finalize_meta(self) -> None:
-        path, is_best, epoch, save_all, extra, step = self._pending_meta
+        (path, is_best, epoch, save_all, extra, step,
+         keep_generations, chaos) = self._pending_meta
         self._pending_meta = None
         target = os.path.join(path, LATEST_DIR)
         if jax.process_index() == 0:
-            meta = {"epoch": epoch, "step": step, "backend": "orbax"}
+            from .checkpoint import (
+                DEFAULT_KEEP_GENERATIONS,
+                _write_meta,
+                read_meta,
+            )
+
+            keep = (
+                DEFAULT_KEEP_GENERATIONS if keep_generations is None
+                else max(int(keep_generations), 1)
+            )
+            prev_meta = read_meta(path)
+            prev_gen = prev_meta.get("generation")
+            generation = int(prev_gen) + 1 if prev_gen is not None else 0
+            # No content digest: orbax's commit protocol already
+            # detects torn writes (an uncommitted dir never restores);
+            # the field stays for schema parity with msgpack metas.
+            # In-place damage to a COMMITTED dir is covered by
+            # load_checkpoint_orbax_resilient (generation-dir rollback).
+            meta = {
+                "epoch": epoch, "step": step, "backend": "orbax",
+                "digest": None, "generation": generation,
+            }
             meta.update(extra or {})
-            with open(os.path.join(path, META), "w") as f:
-                json.dump(meta, f)
+            # Rollback generations, mirroring the msgpack ledger:
+            # hardlink-tree copies named by generation, newest `keep`
+            # retained. The save_all per-epoch dirs are the USER'S
+            # archive and are never pruned (msgpack parity).
+            gen_dir = f"{GEN_DIR_PREFIX}{generation}"
+            _link_tree(target, os.path.join(path, gen_dir))
+            generations = [{"dir": gen_dir, "epoch": epoch, "step": step,
+                            "generation": generation}]
+            generations += [
+                g for g in (prev_meta.get("generations") or [])
+                if g.get("dir") and g["dir"] != gen_dir
+            ]
+            for stale in generations[keep:]:
+                shutil.rmtree(
+                    os.path.join(path, stale["dir"]), ignore_errors=True
+                )
+            meta["generations"] = generations[:keep]
+            _write_meta(path, meta)
             # best / per-epoch copies: HARDLINK the committed payload
             # (os.link as the copy function) so the copy is metadata-only
             # — no re-serialization through one host, no duplicated
@@ -111,6 +152,10 @@ class OrbaxCheckpointer:
                 _link_tree(
                     target, os.path.join(path, f"orbax_epoch_{epoch}")
                 )
+            if chaos is not None:
+                # resilience fault point: corrupts the largest file in
+                # the committed payload (RESILIENCE.md).
+                chaos.on_checkpoint_written(target, epoch=epoch, step=step)
             log.info(
                 "saved orbax checkpoint to %s (epoch=%s best=%s)",
                 target, epoch, is_best,
@@ -141,26 +186,25 @@ def save_checkpoint_orbax(
     epoch: Optional[int] = None,
     save_all: bool = False,
     extra_meta: Optional[dict] = None,
+    keep_generations: Optional[int] = None,
+    chaos: Any = None,
 ) -> str:
     """Blocking orbax save (the async variant is OrbaxCheckpointer)."""
     with OrbaxCheckpointer() as ck:
         return ck.save(
             state, path, is_best=is_best, epoch=epoch, save_all=save_all,
-            extra_meta=extra_meta,
+            extra_meta=extra_meta, keep_generations=keep_generations,
+            chaos=chaos,
         )
 
 
-def load_checkpoint_orbax(
-    state_template: Any, path: str, *, best: bool = False
-) -> Any:
-    """Restore into the template's structure AND shardings: each leaf
-    comes back as a jax.Array placed exactly like the template's (an
-    FSDP/TP-sharded state restores sharded, per process, no gather)."""
+def _restore_target(state_template: Any, target: str) -> Any:
+    """Restore one orbax checkpoint dir into the template's structure
+    AND shardings: each leaf comes back as a jax.Array placed exactly
+    like the template's (an FSDP/TP-sharded state restores sharded, per
+    process, no gather). No barrier — callers barrier once they commit
+    to a candidate."""
     import orbax.checkpoint as ocp
-
-    target = os.path.join(
-        os.path.abspath(path), BEST_DIR if best else LATEST_DIR
-    )
 
     def abstract(x):
         sharding = getattr(x, "sharding", None)
@@ -173,12 +217,87 @@ def load_checkpoint_orbax(
     template = jax.tree.map(abstract, _state_arrays(state_template))
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(target, template)
-    _barrier("orbax_checkpoint_load")
     return state_template.replace(
         step=restored["step"],
         params=restored["params"],
         batch_stats=restored["batch_stats"],
         opt_state=restored["opt_state"],
+    )
+
+
+def load_checkpoint_orbax(
+    state_template: Any, path: str, *, best: bool = False
+) -> Any:
+    """Restore the latest (or best) orbax checkpoint (see
+    ``_restore_target``)."""
+    state = _restore_target(
+        state_template,
+        os.path.join(os.path.abspath(path), BEST_DIR if best else LATEST_DIR),
+    )
+    _barrier("orbax_checkpoint_load")
+    return state
+
+
+def load_checkpoint_orbax_resilient(
+    state_template: Any, path: str
+) -> Tuple[Any, dict]:
+    """The orbax counterpart of ``checkpoint.load_checkpoint_resilient``
+    — same ``(state, info)`` contract. Orbax has no content digests
+    (its commit protocol rejects torn/uncommitted writes), so candidate
+    order is: the latest dir, then the generation ledger's hardlink-tree
+    copies newest-first, then the ``save_all_epochs`` archive as a last
+    resort; a restore failure — e.g. in-place damage to a committed dir
+    — moves on to the next. Raises
+    :class:`~.checkpoint.CheckpointCorruptionError` when nothing
+    restores."""
+    from .checkpoint import CheckpointCorruptionError, read_meta
+
+    base = os.path.abspath(path)
+    top_meta = read_meta(path)
+    candidates = [(LATEST_DIR,
+                   {k: v for k, v in top_meta.items()
+                    if k != "generations"})]
+    for g in top_meta.get("generations") or []:
+        if g.get("dir"):
+            candidates.append((g["dir"], {k: v for k, v in g.items()
+                                          if k != "dir"}))
+    epochs = []
+    for name in os.listdir(base) if os.path.isdir(base) else []:
+        if name.startswith("orbax_epoch_"):
+            try:
+                epochs.append(int(name.rsplit("_", 1)[1]))
+            except ValueError:
+                continue
+    for e in sorted(epochs, reverse=True):
+        candidates.append((f"orbax_epoch_{e}", {"epoch": e}))
+    errors = []
+    for i, (name, meta) in enumerate(candidates):
+        target = os.path.join(base, name)
+        if not os.path.isdir(target):
+            continue
+        try:
+            state = _restore_target(state_template, target)
+        except Exception as e:
+            # Orbax surfaces damage as a zoo of error types; any of
+            # them just means "try the previous copy".
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+            continue
+        if errors:
+            log.warning(
+                "orbax checkpoint rollback: restored %s after skipping "
+                "%s", name, "; ".join(errors),
+            )
+        _barrier("orbax_checkpoint_load")
+        return state, {
+            "file": name,
+            "digest_verified": None,
+            "rolled_back": i > 0,
+            "errors": errors,
+            "meta": dict(meta),
+        }
+    raise CheckpointCorruptionError(
+        f"no loadable orbax checkpoint under {path}: "
+        + ("; ".join(errors) if errors else "no checkpoint dirs")
     )
 
 

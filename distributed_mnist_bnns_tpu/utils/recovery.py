@@ -1,27 +1,37 @@
-"""Failure detection / recovery.
+"""Failure detection / recovery — compat shim.
+
+.. deprecated::
+    This module grew into :mod:`distributed_mnist_bnns_tpu.resilience`
+    (see RESILIENCE.md): ``resilience.policy.run_with_policy`` adds
+    jittered exponential backoff, transient-vs-fatal exception
+    classification (a missing dataset is not retried into oblivion),
+    preemption-aware resume that doesn't burn the failure budget, and
+    structured ``restart`` obs events. ``run_with_recovery`` below is
+    kept as a thin adapter over it for existing callers; new code
+    should construct a :class:`~..resilience.policy.RetryPolicy`
+    directly.
 
 The reference has no elastic runtime; its only recovery artifact is
 "checkpoint on one machine, manually resume on another" over a raw TCP
-socket pair (mnist change node.py:85-90 -> mnist change master.py:56-59;
-SURVEY §5 deems periodic-checkpoint + restart-from-latest sufficient
-parity). This module automates exactly that: run the training closure,
-checkpoint every epoch (the Trainer already does), and on failure restart
-from the latest checkpoint up to a retry budget.
+socket pair (mnist change node.py:85-90 -> mnist change master.py:56-59).
+This loop automates exactly that: run the training closure, and on
+failure rebuild the trainer (which, with ``TrainConfig.resume=True``,
+restores the latest *verified* checkpoint generation) and retry.
 """
 
 from __future__ import annotations
 
-import logging
-import time
 from typing import Callable, TypeVar
 
-log = logging.getLogger(__name__)
+from ..resilience.policy import (  # re-exported for compat
+    RetryPolicy,
+    TrainingFailure,
+    run_with_policy,
+)
 
 T = TypeVar("T")
 
-
-class TrainingFailure(RuntimeError):
-    """Raised when training keeps failing past the retry budget."""
+__all__ = ["TrainingFailure", "run_with_recovery"]
 
 
 def run_with_recovery(
@@ -31,25 +41,12 @@ def run_with_recovery(
     max_restarts: int = 2,
     backoff_s: float = 1.0,
 ) -> T:
-    """Execute ``run(trainer)``; on exception rebuild the trainer (which,
-    with TrainConfig.resume=True, restores the latest checkpoint) and
-    retry. This is the cold-restart recovery loop the reference performed
-    by hand across its two LAN machines."""
-    attempt = 0
-    while True:
-        trainer = make_trainer()
-        try:
-            return run(trainer)
-        except KeyboardInterrupt:  # pragma: no cover
-            raise
-        except Exception as e:
-            attempt += 1
-            if attempt > max_restarts:
-                raise TrainingFailure(
-                    f"training failed {attempt} times; giving up"
-                ) from e
-            log.warning(
-                "training attempt %d failed (%s: %s); restarting from latest "
-                "checkpoint in %.1fs", attempt, type(e).__name__, e, backoff_s,
-            )
-            time.sleep(backoff_s)
+    """Execute ``run(make_trainer())`` with restart-from-latest retry.
+
+    Adapter over :func:`resilience.policy.run_with_policy`: the old
+    constant ``backoff_s`` becomes the base of a jittered exponential
+    schedule, and fatal classes (KeyboardInterrupt-adjacent exits,
+    missing datasets, config/programming errors) are no longer
+    retried."""
+    policy = RetryPolicy(max_restarts=max_restarts, base_backoff_s=backoff_s)
+    return run_with_policy(make_trainer, run, policy=policy)

@@ -21,11 +21,22 @@ import logging
 import os
 import socket
 import struct
+import time
 from typing import Tuple
 
 log = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">Q")
+
+RETRIES_TOTAL = "transfer_retries_total"
+
+
+def _retry_counter():
+    from ..obs import default_registry  # lazy: keep import-time light
+
+    return default_registry().counter(
+        RETRIES_TOTAL, "checkpoint-shipping connect retries"
+    )
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -38,15 +49,66 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def send_file(path: str, host: str, port: int, *, timeout: float = 30.0) -> int:
-    """Ship one file to a listening receiver; returns bytes sent."""
+def _connect_with_retries(
+    host: str, port: int, *, timeout: float,
+    retries: int, backoff_s: float,
+) -> socket.socket:
+    """create_connection with jittered-exponential connect retries —
+    the receiver races to bind/listen, so a refused or timed-out
+    connect is the expected transient, not an error (the reference's
+    node just crashed here). Fatal address errors (gaierror) are not
+    retried."""
+    from ..resilience.policy import RetryPolicy
+
+    policy = RetryPolicy(
+        max_restarts=retries, base_backoff_s=backoff_s, max_backoff_s=10.0
+    )
+    last: Exception = ConnectionError("no attempt made")
+    for attempt in range(retries + 1):
+        if attempt:
+            delay = policy.backoff(attempt)
+            _retry_counter().inc(op="connect")
+            log.warning(
+                "connect to %s:%d failed (%s: %s); retry %d/%d in %.2fs",
+                host, port, type(last).__name__, last, attempt, retries,
+                delay,
+            )
+            time.sleep(delay)
+        try:
+            return socket.create_connection((host, port), timeout=timeout)
+        except (ConnectionError, TimeoutError, socket.timeout) as e:
+            last = e
+    raise ConnectionError(
+        f"could not connect to {host}:{port} after {retries + 1} "
+        f"attempts (timeout {timeout}s each): "
+        f"{type(last).__name__}: {last}"
+    ) from last
+
+
+def send_file(
+    path: str, host: str, port: int, *,
+    timeout: float = 30.0, retries: int = 3, backoff_s: float = 0.5,
+) -> int:
+    """Ship one file to a listening receiver; returns bytes sent.
+
+    Connect failures retry with jittered backoff; a peer that stalls
+    mid-transfer surfaces as a ``TimeoutError`` naming the peer, the
+    file and the deadline instead of a bare ``socket.timeout``."""
     name = os.path.basename(path).encode()
     with open(path, "rb") as f:
         payload = f.read()
-    with socket.create_connection((host, port), timeout=timeout) as s:
-        s.sendall(_LEN.pack(len(name)) + name + _LEN.pack(len(payload)))
-        s.sendall(payload)
-        ack = _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+    with _connect_with_retries(
+        host, port, timeout=timeout, retries=retries, backoff_s=backoff_s
+    ) as s:
+        try:
+            s.sendall(_LEN.pack(len(name)) + name + _LEN.pack(len(payload)))
+            s.sendall(payload)
+            ack = _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+        except (TimeoutError, socket.timeout) as e:
+            raise TimeoutError(
+                f"{host}:{port} stalled mid-transfer of {path} "
+                f"({len(payload)} bytes, timeout {timeout}s)"
+            ) from e
     if ack != len(payload):
         raise IOError(f"receiver acked {ack} bytes, sent {len(payload)}")
     log.info("shipped %s (%d bytes) to %s:%d", path, len(payload), host, port)
@@ -64,15 +126,26 @@ def receive_file(
         srv.bind((host, port))
         srv.listen(1)
         srv.settimeout(timeout)
-        conn, addr = srv.accept()
+        try:
+            conn, addr = srv.accept()
+        except (TimeoutError, socket.timeout) as e:
+            raise TimeoutError(
+                f"no sender connected to port {port} within {timeout}s"
+            ) from e
         with conn:
             conn.settimeout(timeout)
-            name_len = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
-            if name_len > 4096:
-                raise IOError(f"unreasonable name length {name_len}")
-            name = os.path.basename(_recv_exact(conn, name_len).decode())
-            size = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
-            payload = _recv_exact(conn, size)
+            try:
+                name_len = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
+                if name_len > 4096:
+                    raise IOError(f"unreasonable name length {name_len}")
+                name = os.path.basename(_recv_exact(conn, name_len).decode())
+                size = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
+                payload = _recv_exact(conn, size)
+            except (TimeoutError, socket.timeout) as e:
+                raise TimeoutError(
+                    f"sender {addr} stalled mid-transfer into {out_dir} "
+                    f"(timeout {timeout}s)"
+                ) from e
             out_path = os.path.join(out_dir, name)
             tmp = out_path + ".tmp"
             with open(tmp, "wb") as f:

@@ -1,0 +1,141 @@
+"""Chaos smoke (tier-1 / CI): a scripted fault sequence must finish.
+
+Runs a short ``fit()`` under ``run_with_policy`` with three injected
+faults — a checkpoint corruption, a transient step fault and a SIGTERM-
+style preemption — and asserts training completes via generation
+rollback + retry + step-granular resume, with every resilience event
+kind present in the obs log. Exit 0 = the recovery machinery works end
+to end; anything else fails the build (RESILIENCE.md).
+
+Timeline (4 tiny epochs, 4 steps each):
+  attempt 1  epoch-0 ckpt lands clean (gen 0); epoch-1 ckpt is
+             corrupted in place (chaos); step_fault crashes epoch 2 at
+             step 10
+  attempt 2  resume rolls back past the corrupt generation to gen 0
+             (epoch 0), retrains epochs 1-2, then the preempt fault
+             forces a graceful stop mid-epoch-3 before step 13 runs
+             (mid-epoch checkpoint: epoch_in_progress=3,
+             batch_in_epoch=1)
+  attempt 3  resumes epoch 3 at step granularity and finishes
+
+Usage: python scripts/chaos_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXPECTED_KINDS = (
+    "fault_injected", "rollback", "graceful_stop", "resume", "restart",
+)
+
+# 128 synthetic examples / batch 32 = 4 optimizer steps per epoch.
+EPOCHS = 4
+STEPS_PER_EPOCH = 4
+CHAOS_SPEC = (
+    "ckpt_corrupt@epoch=1"          # epoch-1 save: latest+gen_1 corrupt
+    ";step_fault@step=10"           # epoch 2, transient crash
+    ";preempt@step=13"              # after rollback replay: mid-epoch 3
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None,
+                        help="work dir (default: a fresh temp dir)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the work dir for inspection")
+    args = parser.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="chaos_smoke_")
+    ckpt_dir = os.path.join(work, "ckpts")
+    tel_dir = os.path.join(work, "telemetry")
+
+    from distributed_mnist_bnns_tpu.data import load_mnist
+    from distributed_mnist_bnns_tpu.obs import Telemetry, load_events
+    from distributed_mnist_bnns_tpu.resilience import (
+        RetryPolicy,
+        reset_fire_counts,
+        run_with_policy,
+    )
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    reset_fire_counts()
+    data = load_mnist("/nonexistent", synthetic_sizes=(128, 32))
+
+    def make_trainer() -> Trainer:
+        return Trainer(TrainConfig(
+            model="bnn-mlp-small",
+            epochs=EPOCHS,
+            batch_size=32,
+            backend="xla",
+            seed=7,
+            checkpoint_dir=ckpt_dir,
+            telemetry_dir=tel_dir,
+            resume=True,
+            chaos=CHAOS_SPEC,
+        ))
+
+    # The policy's restart events append to the same events.jsonl the
+    # trainers write (each seals its log before the loop emits).
+    with Telemetry(tel_dir, heartbeat=False) as policy_tel:
+        history = run_with_policy(
+            make_trainer,
+            lambda t: t.fit(data),
+            policy=RetryPolicy(
+                max_restarts=3, base_backoff_s=0.05, max_backoff_s=0.2,
+                seed=0,
+            ),
+            telemetry=policy_tel,
+        )
+
+    total_steps = EPOCHS * STEPS_PER_EPOCH
+    failures = []
+    if not history or history[-1]["epoch"] != EPOCHS - 1:
+        failures.append(
+            f"training did not reach epoch {EPOCHS - 1}: "
+            f"{[h['epoch'] for h in history]}"
+        )
+    events = load_events(os.path.join(tel_dir, "events.jsonl"))
+    kinds = {e["kind"] for e in events}
+    for kind in EXPECTED_KINDS:
+        if kind not in kinds:
+            failures.append(f"event log is missing a {kind!r} event")
+    resumes = [e for e in events if e["kind"] == "resume"]
+    if not any(e.get("batch_in_epoch") for e in resumes):
+        failures.append("no step-granular (mid-epoch) resume recorded")
+    if not any(e.get("rolled_back") for e in resumes):
+        failures.append("no resume went through a generation rollback")
+    meta = json.load(open(os.path.join(ckpt_dir, "checkpoint_meta.json")))
+    if meta.get("epoch") != EPOCHS - 1 or meta.get("step") != total_steps:
+        failures.append(
+            f"final checkpoint meta off: epoch={meta.get('epoch')} "
+            f"step={meta.get('step')} (want {EPOCHS - 1}/{total_steps})"
+        )
+
+    summary = {
+        "epochs_completed": [h["epoch"] for h in history],
+        "final_step": meta.get("step"),
+        "events": {
+            k: sum(1 for e in events if e["kind"] == k)
+            for k in EXPECTED_KINDS
+        },
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=2))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not args.keep and args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
