@@ -89,8 +89,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "'step_fault@step=5;ckpt_corrupt@epoch=1;"
                              "preempt@step=12'. Kinds: step_fault, "
                              "data_io, preempt, slow_host, ckpt_corrupt, "
-                             "ckpt_truncate. Default: the JG_CHAOS env "
-                             "var")
+                             "ckpt_truncate, infer_slow, infer_error. "
+                             "Default: the JG_CHAOS env var")
         sp.add_argument("--checkpoint-keep", type=int, default=3,
                         help="checkpoint generations kept for corruption "
                              "rollback (digest-verified on resume)")
@@ -201,11 +201,72 @@ def build_parser() -> argparse.ArgumentParser:
     common(x)
     x.add_argument("--best", action="store_true")
     x.add_argument("--out", default="model_packed.msgpack")
+    sv = sub.add_parser(
+        "serve",
+        help="long-running resilient HTTP inference server over a "
+             "packed artifact (from `export`): bounded admission queue "
+             "with load shedding, per-request deadlines, dynamic "
+             "micro-batching at the compiled batch shape, circuit "
+             "breaker on backend failures/stalls, hot artifact reload, "
+             "SIGTERM graceful drain (SERVING.md)",
+    )
+    sv.add_argument("--artifact", required=True,
+                    help="path to an export-ed packed .msgpack artifact")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8000,
+                    help="0 = pick an ephemeral port (logged)")
+    sv.add_argument("--batch-size", type=int, default=32,
+                    help="compiled micro-batch shape; queued requests "
+                         "coalesce up to it, the remainder is padded — "
+                         "one compile serves the whole run")
+    sv.add_argument("--queue-depth", type=int, default=64,
+                    help="admission bound: requests past it are shed "
+                         "with an immediate 503 (reject-new over "
+                         "collapse)")
+    sv.add_argument("--deadline-ms", type=float, default=1000.0,
+                    help="default per-request deadline (clients may "
+                         "send their own deadline_ms); queued work "
+                         "past its deadline is cancelled, never "
+                         "computed")
+    sv.add_argument("--linger-ms", type=float, default=2.0,
+                    help="micro-batch coalescing window")
+    sv.add_argument("--stall-timeout-s", type=float, default=1.0,
+                    help="a predictor call slower than this counts as "
+                         "a breaker failure even if it returns")
+    sv.add_argument("--breaker-threshold", type=int, default=3,
+                    help="consecutive backend failures/stalls that "
+                         "trip the circuit breaker open")
+    sv.add_argument("--breaker-reset-s", type=float, default=5.0,
+                    help="open -> half-open reset timeout")
+    sv.add_argument("--breaker-probes", type=int, default=1,
+                    help="half-open probe batches before closing")
+    sv.add_argument("--drain-timeout-s", type=float, default=30.0,
+                    help="SIGTERM flush budget for in-flight requests")
+    sv.add_argument("--input-shape", type=int, nargs="+",
+                    default=[28, 28, 1],
+                    help="per-example input shape for the warmup "
+                         "compile (match the artifact's family)")
+    sv.add_argument("--telemetry-dir", default=None,
+                    help="JSONL request/shed/breaker/drain events here "
+                         "(OBSERVABILITY.md)")
+    sv.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="serving fault injection (RESILIENCE.md): "
+                         "e.g. 'infer_error@step=4,times=3;"
+                         "infer_slow@p=0.1,delay_s=0.5'. Default: the "
+                         "JG_CHAOS env var")
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--interpret", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="run the packed kernels in interpreter mode "
+                         "(default: auto - real Mosaic on TPU, "
+                         "interpreter elsewhere)")
+    sv.add_argument("--log-file", default="log.txt")
     inf = sub.add_parser(
         "infer",
         help="serve a packed 1-bit artifact (from `export`): evaluate "
              "it on the dataset's test split and report accuracy + "
-             "per-batch latency",
+             "per-batch latency (one-shot; see `serve` for the "
+             "long-running server)",
     )
     common(inf)
     inf.add_argument("--artifact", required=True,
@@ -513,6 +574,38 @@ def main(argv=None) -> int:
         )
         log.info("lm final next-token loss: %.4f", history[-1])
         return 0
+
+    if args.cmd == "serve":
+        from .utils import setup_logging
+
+        setup_logging(args.log_file)
+        if repin_failed:
+            log.warning(
+                "could not re-pin jax platform to %r (backend already "
+                "initialized)", repin_failed,
+            )
+        from .serve import PackedInferenceServer, ServeConfig
+
+        server = PackedInferenceServer(ServeConfig(
+            artifact=args.artifact,
+            host=args.host,
+            port=args.port,
+            batch_size=args.batch_size,
+            queue_depth=args.queue_depth,
+            default_deadline_ms=args.deadline_ms,
+            linger_ms=args.linger_ms,
+            stall_timeout_s=args.stall_timeout_s,
+            breaker_threshold=args.breaker_threshold,
+            breaker_reset_s=args.breaker_reset_s,
+            breaker_probes=args.breaker_probes,
+            drain_timeout_s=args.drain_timeout_s,
+            input_shape=tuple(args.input_shape),
+            telemetry_dir=args.telemetry_dir,
+            chaos=args.chaos,
+            seed=args.seed,
+            interpret=args.interpret,
+        ))
+        return server.run()
 
     if args.norm is not None and args.norm not in (
         "half", "none",

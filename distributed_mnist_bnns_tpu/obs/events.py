@@ -24,6 +24,13 @@ Event kinds (schema v1):
   rollback       restore skipped corrupt generation(s) (resilience)
   restart        the retry loop rebuilt the trainer (cause, attempt,
                  backoff — resilience/policy)
+  request        one served prediction request's final status (serve/)
+  shed           admission rejected a request (queue_full |
+                 breaker_open | draining — serve/)
+  breaker_open   the serving circuit breaker tripped open
+  breaker_close  it closed again after successful half-open probes
+  drain          SIGTERM graceful drain completed (flush stats, serve/)
+  reload         hot artifact swap on the running server (serve/)
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's
@@ -39,6 +46,7 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -108,12 +116,17 @@ class EventLog:
     """Append-only JSONL sink for one run.
 
     ``emit`` is a no-op on non-primary hosts (see module docstring), so
-    call sites need no rank guards. Flush policy: ``step`` events are
-    buffered (a flushed syscall per hot-loop dispatch would serialize
-    file I/O against sub-ms device steps) and flushed every
+    call sites need no rank guards. Flush policy: the high-rate kinds —
+    ``step`` (one per hot-loop dispatch) and ``request`` (one per
+    served request, written from the serving engine's single worker
+    thread) — are buffered (a flushed syscall per record would
+    serialize file I/O against the hot path) and flushed every
     ``flush_every`` records; every other kind — manifest, epoch, error,
-    run_end — flushes immediately, so a crashed run loses at most the
-    last few step lines, never the milestone records."""
+    shed, breaker transitions, drain, run_end — flushes immediately, so
+    a crashed run loses at most the last few high-rate lines, never the
+    milestone records."""
+
+    BUFFERED_KINDS = ("step", "request")
 
     def __init__(
         self, path: str, *, primary_only: bool = True,
@@ -125,6 +138,12 @@ class EventLog:
         self._manifest_written = False
         self._flush_every = max(int(flush_every), 1)
         self._unflushed = 0
+        # One log is written from many threads (trainer + heartbeat +
+        # async checkpointer; the serving engine worker + HTTP handler
+        # threads + drain): TextIOWrapper writes are not thread-safe,
+        # and an interleaved partial line silently vanishes in
+        # read_events.
+        self._lock = threading.Lock()
         if self._active:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a")
@@ -138,11 +157,16 @@ class EventLog:
             return
         record = {"v": SCHEMA_VERSION, "kind": kind, "ts": utc_now()}
         record.update({k: _jsonable(v) for k, v in fields.items()})
-        self._fh.write(json.dumps(record) + "\n")
-        self._unflushed += 1
-        if kind != "step" or self._unflushed >= self._flush_every:
-            self._fh.flush()
-            self._unflushed = 0
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            if self._fh is None:  # closed concurrently
+                return
+            self._fh.write(line)
+            self._unflushed += 1
+            if (kind not in self.BUFFERED_KINDS
+                    or self._unflushed >= self._flush_every):
+                self._fh.flush()
+                self._unflushed = 0
 
     def manifest(
         self, config: Optional[Dict[str, Any]] = None,
@@ -193,9 +217,10 @@ class EventLog:
         )
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self) -> "EventLog":
         return self

@@ -10,7 +10,9 @@ common case; this package supplies both halves of surviving them:
   preempt  SIGTERM/SIGINT-driven graceful stop at a step boundary,
            with a distinct resumable exit code
   policy   jittered-exponential retry with transient-vs-fatal
-           classification and a per-run restart budget
+           classification and a per-run restart budget, plus the
+           closed/open/half-open ``CircuitBreaker`` the packed-serving
+           engine (serve/) wraps around its predictor calls
 
 The trainer wires chaos + preempt through ``TrainConfig.chaos`` /
 ``--chaos`` / ``JG_CHAOS`` and ``handle_preemption``; the retry loop is
@@ -23,6 +25,7 @@ RESILIENCE.md for the fault catalog, spec grammar and event schema.
 from .chaos import (
     ChaosController,
     ChaosFault,
+    ChaosInferError,
     ChaosIOError,
     ChaosStepFault,
     FaultRule,
@@ -31,6 +34,7 @@ from .chaos import (
 )
 from .policy import (
     DEFAULT_FATAL_TYPES,
+    CircuitBreaker,
     RetryPolicy,
     TrainingFailure,
     classify_failure,
@@ -41,8 +45,10 @@ from .preempt import PREEMPT_EXIT_CODE, Preempted, StopRequest
 __all__ = [
     "ChaosController",
     "ChaosFault",
+    "ChaosInferError",
     "ChaosIOError",
     "ChaosStepFault",
+    "CircuitBreaker",
     "DEFAULT_FATAL_TYPES",
     "FaultRule",
     "PREEMPT_EXIT_CODE",

@@ -13,6 +13,7 @@ Spec grammar (``TrainConfig.chaos`` / ``--chaos`` / ``JG_CHAOS`` env)::
     arg      := key "=" value
     kind     := step_fault | data_io | preempt | slow_host
               | ckpt_corrupt | ckpt_truncate
+              | infer_slow | infer_error
     key      := step | epoch | p | times | delay_s
 
 ``step``/``epoch`` trigger a rule the first time the run reaches that
@@ -36,6 +37,15 @@ Fault points:
   slow_host      stalls the host ``delay_s`` seconds (straggler sim)
   ckpt_corrupt   flips bytes in the just-written checkpoint artifact
   ckpt_truncate  truncates it to half its length
+  infer_slow     stalls the serving predictor call ``delay_s`` seconds
+                 (backend-stall sim; the serve/ engine counts a call
+                 past its stall budget as a breaker failure)
+  infer_error    raises :class:`ChaosInferError` at the predictor call
+                 (transient backend error)
+
+Serving rules trigger on ``step`` = the serving engine's micro-batch
+sequence number (or ``p``), so one spec composes training and serving
+chaos; ``epoch`` has no serving meaning and never fires there.
 
 Fire counts live in a **process-global ledger** keyed by spec entry, so
 a ``times=1`` fault does not re-fire when the retry loop rebuilds the
@@ -65,7 +75,16 @@ ENV_SPEC = "JG_CHAOS"
 FAULT_KINDS = frozenset({
     "step_fault", "data_io", "preempt", "slow_host",
     "ckpt_corrupt", "ckpt_truncate",
+    "infer_slow", "infer_error",
 })
+
+# Which kinds each fault point dispatches — a rule only evaluates its
+# trigger (and, for p=, draws its RNG) at its own point, so a mixed
+# training+serving spec keeps per-rule probabilistic replay
+# deterministic at every point.
+_STEP_KINDS = frozenset({"step_fault", "data_io", "preempt", "slow_host"})
+_CKPT_KINDS = frozenset({"ckpt_corrupt", "ckpt_truncate"})
+_INFER_KINDS = frozenset({"infer_slow", "infer_error"})
 
 FAULTS_TOTAL = "faults_injected_total"
 
@@ -89,6 +108,10 @@ class ChaosStepFault(ChaosFault):
 
 class ChaosIOError(ChaosFault, OSError):
     """Injected data-batch IO error."""
+
+
+class ChaosInferError(ChaosFault):
+    """Injected transient serving-backend error (predictor call)."""
 
 
 @dataclass
@@ -258,6 +281,10 @@ class ChaosController:
             fired = _FIRE_LEDGER.get(rule.key, 0)
             if rule.times < 0 or fired >= rule.times:
                 continue
+            if rule.kind in _INFER_KINDS:
+                # serving rules count micro-batches, not optimizer
+                # steps — a training resume says nothing about them.
+                continue
             at_save = rule.kind in ("ckpt_corrupt", "ckpt_truncate")
             hit = (
                 rule.step is not None
@@ -284,6 +311,8 @@ class ChaosController:
     ) -> None:
         """Pre-dispatch fault point (raises for data_io/step_fault)."""
         for rule in self.rules:
+            if rule.kind not in _STEP_KINDS:
+                continue
             if not self._should_fire(rule, step, epoch):
                 continue
             if rule.kind == "slow_host":
@@ -308,6 +337,28 @@ class ChaosController:
                 else:
                     os.kill(os.getpid(), signal.SIGTERM)
 
+    def on_infer(self, *, step: Optional[int] = None) -> None:
+        """Serving predictor-call fault point (serve/ engine): stalls
+        the call (``infer_slow``) or raises :class:`ChaosInferError`
+        (``infer_error``). ``step`` is the engine's micro-batch
+        sequence number — the serving analogue of the optimizer step,
+        so the ``@step=`` trigger grammar carries over unchanged."""
+        for rule in self.rules:
+            if rule.kind not in _INFER_KINDS:
+                continue
+            if not self._should_fire(rule, step, None):
+                continue
+            if rule.kind == "infer_slow":
+                self._record(
+                    rule, "infer", step, None, f"stall {rule.delay_s}s"
+                )
+                time.sleep(rule.delay_s)
+            else:
+                self._record(rule, "infer", step, None)
+                raise ChaosInferError(
+                    f"chaos: injected backend error at serve batch {step}"
+                )
+
     def on_checkpoint_written(
         self, path: str, *,
         step: Optional[int] = None, epoch: Optional[int] = None,
@@ -317,7 +368,7 @@ class ChaosController:
         exactly the "this save's bytes were bad" scenario the
         generation rollback exists for."""
         for rule in self.rules:
-            if rule.kind not in ("ckpt_corrupt", "ckpt_truncate"):
+            if rule.kind not in _CKPT_KINDS:
                 continue
             if not self._should_fire(rule, step, epoch):
                 continue

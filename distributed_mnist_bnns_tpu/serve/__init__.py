@@ -1,0 +1,32 @@
+"""serve — resilient long-running packed-inference serving.
+
+The deployment half the one-shot ``cli infer`` evaluator lacks: a
+long-running HTTP server over ``infer.load_packed`` artifacts with the
+Tail-at-Scale failure modes engineered in, not hoped away:
+
+  core.py    requests with deadlines, the bounded admission queue with
+             load shedding, and the micro-batching engine that pads
+             every dispatch to the ONE compiled batch shape
+  server.py  the stdlib HTTP front end (/predict, /healthz, /metrics,
+             /admin/reload), hot artifact swap, and the SIGTERM
+             graceful drain (stop admitting → flush → exit 0)
+  client.py  tiny urllib client used by tests and the CI smoke
+
+The circuit breaker lives in ``resilience.policy.CircuitBreaker`` (so
+training restart loops can reuse it); serving chaos (``infer_slow`` /
+``infer_error``) in ``resilience.chaos``. See SERVING.md "Live
+serving", RESILIENCE.md for the fault kinds, OBSERVABILITY.md for the
+``request`` / ``shed`` / ``breaker_open`` / ``breaker_close`` /
+``drain`` / ``reload`` event schema.
+"""
+
+from .core import AdmissionQueue, Request, ServeEngine
+from .server import PackedInferenceServer, ServeConfig
+
+__all__ = [
+    "AdmissionQueue",
+    "PackedInferenceServer",
+    "Request",
+    "ServeConfig",
+    "ServeEngine",
+]

@@ -1,0 +1,62 @@
+"""Minimal stdlib HTTP client for the packed-inference server.
+
+Shared by tests/test_serve.py and scripts/serve_smoke.py so both speak
+the exact wire protocol the server implements (and the smoke stays
+dependency-free). Every helper returns ``(status_code, body_bytes)`` —
+raw bytes on purpose: the hot-reload acceptance check compares response
+bodies bitwise across an artifact swap.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+
+def _request(
+    url: str, *, data: Optional[bytes] = None, timeout: float = 30.0
+) -> Tuple[int, bytes]:
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        # 4xx/5xx still carry the server's JSON body — that's the shed/
+        # deadline/breaker signal callers assert on, not a client crash.
+        return e.code, e.read()
+
+
+def predict(
+    base_url: str, images: Any, *,
+    deadline_ms: Optional[float] = None, timeout: float = 30.0,
+) -> Tuple[int, bytes]:
+    body: Dict[str, Any] = {"images": images}
+    if deadline_ms is not None:
+        body["deadline_ms"] = deadline_ms
+    return _request(
+        base_url + "/predict", data=json.dumps(body).encode(),
+        timeout=timeout,
+    )
+
+
+def healthz(base_url: str, timeout: float = 10.0) -> Tuple[int, bytes]:
+    return _request(base_url + "/healthz", timeout=timeout)
+
+
+def metrics(base_url: str, timeout: float = 10.0) -> Tuple[int, bytes]:
+    return _request(base_url + "/metrics", timeout=timeout)
+
+
+def reload_artifact(
+    base_url: str, artifact: Optional[str] = None, timeout: float = 60.0
+) -> Tuple[int, bytes]:
+    body = {"artifact": artifact} if artifact else {}
+    return _request(
+        base_url + "/admin/reload", data=json.dumps(body).encode(),
+        timeout=timeout,
+    )

@@ -1,0 +1,407 @@
+"""Serving data plane: requests, admission queue, micro-batching engine.
+
+The Tail-at-Scale mechanics live here, independent of the transport:
+
+  * every request carries a **deadline**; work whose deadline has
+    passed is cancelled when the batcher pops it (never computed), and
+    a waiter that gives up claims the request so the engine drops it —
+    both sides race through ``Request.finish``, exactly one wins;
+  * admission is a **bounded queue**: when it is full the request is
+    rejected immediately (``shed`` event + counter) instead of growing
+    an unbounded backlog that turns a brownout into a collapse;
+  * a **micro-batcher** coalesces queued requests up to the compiled
+    batch shape (padding the remainder), so the jitted predictor only
+    ever sees one batch shape — no recompiles under bursty load;
+  * the predictor call sits behind a :class:`~..resilience.policy.
+    CircuitBreaker`: consecutive exceptions OR stalls past the stall
+    budget trip it open, and while open the admission path fast-fails
+    (``breaker.admits()``) without consuming the half-open probe the
+    worker's ``allow()`` must issue.
+
+Chaos (``infer_slow`` / ``infer_error``) hooks the same predictor call,
+so CI continuously proves shed/breaker/drain behavior rather than only
+the happy path (RESILIENCE.md, crash-only design: the recovery path IS
+the exercised path).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+REQUESTS_TOTAL = "serve_requests_total"
+SHED_TOTAL = "serve_shed_total"
+BATCHES_TOTAL = "serve_batches_total"
+BATCH_SECONDS = "serve_batch_seconds"
+QUEUE_DEPTH = "serve_queue_depth"
+BREAKER_TRANSITIONS_TOTAL = "serve_breaker_transitions_total"
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One admitted prediction request.
+
+    The handler thread waits on ``event``; the engine fills the result.
+    ``finish`` is claim-once: the first caller (engine delivering, or a
+    deadline-expired waiter abandoning) wins, the loser's call returns
+    False and must not touch the payload.
+    """
+
+    __slots__ = (
+        "id", "images", "n", "deadline", "enqueued_at", "event",
+        "status", "log_probs", "error", "_lock", "_done",
+    )
+
+    def __init__(self, images: np.ndarray, deadline: float):
+        self.id = next(_req_ids)
+        self.images = images
+        self.n = int(images.shape[0])
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.event = threading.Event()
+        self.status: Optional[str] = None
+        self.log_probs: Optional[np.ndarray] = None
+        self.error = ""
+        self._lock = threading.Lock()
+        self._done = False
+
+    def finish(
+        self, status: str, *,
+        log_probs: Optional[np.ndarray] = None, error: str = "",
+    ) -> bool:
+        """Resolve the request; returns False if already resolved."""
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+            self.status = status
+            self.log_probs = log_probs
+            self.error = error
+        self.event.set()
+        return True
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+
+class AdmissionQueue:
+    """Bounded FIFO with a blocking batch pop.
+
+    ``try_put`` never blocks — a full queue is the caller's signal to
+    shed. ``pop_batch`` blocks for the first request (bounded by
+    ``timeout``), then lingers briefly to coalesce more, popping
+    requests while their examples fit ``max_examples``.
+    """
+
+    def __init__(self, maxsize: int):
+        self.maxsize = int(maxsize)
+        self._items: deque[Request] = deque()
+        self._cond = threading.Condition()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def try_put(self, req: Request) -> bool:
+        with self._cond:
+            if len(self._items) >= self.maxsize:
+                return False
+            self._items.append(req)
+            self._cond.notify()
+            return True
+
+    def wake(self) -> None:
+        """Unblock a pending ``pop_batch`` (drain/stop path)."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def pop_batch(
+        self, max_examples: int, *,
+        linger_s: float = 0.0, timeout: float = 0.1,
+        claim: Optional[Callable[[], None]] = None,
+    ) -> List[Request]:
+        """Up to ``max_examples`` worth of requests; ``[]`` on timeout.
+
+        A request whose batch alone exceeds ``max_examples`` never
+        fits — admission rejects those up front (server layer), so the
+        head of the queue always makes progress here.
+
+        ``claim`` runs under the queue lock before a non-empty batch is
+        returned: the engine marks itself busy there, so a drain
+        watcher can never observe "queue empty AND worker idle" while a
+        popped batch is still unprocessed.
+        """
+        with self._cond:
+            if not self._items:
+                self._cond.wait(timeout)
+                if not self._items:
+                    return []
+            if linger_s > 0:
+                deadline = time.monotonic() + linger_s
+                while True:
+                    have = sum(r.n for r in self._items)
+                    remaining = deadline - time.monotonic()
+                    if have >= max_examples or remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            out: List[Request] = []
+            total = 0
+            while self._items and total + self._items[0].n <= max_examples:
+                req = self._items.popleft()
+                out.append(req)
+                total += req.n
+            if out and claim is not None:
+                claim()
+            return out
+
+
+class ServeEngine:
+    """Single-worker micro-batching inference engine.
+
+    ``predict_fn`` is the jitted predictor from ``infer.load_packed``;
+    it is only ever called from the worker thread, always at the
+    compiled ``batch_size`` (padded), so one compile serves the whole
+    run — and ``swap_predictor`` (hot reload) is a plain attribute
+    write observed at the next batch.
+    """
+
+    def __init__(
+        self,
+        predict_fn: Callable,
+        *,
+        batch_size: int,
+        queue: AdmissionQueue,
+        breaker: Any,
+        chaos: Any = None,
+        telemetry: Any = None,
+        stall_timeout_s: float = 1.0,
+        linger_s: float = 0.002,
+    ):
+        self.predict_fn = predict_fn
+        self.batch_size = int(batch_size)
+        self.queue = queue
+        self.breaker = breaker
+        self.chaos = chaos
+        self.telemetry = telemetry
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.linger_s = float(linger_s)
+        self.batch_seq = 0
+        self.draining = False
+        self._stop = threading.Event()
+        self._busy = False
+        self._thread: Optional[threading.Thread] = None
+        reg = telemetry.registry if telemetry is not None else None
+        if reg is None:
+            from ..obs import default_registry
+
+            reg = default_registry()
+        self.requests_ctr = reg.counter(
+            REQUESTS_TOTAL, "serving requests by final status"
+        )
+        self.shed_ctr = reg.counter(
+            SHED_TOTAL, "admission rejections by reason"
+        )
+        self.batches_ctr = reg.counter(
+            BATCHES_TOTAL, "predictor micro-batches dispatched"
+        )
+        self.batch_hist = reg.histogram(
+            BATCH_SECONDS, "predictor call latency per micro-batch"
+        )
+        self.depth_gauge = reg.gauge(
+            QUEUE_DEPTH, "admission queue depth at batch pop"
+        )
+
+    # -- admission (handler threads) ----------------------------------------
+
+    def submit(self, images: np.ndarray, deadline: float):
+        """Admit or shed. Returns a :class:`Request`, or a shed-reason
+        string (``draining`` | ``breaker_open`` | ``queue_full``)."""
+        if self.draining or self._stop.is_set():
+            return self._shed("draining")
+        if not self.breaker.admits():
+            return self._shed("breaker_open")
+        req = Request(images, deadline)
+        if not self.queue.try_put(req):
+            return self._shed("queue_full")
+        return req
+
+    def _shed(self, reason: str) -> str:
+        self.shed_ctr.inc(reason=reason)
+        self.requests_ctr.inc(status="shed")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "shed", reason=reason, queue_depth=len(self.queue)
+            )
+        return reason
+
+    # -- worker -------------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        self._thread = threading.Thread(
+            target=self._run, name="serve-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _claim_busy(self) -> None:
+        self._busy = True
+
+    def _run(self) -> None:
+        while True:
+            reqs = self.queue.pop_batch(
+                self.batch_size, linger_s=self.linger_s, timeout=0.1,
+                claim=self._claim_busy,
+            )
+            if not reqs:
+                if self._stop.is_set() and not len(self.queue):
+                    return
+                continue
+            try:
+                self._process(reqs)
+            except Exception:
+                # The worker must outlive ANY per-batch failure (e.g. a
+                # full disk erroring the telemetry write): a dead worker
+                # is a silent total outage behind a green healthz. The
+                # batch's unresolved requests 504 at their deadlines.
+                log.exception(
+                    "serve-engine batch %d processing failed; worker "
+                    "continues", self.batch_seq,
+                )
+            finally:
+                self._busy = False
+
+    def _process(self, reqs: List[Request]) -> None:
+        self.batch_seq += 1
+        self.depth_gauge.set(len(self.queue))
+        now = time.monotonic()
+        # queue wait ends at the pop — measured here so the reported
+        # queue_ms/infer_ms split cleanly separates queueing pressure
+        # from backend slowness.
+        waits = {r.id: now - r.enqueued_at for r in reqs}
+        live = []
+        for r in reqs:
+            if r.expired(now):
+                self._finish(r, "deadline",
+                             error="deadline exceeded in queue",
+                             queue_s=waits[r.id])
+            else:
+                live.append(r)
+        if not live:
+            return
+        if not self.breaker.allow():
+            # open breaker: fast-fail everything the admission race let in
+            for r in live:
+                self._finish(
+                    r, "breaker_open", error="circuit breaker open",
+                    queue_s=waits[r.id],
+                )
+            return
+        t0 = time.perf_counter()
+        try:
+            # Assembly stays inside the try: admission validates shapes
+            # against the served input shape, but a defect there must
+            # fail THIS batch, never kill the worker thread (a dead
+            # worker is a silent total outage behind a green healthz).
+            x = np.concatenate([r.images for r in live], axis=0)
+            pad = self.batch_size - x.shape[0]
+            if pad:
+                x = np.concatenate(
+                    [x, np.zeros((pad, *x.shape[1:]), x.dtype)], axis=0
+                )
+            if self.chaos is not None and self.chaos.active:
+                self.chaos.on_infer(step=self.batch_seq)
+            out = np.asarray(self.predict_fn(x))
+        except Exception as e:  # any backend error must trip, not crash
+            dt = time.perf_counter() - t0
+            self.breaker.record_failure(f"{type(e).__name__}: {e}")
+            log.warning(
+                "serve batch %d failed after %.3fs (%s: %s)",
+                self.batch_seq, dt, type(e).__name__, e,
+            )
+            for r in live:
+                self._finish(
+                    r, "error",
+                    error=f"backend failure: {type(e).__name__}: {e}",
+                    infer_s=dt, queue_s=waits[r.id],
+                )
+            return
+        dt = time.perf_counter() - t0
+        self.batches_ctr.inc()
+        self.batch_hist.observe(dt)
+        if dt > self.stall_timeout_s:
+            # The Tail-at-Scale stall case: the call *returned*, but so
+            # late that the backend must be presumed unhealthy.
+            self.breaker.record_failure(
+                f"stall: batch took {dt:.3f}s > {self.stall_timeout_s}s"
+            )
+        else:
+            self.breaker.record_success()
+        offset = 0
+        for r in live:
+            rows = out[offset:offset + r.n]
+            offset += r.n
+            self._finish(r, "ok", log_probs=rows, infer_s=dt,
+                         queue_s=waits[r.id])
+
+    def _finish(self, req: Request, status: str, *,
+                log_probs: Optional[np.ndarray] = None, error: str = "",
+                infer_s: Optional[float] = None,
+                queue_s: Optional[float] = None) -> None:
+        """Resolve ``req`` and emit its single ``request`` event. A
+        failed claim means the waiter already abandoned it at its
+        deadline — record that truth, not the late result."""
+        if not req.finish(status, log_probs=log_probs, error=error):
+            status = "deadline"
+        self.requests_ctr.inc(status=status)
+        if self.telemetry is not None:
+            if queue_s is None:
+                queue_s = time.monotonic() - req.enqueued_at
+            fields: Dict[str, Any] = {
+                "id": req.id,
+                "status": status,
+                "n": req.n,
+                "batch_seq": self.batch_seq,
+                "queue_ms": round(queue_s * 1e3, 3),
+            }
+            if infer_s is not None:
+                fields["infer_ms"] = round(infer_s * 1e3, 3)
+            if error:
+                fields["error"] = error[:500]
+            self.telemetry.emit("request", **fields)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def swap_predictor(self, predict_fn: Callable) -> None:
+        """Atomic hot swap; callers warm the new fn first so serving
+        never stalls on a fresh compile."""
+        self.predict_fn = predict_fn
+
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Flush: wait for the queue to empty and the in-flight batch
+        to resolve. Returns False on timeout (callers still stop)."""
+        self.begin_drain()
+        deadline = time.monotonic() + timeout
+        while len(self.queue) or self._busy:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.wake()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -1,0 +1,447 @@
+"""Long-running packed-inference HTTP server (stdlib-only transport).
+
+`cli infer` walks the test split once and exits; this is the missing
+long-running half of the serving story: a ``ThreadingHTTPServer`` front
+end over the :class:`~.core.ServeEngine` micro-batcher, serving the
+packed artifacts of ``infer.load_packed`` with the production failure
+modes handled and observable (SERVING.md "Live serving"):
+
+  POST /predict        {"images": [...], "deadline_ms": optional}
+                       -> {"argmax": [...], "log_probs": [[...]]}
+                       200 ok | 503 shed (queue_full/breaker_open/
+                       draining) | 504 deadline | 502 backend error |
+                       400 bad input | 413 batch too large
+  GET  /healthz        status (ok|draining), breaker state, queue depth
+  GET  /metrics        obs registry snapshot (JSON)
+  POST /admin/reload   {"artifact": path} — hot swap: the new artifact
+                       is loaded AND warmed off-path, then atomically
+                       swapped in; unchanged weights give bitwise-
+                       identical responses across the swap
+
+Lifecycle: SIGTERM/SIGINT install the same :class:`~..resilience.
+preempt.StopRequest` pattern as training — stop admitting (new work is
+shed with reason ``draining``), flush everything in flight, emit a
+``drain`` event, exit 0. Crash-only discipline: the drain path is the
+same code the chaos smoke exercises in CI (scripts/serve_smoke.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.policy import CircuitBreaker
+from ..resilience.preempt import StopRequest
+from .core import (
+    BREAKER_TRANSITIONS_TOTAL,
+    AdmissionQueue,
+    Request,
+    ServeEngine,
+)
+
+log = logging.getLogger(__name__)
+
+# Extra slack a waiter grants the engine past the request deadline
+# before abandoning (claiming) it: covers the scheduler hop between the
+# engine resolving at the boundary and the waiter waking.
+_WAIT_SLACK_S = 0.05
+
+_SHED_HTTP = {
+    "queue_full": 503, "breaker_open": 503, "draining": 503,
+}
+
+
+@dataclass
+class ServeConfig:
+    """Server shape + robustness budgets (CLI flags mirror these)."""
+
+    artifact: str
+    host: str = "127.0.0.1"
+    port: int = 8000                 # 0 = ephemeral (tests)
+    batch_size: int = 32             # the ONE compiled batch shape
+    queue_depth: int = 64            # admission bound (reject past it)
+    default_deadline_ms: float = 1000.0
+    linger_ms: float = 2.0           # micro-batch coalescing window
+    stall_timeout_s: float = 1.0     # backend call past this = failure
+    breaker_threshold: int = 3       # consecutive failures to trip
+    breaker_reset_s: float = 5.0     # open -> half-open timeout
+    breaker_probes: int = 1          # half-open probe batches
+    drain_timeout_s: float = 30.0    # flush budget on SIGTERM
+    input_shape: Tuple[int, ...] = (28, 28, 1)   # warmup example shape
+    telemetry_dir: Optional[str] = None
+    chaos: Optional[str] = None      # RESILIENCE.md spec (or JG_CHAOS)
+    seed: int = 0
+    interpret: Optional[bool] = None  # None: Mosaic on TPU, else interp
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class PackedInferenceServer:
+    """Owns the engine, the HTTP front end and the drain lifecycle."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        from ..obs import Telemetry
+
+        self.telemetry = Telemetry(config.telemetry_dir, heartbeat=False)
+        from ..resilience.chaos import ChaosController
+
+        self.chaos = ChaosController.from_config(
+            config.chaos, seed=config.seed, telemetry=self.telemetry
+        )
+        self.breaker = CircuitBreaker(
+            failure_threshold=config.breaker_threshold,
+            reset_timeout_s=config.breaker_reset_s,
+            half_open_probes=config.breaker_probes,
+            on_transition=self._on_breaker_transition,
+        )
+        self.queue = AdmissionQueue(config.queue_depth)
+        self.stop_request = StopRequest()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._reload_lock = threading.Lock()
+        self._started_at = time.time()
+        self.engine: Optional[ServeEngine] = None
+        self.artifact_info: Dict[str, Any] = {}
+        # Request-body cap: a full micro-batch of JSON floats (~32
+        # chars/value incl. separators) plus headroom, floored at 1 MiB.
+        # Enforced BEFORE the body is read — overload protection must
+        # not be bypassable by size (reject-new over collapse).
+        n_vals = 1
+        for d in config.input_shape:
+            n_vals *= int(d)
+        self.max_body_bytes = max(
+            1 << 20, config.batch_size * n_vals * 32 + (1 << 16)
+        )
+
+    # -- predictor loading ---------------------------------------------------
+
+    def _interpret(self) -> bool:
+        if self.config.interpret is not None:
+            return self.config.interpret
+        import jax
+
+        return jax.default_backend() != "tpu"
+
+    def _load_and_warm(self, path: str):
+        """load_packed + one padded-shape call, OFF the serving path:
+        the compile happens before the swap (or before the first
+        request), so traffic never waits on XLA."""
+        from ..infer import load_packed
+
+        fn, info = load_packed(path, interpret=self._interpret())
+        warm = np.zeros(
+            (self.config.batch_size, *self.config.input_shape), np.float32
+        )
+        np.asarray(fn(warm))
+        return fn, info
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> Tuple[str, int]:
+        """Load + warm the artifact, start the engine and the HTTP
+        front end. Returns the bound (host, port)."""
+        cfg = self.config
+        fn, info = self._load_and_warm(cfg.artifact)
+        self.artifact_info = dict(info)
+        self.engine = ServeEngine(
+            fn,
+            batch_size=cfg.batch_size,
+            queue=self.queue,
+            breaker=self.breaker,
+            chaos=self.chaos if self.chaos.active else None,
+            telemetry=self.telemetry,
+            stall_timeout_s=cfg.stall_timeout_s,
+            linger_s=cfg.linger_ms / 1e3,
+        ).start()
+        server = self
+
+        class Handler(_Handler):
+            srv = server
+
+        self._httpd = ThreadingHTTPServer((cfg.host, cfg.port), Handler)
+        self._httpd.daemon_threads = True
+        host, port = self._httpd.server_address[:2]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http",
+            daemon=True,
+        )
+        self._http_thread.start()
+        self.telemetry.manifest(
+            config={
+                "artifact": cfg.artifact,
+                "batch_size": cfg.batch_size,
+                "queue_depth": cfg.queue_depth,
+                "default_deadline_ms": cfg.default_deadline_ms,
+                "stall_timeout_s": cfg.stall_timeout_s,
+                "breaker_threshold": cfg.breaker_threshold,
+                "breaker_reset_s": cfg.breaker_reset_s,
+                "chaos": self.chaos.spec or None,
+                **cfg.extra,
+            },
+            artifact_info=self.artifact_info,
+        )
+        log.info(
+            "serving %s (%s) on %s:%d — batch %d, queue %d, deadline "
+            "%.0fms", cfg.artifact, info.get("family"), host, port,
+            cfg.batch_size, cfg.queue_depth, cfg.default_deadline_ms,
+        )
+        return host, port
+
+    def _on_breaker_transition(
+        self, old: str, new: str, reason: str
+    ) -> None:
+        self.telemetry.registry.counter(
+            BREAKER_TRANSITIONS_TOTAL,
+            "circuit-breaker state transitions",
+        ).inc(to=new)
+        if new == "open":
+            self.telemetry.emit(
+                "breaker_open", from_state=old, reason=reason
+            )
+        elif new == "closed":
+            self.telemetry.emit(
+                "breaker_close", from_state=old, reason=reason
+            )
+        # half_open is an internal hop; the close/open events bracket it
+
+    def reload_artifact(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """Hot swap to ``path`` (default: the configured artifact —
+        re-read from disk, the "a new msgpack landed under the same
+        name" deployment). Load + warm happen outside the swap, so the
+        worker observes either the old or the new predictor, never a
+        half-built one."""
+        path = path or self.config.artifact
+        with self._reload_lock:  # serialize concurrent admin calls
+            fn, info = self._load_and_warm(path)
+            assert self.engine is not None
+            self.engine.swap_predictor(fn)
+            self.artifact_info = dict(info)
+        # info nests under its own field: transformer artifacts carry a
+        # "kind" key that would collide with the event envelope's kind.
+        self.telemetry.emit("reload", artifact=path, info=dict(info))
+        log.info("hot-reloaded artifact %s (%s)", path, info.get("family"))
+        return dict(info)
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "status": "draining" if (
+                self.engine is not None and self.engine.draining
+            ) else "ok",
+            "breaker": self.breaker.state,
+            "queue_depth": len(self.queue),
+            "batch_size": self.config.batch_size,
+            "family": self.artifact_info.get("family"),
+            "uptime_s": round(time.time() - self._started_at, 3),
+        }
+
+    def request_stop(self, reason: str = "stop requested") -> None:
+        self.stop_request.request(reason)
+
+    def drain_and_stop(self) -> Dict[str, Any]:
+        """Stop admitting, flush in-flight work, shut the front end
+        down, seal telemetry. Idempotent-ish: safe to call once after
+        the run loop exits."""
+        assert self.engine is not None
+        t0 = time.monotonic()
+        inflight = len(self.queue)
+        self.engine.begin_drain()
+        flushed = self.engine.drain(timeout=self.config.drain_timeout_s)
+        self.engine.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        stats = {
+            "reason": self.stop_request.reason or "stop requested",
+            "inflight_at_drain": inflight,
+            "flushed": flushed,
+            "requests_total": int(self.engine.requests_ctr.total()),
+            "shed_total": int(self.engine.shed_ctr.total()),
+            "batches_total": int(self.engine.batches_ctr.total()),
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        self.telemetry.emit("drain", **stats)
+        self.telemetry.close()
+        log.info("drained and stopped: %s", stats)
+        return stats
+
+    def run(self) -> int:
+        """CLI entry: serve until SIGTERM/SIGINT, graceful-drain, exit
+        0. The handler pattern is resilience/preempt.py's — the signal
+        only sets a flag; this loop polls it and runs the drain in
+        normal (non-handler) context. Handlers install BEFORE
+        ``start()``: a supervisor's SIGTERM during the warmup compile
+        must also land as a graceful (if trivially empty) drain, not
+        the default kill."""
+        with self.stop_request.install():
+            self.start()
+            while not self.stop_request.requested:
+                time.sleep(0.05)
+        self.drain_and_stop()
+        return 0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Per-connection handler; ``srv`` is bound by the enclosing
+    server's subclass. Threaded: N handlers block in ``Request.event``
+    waits while the single engine worker batches behind them."""
+
+    srv: PackedInferenceServer
+    protocol_version = "HTTP/1.1"
+    # Connection-socket timeout (BaseHTTPRequestHandler applies it in
+    # setup()): a client that declares a Content-Length and never sends
+    # the body must not pin a handler thread forever — resource bounds
+    # have to hold BEFORE admission, not only behind it.
+    timeout = 30.0
+
+    # route BaseHTTPRequestHandler's stderr chatter into logging
+    def log_message(self, fmt: str, *args: Any) -> None:
+        log.debug("http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Optional[Dict[str, Any]]:
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            if n > self.srv.max_body_bytes:
+                # replying without reading the body desyncs a keep-
+                # alive connection — close it instead of draining GBs
+                self.close_connection = True
+                self._reply(413, {
+                    "error": f"body of {n} bytes exceeds the "
+                             f"{self.srv.max_body_bytes}-byte limit "
+                             "(one micro-batch of examples)",
+                })
+                return None
+            return json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request body: {e}"})
+            return None
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        if self.path == "/healthz":
+            self._reply(200, self.srv.health())
+        elif self.path == "/metrics":
+            self._reply(200, self.srv.telemetry.registry.snapshot())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        if self.path == "/predict":
+            self._predict()
+        elif self.path == "/admin/reload":
+            self._reload()
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def _reload(self) -> None:
+        body = self._read_json()
+        if body is None:
+            return
+        try:
+            info = self.srv.reload_artifact(body.get("artifact"))
+        except (OSError, ValueError, KeyError) as e:
+            self._reply(
+                400, {"error": f"reload failed: {type(e).__name__}: {e}"}
+            )
+            return
+        self._reply(200, {"reloaded": True, "info": info})
+
+    def _predict(self) -> None:
+        body = self._read_json()
+        if body is None:
+            return
+        engine = self.srv.engine
+        assert engine is not None
+        try:
+            images = np.asarray(body["images"], np.float32)
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply(400, {"error": f"bad images payload: {e}"})
+            return
+        expected = tuple(self.srv.config.input_shape)
+        if images.ndim == len(expected):
+            images = images[None]  # single unbatched example
+        if images.shape[1:] != expected:
+            # One compiled batch shape is the whole micro-batcher
+            # contract: a differently-shaped example must be an
+            # explicit 400, not a cross-request concatenate error or a
+            # fresh jit signature.
+            self._reply(400, {
+                "error": f"per-example shape {list(images.shape[1:])} "
+                         f"does not match the served input shape "
+                         f"{list(expected)}",
+            })
+            return
+        if images.shape[0] > engine.batch_size:
+            self._reply(413, {
+                "error": f"request batch {images.shape[0]} exceeds the "
+                         f"compiled micro-batch size {engine.batch_size}",
+            })
+            return
+        try:
+            deadline_ms = float(
+                body.get("deadline_ms",
+                         self.srv.config.default_deadline_ms)
+            )
+        except (TypeError, ValueError):
+            deadline_ms = float("nan")
+        if not (math.isfinite(deadline_ms) and deadline_ms > 0):
+            self._reply(400, {
+                "error": f"deadline_ms must be a positive finite "
+                         f"number, got {body.get('deadline_ms')!r}",
+            })
+            return
+        deadline = time.monotonic() + deadline_ms / 1e3
+        req = engine.submit(images, deadline)
+        if isinstance(req, str):  # shed reason
+            self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req})
+            return
+        self._wait_and_reply(req, deadline)
+
+    def _wait_and_reply(self, req: Request, deadline: float) -> None:
+        """Block until the engine resolves ``req`` or its deadline
+        passes — the response ALWAYS arrives within deadline + slack,
+        even if the backend is mid-stall (the abandoned request is
+        claimed, so the engine discards its late result)."""
+        remaining = deadline - time.monotonic() + _WAIT_SLACK_S
+        if not req.event.wait(max(remaining, 0.0)):
+            if req.finish("deadline", error="deadline exceeded"):
+                self._reply(504, {
+                    "error": "deadline exceeded", "id": req.id,
+                })
+                return
+            # engine won the race after our timeout check: fall through
+        status = req.status
+        if status == "ok":
+            lp = req.log_probs
+            assert lp is not None
+            # No request id in the OK body: responses are a pure
+            # function of (weights, images), which is what makes the
+            # hot-reload bitwise-identity contract assertable.
+            self._reply(200, {
+                "argmax": [int(i) for i in lp.argmax(-1)],
+                "log_probs": [[float(v) for v in row] for row in lp],
+            })
+        elif status == "deadline":
+            self._reply(504, {"error": req.error or "deadline exceeded",
+                              "id": req.id})
+        elif status == "breaker_open":
+            self._reply(503, {"error": "shed", "reason": "breaker_open",
+                              "id": req.id})
+        else:
+            self._reply(502, {"error": req.error or "backend failure",
+                              "id": req.id})

@@ -10,13 +10,19 @@ reference's socket pair addressed, with a correct length-prefixed protocol
 instead of the reference's filename/size handshake.
 
 Protocol (all big-endian):
-    8-byte name length | name utf-8 | 8-byte payload length | payload bytes
-Receiver replies with the 8-byte payload length as an ack (the analogue of
-the reference's size reply).
+    8-byte name length | name utf-8 | 8-byte payload length
+    | 32-byte sha256(payload) | payload bytes
+The receiver verifies the digest BEFORE the atomic tmp→rename (a
+truncated-but-length-matching or bit-flipped ship is rejected, never
+silently accepted as a checkpoint), then replies with the 8-byte payload
+length + its own 32-byte digest of the written bytes as the ack; the
+sender verifies both. Same sha256 the checkpoint integrity layer records
+in checkpoint_meta.json (utils/checkpoint.file_digest).
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import socket
@@ -27,6 +33,7 @@ from typing import Tuple
 log = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">Q")
+_DIGEST_BYTES = hashlib.sha256().digest_size  # 32
 
 RETRIES_TOTAL = "transfer_retries_total"
 
@@ -93,17 +100,28 @@ def send_file(
 
     Connect failures retry with jittered backoff; a peer that stalls
     mid-transfer surfaces as a ``TimeoutError`` naming the peer, the
-    file and the deadline instead of a bare ``socket.timeout``."""
+    file and the deadline instead of a bare ``socket.timeout``. The ack
+    must echo both the payload length and its sha256 — a receiver that
+    stored different bytes fails the ship loudly on this side too."""
     name = os.path.basename(path).encode()
     with open(path, "rb") as f:
         payload = f.read()
+    # Hash the bytes actually being shipped (one read, no TOCTOU with a
+    # concurrent rewrite) — the same sha256 utils/checkpoint.file_digest
+    # records in checkpoint_meta.json, so a receiver-side resume can
+    # cross-check the shipped artifact against its meta.
+    digest = hashlib.sha256(payload).digest()
     with _connect_with_retries(
         host, port, timeout=timeout, retries=retries, backoff_s=backoff_s
     ) as s:
         try:
-            s.sendall(_LEN.pack(len(name)) + name + _LEN.pack(len(payload)))
+            s.sendall(
+                _LEN.pack(len(name)) + name + _LEN.pack(len(payload))
+                + digest
+            )
             s.sendall(payload)
             ack = _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+            ack_digest = _recv_exact(s, _DIGEST_BYTES)
         except (TimeoutError, socket.timeout) as e:
             raise TimeoutError(
                 f"{host}:{port} stalled mid-transfer of {path} "
@@ -111,6 +129,11 @@ def send_file(
             ) from e
     if ack != len(payload):
         raise IOError(f"receiver acked {ack} bytes, sent {len(payload)}")
+    if ack_digest != digest:
+        raise IOError(
+            f"receiver acked sha256 {ack_digest.hex()[:16]}…, sent "
+            f"{digest.hex()[:16]}… — stored bytes differ from {path}"
+        )
     log.info("shipped %s (%d bytes) to %s:%d", path, len(payload), host, port)
     return len(payload)
 
@@ -140,18 +163,29 @@ def receive_file(
                     raise IOError(f"unreasonable name length {name_len}")
                 name = os.path.basename(_recv_exact(conn, name_len).decode())
                 size = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
+                expected = _recv_exact(conn, _DIGEST_BYTES)
                 payload = _recv_exact(conn, size)
             except (TimeoutError, socket.timeout) as e:
                 raise TimeoutError(
                     f"sender {addr} stalled mid-transfer into {out_dir} "
                     f"(timeout {timeout}s)"
                 ) from e
+            # Verify BEFORE the atomic rename: a corrupt ship must never
+            # become the latest-checkpoint file a resume would trust.
+            got = hashlib.sha256(payload).digest()
+            if got != expected:
+                raise IOError(
+                    f"sha256 mismatch receiving {name} from {addr}: got "
+                    f"{got.hex()[:16]}…, sender declared "
+                    f"{expected.hex()[:16]}… ({size} bytes) — rejecting "
+                    "before rename"
+                )
             out_path = os.path.join(out_dir, name)
             tmp = out_path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(payload)
             os.replace(tmp, out_path)
-            conn.sendall(_LEN.pack(size))  # size ack
+            conn.sendall(_LEN.pack(size) + got)  # length + digest ack
     log.info("received %s (%d bytes) from %s", out_path, size, addr)
     return out_path, size
 

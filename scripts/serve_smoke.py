@@ -1,0 +1,228 @@
+"""Serve smoke (tier-1 / CI): the resilient server must survive chaos.
+
+The serving mirror of scripts/chaos_smoke.py: exports a tiny bnn-mlp
+artifact, starts `cli serve` as a real subprocess with a chaos spec
+injecting backend errors and stalls, hammers it with concurrent
+requests at saturation, hot-reloads the artifact mid-traffic (responses
+must be bitwise identical for unchanged weights), then sends SIGTERM
+and requires a graceful drain with **exit 0**. Asserts from the obs
+event log that the server shed explicitly (never queue collapse), the
+circuit breaker opened AND closed again, and the drain flushed
+(SERVING.md "Live serving", RESILIENCE.md).
+
+Usage: python scripts/serve_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHAOS_SPEC = (
+    "infer_error@step=4,times=3"            # batches 4-6: breaker trips
+    ";infer_slow@step=10,times=2,delay_s=0.3"  # stalls: queue backs up
+)
+EXPECTED_KINDS = (
+    "request", "shed", "breaker_open", "breaker_close", "drain",
+    "fault_injected",
+)
+HAMMER_THREADS = 10
+HAMMER_SECONDS = 4.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None,
+                        help="work dir (default: a fresh temp dir)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the work dir for inspection")
+    args = parser.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="serve_smoke_")
+    tel_dir = os.path.join(work, "telemetry")
+    artifact = os.path.join(work, "model_packed.msgpack")
+
+    import jax
+
+    from distributed_mnist_bnns_tpu.infer import export_packed
+    from distributed_mnist_bnns_tpu.models import bnn_mlp_small
+    from distributed_mnist_bnns_tpu.obs import load_events
+    from distributed_mnist_bnns_tpu.serve import client as sc
+
+    model = bnn_mlp_small(backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        x, train=True,
+    )
+    export_packed(model, variables, artifact)
+
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+            "serve",
+            "--artifact", artifact,
+            "--port", str(port),
+            "--batch-size", "8",
+            "--queue-depth", "4",
+            "--deadline-ms", "400",
+            "--stall-timeout-s", "0.15",
+            "--breaker-threshold", "3",
+            "--breaker-reset-s", "0.4",
+            "--telemetry-dir", tel_dir,
+            "--chaos", CHAOS_SPEC,
+            "--interpret",
+            "--log-file", os.path.join(work, "serve.log"),
+        ],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    )
+
+    failures = []
+    try:
+        # jax import + warmup compile make startup slow on CI runners
+        for _ in range(240):
+            try:
+                if sc.healthz(base, timeout=2)[0] == 200:
+                    break
+            except OSError:
+                pass
+            if proc.poll() is not None:
+                print(f"FAIL: server died at startup (rc {proc.returncode})",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.5)
+        else:
+            print("FAIL: server never became healthy", file=sys.stderr)
+            return 1
+
+        rng_imgs = [[[[0.1 * ((i + j) % 7)] for j in range(28)]
+                     for i in range(28)]]
+
+        codes = []
+        lock = threading.Lock()
+        stop_at = time.monotonic() + HAMMER_SECONDS
+
+        def hammer(tid: int) -> None:
+            while time.monotonic() < stop_at:
+                try:
+                    code, _ = sc.predict(
+                        base, rng_imgs * 2, deadline_ms=250, timeout=10
+                    )
+                except OSError as e:
+                    code = -1
+                    print(f"hammer[{tid}]: transport error {e}",
+                          file=sys.stderr)
+                with lock:
+                    codes.append(code)
+                time.sleep(0.01)
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,))
+            for i in range(HAMMER_THREADS)
+        ]
+        for t in threads:
+            t.start()
+
+        # mid-traffic hot reload + bitwise identity probe
+        time.sleep(HAMMER_SECONDS / 2)
+        probe_before = sc.predict(base, rng_imgs, deadline_ms=5000,
+                                  timeout=10)
+        reload_code, _ = sc.reload_artifact(base, timeout=60)
+        probe_after = sc.predict(base, rng_imgs, deadline_ms=5000,
+                                 timeout=10)
+        for t in threads:
+            t.join(timeout=60)
+        if any(t.is_alive() for t in threads):
+            failures.append("hammer thread hung (deadline-less wait)")
+        if reload_code != 200:
+            failures.append(f"hot reload returned {reload_code}")
+        if probe_before[0] == probe_after[0] == 200:
+            if probe_before[1] != probe_after[1]:
+                failures.append(
+                    "responses not bitwise identical across hot reload"
+                )
+        else:
+            failures.append(
+                f"reload probes failed: {probe_before[0]}/{probe_after[0]}"
+            )
+
+        by_code = {c: codes.count(c) for c in sorted(set(codes))}
+        if -1 in by_code:
+            failures.append(
+                f"{by_code[-1]} transport-level failures (shedding must "
+                "be an explicit HTTP response)"
+            )
+        if not by_code.get(200):
+            failures.append(f"no request ever succeeded: {by_code}")
+
+        # graceful drain: SIGTERM -> flush -> exit 0
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = proc.wait()
+            failures.append("server did not drain within 60s of SIGTERM")
+        if rc != 0:
+            failures.append(f"server exited {rc} after SIGTERM (want 0)")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    events = load_events(os.path.join(tel_dir, "events.jsonl"))
+    kinds = {e["kind"] for e in events}
+    for kind in EXPECTED_KINDS:
+        if kind not in kinds:
+            failures.append(f"event log is missing a {kind!r} event")
+    sheds = [e for e in events if e["kind"] == "shed"]
+    if not any(e.get("reason") == "queue_full" for e in sheds):
+        failures.append(
+            "saturation never shed on the bounded queue (reasons: "
+            f"{sorted({e.get('reason') for e in sheds})})"
+        )
+    drains = [e for e in events if e["kind"] == "drain"]
+    if drains and not drains[-1].get("flushed"):
+        failures.append("drain did not flush in-flight work")
+
+    summary = {
+        "responses_by_code": by_code,
+        "events": {
+            k: sum(1 for e in events if e["kind"] == k)
+            for k in EXPECTED_KINDS
+        },
+        "drain": drains[-1] if drains else None,
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=2, default=str))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not args.keep and args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

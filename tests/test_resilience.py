@@ -7,6 +7,7 @@ import importlib.util
 import json
 import os
 import signal
+import time
 
 import jax
 import numpy as np
@@ -113,6 +114,35 @@ def test_chaos_fire_ledger_survives_controller_rebuild():
         c2.on_step(step=5, epoch=0)
 
 
+def test_chaos_infer_faults_fire_only_at_the_infer_point():
+    """Serving kinds share the grammar/ledger but fire only at
+    ``on_infer`` (step = serving micro-batch sequence), never at the
+    training step point — one spec composes both chaoses."""
+    from distributed_mnist_bnns_tpu.resilience import ChaosInferError
+
+    ctl = ChaosController.from_config(
+        "infer_error@step=2,times=1;infer_slow@step=3,times=1,"
+        "delay_s=0.01;step_fault@step=2", seed=0,
+    )
+    ctl.on_infer(step=1)  # below the trigger: nothing
+    with pytest.raises(ChaosInferError):
+        ctl.on_infer(step=2)
+    ctl.on_infer(step=2)  # times=1 exhausted in the ledger
+    t0 = time.monotonic()
+    ctl.on_infer(step=3)  # the stall
+    assert time.monotonic() - t0 >= 0.01
+    # the training point never fires serving kinds (and vice versa)
+    reset_fire_counts()
+    with pytest.raises(ChaosStepFault):
+        ctl.on_step(step=2, epoch=0)
+    ctl.on_step(step=5, epoch=0)  # infer rules did not leak here
+    # a training resume says nothing about serving micro-batches
+    reset_fire_counts()
+    ctl.mark_reached(step=10, epoch=0)
+    with pytest.raises(ChaosInferError):
+        ctl.on_infer(step=2)
+
+
 def test_chaos_mark_reached_epoch_rules_by_fault_point(tmp_path):
     """Resumed AT epoch E: an epoch-E preempt (fires at epoch START —
     it produced the resume) is spent, but an epoch-E checkpoint-write
@@ -164,6 +194,135 @@ def test_backoff_is_jittered_exponential_and_capped():
         jitter=0.5, seed=0,
     )
     assert delays == [q.backoff(i) for i in range(1, 7)]
+
+
+def test_backoff_jitter_edge_values():
+    # jitter=0: exact deterministic exponential
+    p0 = RetryPolicy(
+        base_backoff_s=0.5, backoff_factor=2.0, max_backoff_s=4.0,
+        jitter=0.0, seed=None,
+    )
+    assert [p0.backoff(i) for i in (1, 2, 3, 4, 5)] == [
+        0.5, 1.0, 2.0, 4.0, 4.0
+    ]
+    # out-of-range jitter clamps to [0, 1]: delays stay in [0, raw]
+    p2 = RetryPolicy(base_backoff_s=1.0, max_backoff_s=8.0, jitter=2.0,
+                     seed=3)
+    for i in range(1, 20):
+        raw = min(2.0 ** (i - 1), 8.0)
+        assert 0.0 <= p2.backoff(i) <= raw
+    # zero base: never negative, never NaN
+    assert RetryPolicy(base_backoff_s=0.0, seed=0).backoff(1) == 0.0
+
+
+def test_classify_preempt_wins_over_fatal_override():
+    """Preempted IS a RuntimeError — a caller declaring RuntimeError
+    fatal must not turn preemption into a budget-consuming failure."""
+    assert classify_failure(
+        Preempted(0, 1), fatal_types=(RuntimeError,)
+    ) == "preempt"
+    assert RetryPolicy(fatal_types=(RuntimeError,)).classify(
+        Preempted(2, 8)
+    ) == "preempt"
+    # injected serving-backend faults are transient like all ChaosFaults
+    from distributed_mnist_bnns_tpu.resilience import ChaosInferError
+
+    assert classify_failure(ChaosInferError("boom")) == "transient"
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(**kw):
+    from distributed_mnist_bnns_tpu.resilience import CircuitBreaker
+
+    clock = _FakeClock()
+    transitions = []
+    b = CircuitBreaker(
+        clock=clock,
+        on_transition=lambda old, new, why: transitions.append((old, new)),
+        **kw,
+    )
+    return b, clock, transitions
+
+
+def test_breaker_trips_on_consecutive_failures_only():
+    b, _, transitions = _breaker(failure_threshold=3, reset_timeout_s=10.0)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # success resets the streak
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_failure()  # third consecutive
+    assert b.state == "open" and not b.allow()
+    assert transitions == [("closed", "open")]
+
+
+def test_breaker_half_open_probe_success_closes():
+    b, clock, transitions = _breaker(
+        failure_threshold=1, reset_timeout_s=5.0
+    )
+    b.record_failure("backend down")
+    assert not b.allow() and not b.admits()
+    clock.t = 4.9
+    assert not b.allow()
+    clock.t = 5.0
+    assert b.admits()          # read-only check does not consume probes
+    assert b.allow()           # the probe
+    assert b.state == "half_open"
+    assert not b.allow()       # only one probe outstanding
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    assert transitions == [
+        ("closed", "open"), ("open", "half_open"), ("half_open", "closed"),
+    ]
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    b, clock, transitions = _breaker(
+        failure_threshold=1, reset_timeout_s=2.0
+    )
+    b.record_failure()
+    clock.t = 2.0
+    assert b.allow()
+    b.record_failure("probe failed")
+    assert b.state == "open"
+    assert not b.allow()  # the reset timeout restarted at the re-open
+    clock.t = 3.9
+    assert not b.allow()
+    clock.t = 4.0
+    assert b.allow() and b.state == "half_open"
+    assert transitions[-2:] == [("half_open", "open"), ("open", "half_open")]
+
+
+def test_breaker_multi_probe_half_open():
+    b, clock, _ = _breaker(
+        failure_threshold=1, reset_timeout_s=1.0, half_open_probes=2
+    )
+    b.record_failure()
+    clock.t = 1.0
+    assert b.allow() and b.allow()   # two probes admitted
+    assert not b.allow()             # third rejected
+    b.record_success()
+    assert b.state == "half_open"    # one success is not enough
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_rejects_zero_threshold():
+    from distributed_mnist_bnns_tpu.resilience import CircuitBreaker
+
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=0)
 
 
 def test_run_with_policy_retries_transient_then_succeeds():

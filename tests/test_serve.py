@@ -1,0 +1,335 @@
+"""serve/ tests: admission queue + micro-batcher units, HTTP roundtrip,
+per-request deadlines (bounded response under backend stalls), load
+shedding at saturation, circuit-breaker open/half-open/close, SIGTERM
+graceful drain and bitwise-identical hot artifact reload — the serving
+acceptance criteria of SERVING.md "Live serving" / RESILIENCE.md."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.infer import export_packed
+from distributed_mnist_bnns_tpu.models import bnn_mlp_small
+from distributed_mnist_bnns_tpu.obs import load_events
+from distributed_mnist_bnns_tpu.resilience import reset_fire_counts
+from distributed_mnist_bnns_tpu.serve import (
+    AdmissionQueue,
+    PackedInferenceServer,
+    Request,
+    ServeConfig,
+)
+from distributed_mnist_bnns_tpu.serve import client as sc
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_ledger():
+    reset_fire_counts()
+    yield
+    reset_fire_counts()
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """A tiny exported bnn-mlp artifact (untrained weights — serving
+    mechanics don't care about accuracy)."""
+    model = bnn_mlp_small(backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=True,
+    )
+    path = tmp_path_factory.mktemp("serve_artifact") / "m.msgpack"
+    export_packed(model, variables, str(path))
+    return str(path)
+
+
+def _server(artifact, tmp_path, **kw):
+    kw.setdefault("port", 0)
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("default_deadline_ms", 2000.0)
+    kw.setdefault("telemetry_dir", str(tmp_path / "tel"))
+    kw.setdefault("interpret", True)
+    srv = PackedInferenceServer(ServeConfig(artifact=artifact, **kw))
+    host, port = srv.start()
+    return srv, f"http://{host}:{port}"
+
+
+def _events(tmp_path):
+    return load_events(str(tmp_path / "tel" / "events.jsonl"))
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 28, 28, 1).tolist()
+
+
+# -- data-plane units (no jax, no HTTP) --------------------------------------
+
+
+def test_admission_queue_bounded_and_coalescing():
+    q = AdmissionQueue(maxsize=3)
+    reqs = [
+        Request(np.zeros((n, 4), np.float32), time.monotonic() + 10)
+        for n in (2, 1, 1)
+    ]
+    for r in reqs:
+        assert q.try_put(r)
+    # full: the 4th is shed by the caller
+    assert not q.try_put(
+        Request(np.zeros((1, 4), np.float32), time.monotonic() + 10)
+    )
+    # pop coalesces whole requests up to max_examples: 2+1 fit in 4,
+    # the next 1 would fit too — all three go (total 4)
+    batch = q.pop_batch(4, linger_s=0)
+    assert [r.n for r in batch] == [2, 1, 1]
+    assert len(q) == 0
+    # empty queue: bounded wait, returns []
+    t0 = time.monotonic()
+    assert q.pop_batch(4, timeout=0.05) == []
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_admission_queue_head_never_splits():
+    q = AdmissionQueue(maxsize=4)
+    q.try_put(Request(np.zeros((3, 4), np.float32), time.monotonic() + 10))
+    q.try_put(Request(np.zeros((2, 4), np.float32), time.monotonic() + 10))
+    batch = q.pop_batch(4, linger_s=0)
+    assert [r.n for r in batch] == [3]  # the 2-example req doesn't fit
+    assert [r.n for r in q.pop_batch(4, linger_s=0)] == [2]
+
+
+def test_request_finish_claims_once():
+    r = Request(np.zeros((1, 4), np.float32), time.monotonic() + 10)
+    assert r.finish("deadline", error="waiter gave up")
+    # the engine's late delivery loses the race and must not overwrite
+    assert not r.finish("ok", log_probs=np.zeros((1, 10)))
+    assert r.status == "deadline"
+    assert r.event.is_set()
+
+
+# -- HTTP server -------------------------------------------------------------
+
+
+def test_roundtrip_health_metrics(artifact, tmp_path):
+    srv, base = _server(artifact, tmp_path)
+    try:
+        code, body = sc.healthz(base)
+        health = json.loads(body)
+        assert code == 200
+        assert health["status"] == "ok"
+        assert health["breaker"] == "closed"
+        assert health["family"] == "bnn-mlp"
+
+        code, body = sc.predict(base, _imgs(3))
+        assert code == 200
+        out = json.loads(body)
+        assert len(out["argmax"]) == 3
+        assert len(out["log_probs"][0]) == 10
+        # matches the engine's own predictor on the same padded batch
+        x = np.asarray(_imgs(3), np.float32)
+        xp = np.concatenate([x, np.zeros((1, 28, 28, 1), np.float32)])
+        direct = np.asarray(srv.engine.predict_fn(xp))[:3]
+        np.testing.assert_allclose(
+            np.asarray(out["log_probs"]), direct, rtol=1e-5, atol=1e-5
+        )
+
+        code, body = sc.metrics(base)
+        snap = json.loads(body)
+        assert code == 200
+        assert snap["serve_requests_total"]["series"]
+
+        # malformed input is a 400, not a 500 or a hang
+        assert sc.predict(base, "not-an-image")[0] == 400
+        # an over-size batch is an explicit 413
+        assert sc.predict(base, _imgs(5))[0] == 413
+        # a wrong per-example shape is a 400 at admission — it must
+        # never reach the worker (one compiled batch shape) nor kill it
+        flat = np.zeros((2, 784), np.float32).tolist()
+        code, body = sc.predict(base, flat)
+        assert code == 400 and b"input shape" in body
+        # a junk deadline is a 400 too, never a handler crash
+        assert sc.predict(base, _imgs(1), deadline_ms="fast")[0] == 400
+        assert sc.predict(base, _imgs(1))[0] == 200  # still serving
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+
+
+def test_hot_reload_bitwise_identical(artifact, tmp_path):
+    """Atomic artifact swap: for unchanged weights, the response for a
+    fixed input is BITWISE identical across the reload."""
+    srv, base = _server(artifact, tmp_path)
+    try:
+        imgs = _imgs(2, seed=3)
+        code, before = sc.predict(base, imgs)
+        assert code == 200
+        code, body = sc.reload_artifact(base)
+        assert code == 200 and json.loads(body)["reloaded"]
+        code, after = sc.predict(base, imgs)
+        assert code == 200
+        assert before == after
+        # unknown path fails cleanly and keeps serving
+        assert sc.reload_artifact(base, "/nonexistent.msgpack")[0] == 400
+        assert sc.predict(base, imgs)[1] == before
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+    assert any(e["kind"] == "reload" for e in _events(tmp_path))
+
+
+def test_deadline_bounds_response_under_stall(artifact, tmp_path):
+    """A backend stall must not turn into a deadline-less client hang:
+    the waiter abandons at its deadline and gets a prompt 504."""
+    srv, base = _server(
+        artifact, tmp_path,
+        chaos="infer_slow@step=1,times=1,delay_s=0.6",
+        stall_timeout_s=10.0,  # isolate deadlines from the breaker
+    )
+    try:
+        t0 = time.monotonic()
+        code, body = sc.predict(base, _imgs(1), deadline_ms=200)
+        elapsed = time.monotonic() - t0
+        assert code == 504
+        assert elapsed < 0.55, f"504 took {elapsed:.3f}s (stall was 0.6s)"
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+    events = _events(tmp_path)
+    assert any(
+        e["kind"] == "request" and e["status"] == "deadline"
+        for e in events
+    )
+    assert any(
+        e["kind"] == "fault_injected" and e["fault"] == "infer_slow"
+        for e in events
+    )
+
+
+def test_breaker_trips_half_opens_closes(artifact, tmp_path):
+    """Consecutive backend errors trip the breaker; while open the
+    server fast-fails; after the reset timeout a half-open probe
+    succeeds and closes it — all visible in obs events."""
+    srv, base = _server(
+        artifact, tmp_path,
+        chaos="infer_error@step=2,times=3",
+        breaker_threshold=3, breaker_reset_s=0.3,
+    )
+    try:
+        assert sc.predict(base, _imgs(1))[0] == 200       # batch 1
+        for _ in range(3):                                # batches 2-4
+            assert sc.predict(base, _imgs(1))[0] == 502
+        assert json.loads(sc.healthz(base)[1])["breaker"] == "open"
+        code, body = sc.predict(base, _imgs(1))           # fast-fail
+        assert code == 503
+        assert json.loads(body)["reason"] == "breaker_open"
+        time.sleep(0.35)
+        assert sc.predict(base, _imgs(1))[0] == 200       # probe
+        assert json.loads(sc.healthz(base)[1])["breaker"] == "closed"
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+    kinds = [e["kind"] for e in _events(tmp_path)]
+    assert "breaker_open" in kinds and "breaker_close" in kinds
+
+
+def test_chaos_saturation_shed_breaker_drain(artifact, tmp_path):
+    """The acceptance scenario: stalls + errors injected at saturation
+    load — the server sheds explicitly (never queue collapse), the
+    breaker cycles as scripted, and a stop request drains all in-flight
+    work; every behavior asserted from emitted obs events."""
+    srv, base = _server(
+        artifact, tmp_path,
+        queue_depth=3,
+        chaos=(
+            # stalls FIRST: the queue must observably back up and shed
+            # while all hammer threads are still in flight...
+            "infer_slow@step=3,times=2,delay_s=0.4"
+            # ...then consecutive errors trip the breaker
+            ";infer_error@step=12,times=3"
+        ),
+        stall_timeout_s=0.15, breaker_threshold=3, breaker_reset_s=0.3,
+    )
+    codes = []
+    lock = threading.Lock()
+    stop_at = time.monotonic() + 3.5
+
+    def hammer(tid):
+        while time.monotonic() < stop_at:
+            code, _ = sc.predict(
+                base, _imgs(2, seed=tid), deadline_ms=250
+            )
+            with lock:
+                codes.append(code)
+            time.sleep(0.01)
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,)) for i in range(8)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "client hang"
+        # keep probing until the exhausted-chaos traffic closes the
+        # breaker again (half-open probe success after breaker_reset_s)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            time.sleep(0.35)
+            if sc.predict(base, _imgs(1))[0] == 200 and json.loads(
+                sc.healthz(base)[1]
+            )["breaker"] == "closed":
+                break
+        assert json.loads(sc.healthz(base)[1])["breaker"] == "closed"
+    finally:
+        srv.request_stop("chaos acceptance over")
+        stats = srv.drain_and_stop()
+
+    # every response is an explicit status — shed/deadline/error, never
+    # a transport failure or a hang
+    assert set(codes) <= {200, 502, 503, 504}
+    assert stats["flushed"], "drain did not flush in-flight work"
+    assert len(srv.queue) == 0
+    events = _events(tmp_path)
+    kinds = {e["kind"] for e in events}
+    assert {
+        "request", "shed", "breaker_open", "breaker_close", "drain",
+        "fault_injected",
+    } <= kinds, f"missing event kinds, have {sorted(kinds)}"
+    sheds = [e for e in events if e["kind"] == "shed"]
+    assert any(e["reason"] == "queue_full" for e in sheds), \
+        "saturation never shed on the bounded queue"
+    drain = [e for e in events if e["kind"] == "drain"][-1]
+    assert drain["flushed"] is True
+
+
+def test_drain_rejects_new_work_but_flushes_queued(artifact, tmp_path):
+    """Graceful drain = stop admitting + flush: requests queued before
+    the stop still get real answers; requests after it get an explicit
+    draining 503."""
+    srv, base = _server(
+        artifact, tmp_path, default_deadline_ms=5000.0,
+        chaos="infer_slow@step=1,times=1,delay_s=0.3",
+        stall_timeout_s=10.0,
+    )
+    results = {}
+
+    def slow_req():
+        results["queued"] = sc.predict(base, _imgs(1))
+
+    t = threading.Thread(target=slow_req)
+    t.start()
+    time.sleep(0.1)  # let it reach the (stalled) engine
+    srv.engine.begin_drain()
+    code, body = sc.predict(base, _imgs(1))
+    assert code == 503
+    assert json.loads(body)["reason"] == "draining"
+    t.join(timeout=10)
+    assert results["queued"][0] == 200, "in-flight request lost in drain"
+    srv.request_stop("test over")
+    srv.drain_and_stop()

@@ -1,11 +1,17 @@
-"""TCP checkpoint shipping: roundtrip on localhost, then resume from the
-shipped checkpoint — the working version of the reference's master/node
-socket experiment (SURVEY §3.4)."""
+"""TCP checkpoint shipping: roundtrip on localhost, digest-verified
+protocol (corrupt ships rejected before the atomic rename, bad acks
+rejected by the sender), then resume from the shipped checkpoint — the
+working version of the reference's master/node socket experiment
+(SURVEY §3.4)."""
 
+import hashlib
+import socket
+import struct
 import threading
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_mnist_bnns_tpu.data import load_mnist
 from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
@@ -39,6 +45,80 @@ def test_send_receive_roundtrip(tmp_path):
     t.join(timeout=10)
     assert sent == len(payload) == result["size"]
     assert (out_dir / "artifact.bin").read_bytes() == payload
+
+
+def test_corrupt_ship_rejected_before_rename(tmp_path):
+    """A truncated-but-length-matching (here: bit-flipped) payload must
+    fail the receiver's digest check BEFORE the tmp→rename — the final
+    file never appears, so a resume can't trust corrupt bytes."""
+    out_dir = tmp_path / "inbox"
+    errors = {}
+
+    def recv():
+        try:
+            receive_file(str(out_dir), PORT + 2, timeout=10)
+        except IOError as e:
+            errors["e"] = e
+
+    t = threading.Thread(target=recv)
+    t.start()
+    import time
+
+    time.sleep(0.2)
+    # hand-rolled sender: correct name/length framing, digest of the
+    # ORIGINAL payload, but ships flipped bytes (same length)
+    payload = bytes(range(256)) * 64
+    corrupt = bytes(b ^ 0xFF for b in payload)
+    digest = hashlib.sha256(payload).digest()
+    q = struct.Struct(">Q")
+    with socket.create_connection(("127.0.0.1", PORT + 2), timeout=10) as s:
+        s.sendall(q.pack(4) + b"f.ck" + q.pack(len(payload)) + digest)
+        s.sendall(corrupt)
+    t.join(timeout=10)
+    assert "e" in errors and "sha256 mismatch" in str(errors["e"])
+    assert not (out_dir / "f.ck").exists()
+    assert not (out_dir / "f.ck.tmp").exists()
+
+
+def test_sender_rejects_wrong_ack_digest(tmp_path):
+    """The sender verifies the ack digest too: a receiver that stored
+    different bytes (here: a fake acking garbage) fails the ship."""
+    src = tmp_path / "artifact.bin"
+    src.write_bytes(b"payload-bytes" * 100)
+    q = struct.Struct(">Q")
+    ready = threading.Event()
+
+    def fake_receiver():
+        with socket.socket() as srv:
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", PORT + 3))
+            srv.listen(1)
+            srv.settimeout(10)
+            ready.set()
+            conn, _ = srv.accept()
+            with conn:
+                conn.settimeout(10)
+                name_len = q.unpack(_read(conn, 8))[0]
+                _read(conn, name_len)
+                size = q.unpack(_read(conn, 8))[0]
+                _read(conn, 32)          # sender digest, ignored
+                _read(conn, size)        # payload, discarded
+                conn.sendall(q.pack(size) + b"\x00" * 32)  # bad digest
+
+    def _read(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            assert chunk
+            buf += chunk
+        return buf
+
+    t = threading.Thread(target=fake_receiver)
+    t.start()
+    ready.wait(10)
+    with pytest.raises(IOError, match="acked sha256"):
+        send_file(str(src), "127.0.0.1", PORT + 3, retries=0)
+    t.join(timeout=10)
 
 
 def test_ship_checkpoint_and_resume_elsewhere(tmp_path):
