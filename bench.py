@@ -287,7 +287,7 @@ def _utc_now(epoch_s: float | None = None) -> str:
 # was silently dropped when they did).
 SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
-    "device_resident_epoch", "train_step_per_backend",
+    "device_resident_epoch", "train_step_per_backend", "comm",
 )
 
 
@@ -509,6 +509,90 @@ def _cpu_fallback_extras(args):
         "step_time_ms": round(dt * 1e3, 3),
         "loss_finite": math.isfinite(loss),
     }
+
+
+def _bench_comm(args, deadline):
+    """Gradient-exchange section (--comm-bench; PERF.md "Gradient
+    comms"): the DP train step at each grad_compress mode — fp32 psum
+    baseline vs 1-bit sign / sign_ef — reporting wire bytes/step (the
+    analytic ring model over the real packed sizes, the same numbers
+    the comm_bytes_total counter accumulates) and measured step time.
+    Wire savings are topology-independent; the step-time column is only
+    meaningful where the interconnect, not compute, bounds the step —
+    on a single-host CPU/TPU mesh the collectives are ICI/shared-memory
+    and the compression arithmetic usually costs more than it saves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    n = jax.device_count()
+    out = {
+        "devices": n,
+        "model": args.model,
+        "batch_size": args.comm_batch_size,
+        "backend": args.backend,
+        "device_kind": str(jax.devices()[0].device_kind),
+    }
+    if n < 2:
+        out["note"] = "single device: no gradient exchange to measure"
+        return out
+    bs = -(-args.comm_batch_size // n) * n
+    if args.model.startswith("xnor-resnet"):
+        input_shape = (32, 32, 3)
+    else:
+        input_shape = (28, 28, 1)
+    key = jax.random.PRNGKey(0)
+    images = np.asarray(jax.random.normal(
+        key, (bs, *input_shape), jnp.float32
+    ))
+    labels = np.asarray(jax.random.randint(key, (bs,), 0, 10))
+    modes = {}
+    for mode in ("none", "sign", "sign_ef"):
+        if time.monotonic() > deadline:
+            modes[mode] = "skipped (bench deadline)"
+            continue
+        trainer = Trainer(
+            TrainConfig(
+                model=args.model, batch_size=bs, optimizer="adam",
+                learning_rate=0.01, backend=args.backend, seed=0,
+                data_parallel="auto", grad_compress=mode,
+            ),
+            input_shape=input_shape,
+        )
+        dt, loss = _bench_train_step(
+            trainer, images, labels, min(args.steps, args.comm_steps),
+            args.warmup, args.reps, deadline,
+        )
+        plan = trainer.comm_plan
+        row = {
+            "wire_bytes_per_step": plan.wire_bytes_per_step,
+            "wire_ratio_vs_fp32": (
+                round(plan.wire_ratio, 5)
+                if plan.wire_ratio is not None else None
+            ),
+            "n_params": plan.n_params,
+            "buckets": plan.world * plan.nb,
+        }
+        if dt is None:
+            row["step_time_ms"] = "below measurement floor"
+        else:
+            row.update(
+                step_time_ms=round(dt * 1e3, 3),
+                images_per_sec=round(bs / dt, 1),
+                loss_finite=math.isfinite(loss),
+            )
+        modes[mode] = row
+    out["modes"] = modes
+    sign = modes.get("sign")
+    if isinstance(sign, dict) and isinstance(modes.get("none"), dict):
+        base_bytes = modes["none"]["wire_bytes_per_step"]
+        out["bytes_reduction_sign"] = (
+            round(base_bytes / sign["wire_bytes_per_step"], 1)
+            if sign["wire_bytes_per_step"] else None
+        )
+    return out
 
 
 def _bench_lm(args, deadline):
@@ -919,6 +1003,16 @@ def main() -> None:
                    help="also bench end-to-end frozen-model serving: "
                         "packed img/s at batch 1/8/64 vs live eval, "
                         "KV-decode tokens/s, artifact cold-start latency")
+    p.add_argument("--comm-bench", action="store_true",
+                   help="also bench the DP gradient exchange: fp32 psum "
+                        "vs 1-bit sign/sign_ef compression (wire "
+                        "bytes/step + step time per mode; PERF.md "
+                        "'Gradient comms')")
+    p.add_argument("--comm-batch-size", type=int, default=512,
+                   help="global batch for the comm section (rounded up "
+                        "to a device multiple)")
+    p.add_argument("--comm-steps", type=int, default=20,
+                   help="timed steps per comm mode")
     p.add_argument("--serving-lm-ctx", type=int, default=256,
                    help="KV-cache length for the serving decode bench")
     p.add_argument("--serving-batches", type=int, nargs="+",
@@ -1297,6 +1391,13 @@ def main() -> None:
             result["serving"] = _bench_serving(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["serving"] = f"failed: {e!r:.300}"
+
+    if args.comm_bench and time.monotonic() < deadline - 60:
+        try:
+            _progress("comm: DP gradient-exchange compression section")
+            result["comm"] = _bench_comm(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["comm"] = f"failed: {e!r:.300}"
 
     if args.all_backends:
         per_backend = {}

@@ -217,17 +217,29 @@ def _is_train_step_shaped(name: Optional[str], fn: Optional[ast.AST]) -> bool:
     """The shapes we insist donate their input state: a 'step' that is
     explicitly a *train/update* step, or whose first parameter is the
     optimizer-carrying ``state``. Eval steps are excluded — their state
-    argument is reused across batches and must NOT be donated."""
-    label = (name or "").lower()
+    argument is reused across batches and must NOT be donated.
+
+    Both the jitted binding name AND the resolved callable's own name
+    are considered: step builders that jit a shard_map-wrapped body
+    (``shmapped = shard_map(compressed_train_step, ...); jax.jit(
+    shmapped)``) would otherwise hide a train step behind a wrapper
+    binding the name check can't see through — the compressed-DP step
+    family is exactly this shape."""
+    labels = []
+    if name:
+        labels.append(name.lower())
     first_param = None
     if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-        if not name:
-            label = fn.name.lower()
+        labels.append(fn.name.lower())
         if fn.args.args:
             first_param = fn.args.args[0].arg
-    if "eval" in label or "step" not in label:
+    if any("eval" in label for label in labels):
         return False
-    return first_param == "state" or "train" in label or "update" in label
+    if not any("step" in label for label in labels):
+        return False
+    return first_param == "state" or any(
+        "train" in label or "update" in label for label in labels
+    )
 
 
 def check_jit_boundary(module: LintModule) -> List[Finding]:

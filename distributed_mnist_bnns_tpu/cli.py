@@ -160,6 +160,22 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--dp-mode", default="gspmd",
                         choices=["gspmd", "fsdp"],
                         help="fsdp = ZeRO-style sharded params/opt state")
+        sp.add_argument("--grad-compress", default="none",
+                        choices=["none", "sign", "sign_ef"],
+                        help="1-bit DP gradient exchange (PERF.md "
+                             "'Gradient comms'): sign bitplanes + per-"
+                             "bucket fp32 scales, ~32x fewer wire bytes "
+                             "per step; sign = majority-vote signSGD, "
+                             "sign_ef = error feedback (residuals "
+                             "checkpoint in the optimizer state). "
+                             "gspmd DP only")
+        sp.add_argument("--compress-bucket-size", type=int, default=1024,
+                        help="elements per compression scale bucket "
+                             "(multiple of 32)")
+        sp.add_argument("--compress-chunks", type=int, default=4,
+                        help="independent overlap groups for the "
+                             "compressed exchange (comm of group i "
+                             "overlaps packing of group i+1)")
         sp.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel width: Megatron col/row "
                              "sharding over a 'model' mesh axis (MLP/QNN "
@@ -391,6 +407,9 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         resume=args.resume,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
         dp_mode=args.dp_mode,
+        grad_compress=args.grad_compress,
+        compress_bucket_size=args.compress_bucket_size,
+        compress_chunks=args.compress_chunks,
         pipeline_parallel=args.pp,
         pp_microbatches=args.pp_microbatches,
         pp_remat=args.pp_remat,

@@ -24,6 +24,8 @@ Event kinds (schema v1):
   rollback       restore skipped corrupt generation(s) (resilience)
   restart        the retry loop rebuilt the trainer (cause, attempt,
                  backoff — resilience/policy)
+  comm_compress  the DP run's 1-bit gradient-exchange plan (mode,
+                 buckets, wire bytes/step vs fp32 — PERF.md)
   request        one served prediction request's final status (serve/)
   shed           admission rejected a request (queue_full |
                  breaker_open | draining — serve/)

@@ -2,6 +2,13 @@ from .augment import random_crop_flip
 from .binarize import binarize, binarize_ste, quantize
 from .losses import hinge_loss, sqrt_hinge_loss, cross_entropy_loss, make_loss
 from .bitpack import pack_bits, pack_bits_mxu, unpack_bits, packed_dim
+from .comm_compress import (
+    CommPlan,
+    compress_buckets,
+    decompress_buckets,
+    exchange,
+    make_plan,
+)
 from .flash_attention import flash_attention
 from .xnor_gemm import (
     xnor_matmul,
@@ -26,6 +33,11 @@ __all__ = [
     "pack_bits_mxu",
     "unpack_bits",
     "packed_dim",
+    "CommPlan",
+    "compress_buckets",
+    "decompress_buckets",
+    "exchange",
+    "make_plan",
     "xnor_matmul",
     "xnor_matmul_packed",
     "prepack_weights",

@@ -1,0 +1,273 @@
+"""1-bit gradient compression for the data-parallel exchange.
+
+The source paper's premise is distributed BNN training over a slow
+commodity network, yet the plain DP step moves full fp32 gradients every
+step — the one tensor class this codebase already knows how to make 32x
+smaller (ops/bitpack: bitplane packing, 0.031 bytes/param). This module
+compresses the gradient exchange itself: per-bucket **sign bitplanes**
+(int32 words, the exact pack_bits wire format the XNOR kernels use) plus
+one fp32 **scale per bucket** (mean |g| — the L2-optimal 1-bit
+magnitude), following signSGD with majority vote (Bernstein et al.,
+2018) and error-feedback sign compression (EF-SignSGD, Karimireddy et
+al., 2019; two-stage residuals as in 1-bit Adam).
+
+Exchange topology — two compressed phases, not one all_gather:
+
+  phase 1 (compressed reduce-scatter): the flattened gradient is split
+      into ``world`` segments; ``lax.all_to_all`` routes every worker's
+      sign-planes for segment *j* to worker *j*, which decodes the
+      ``world`` contributions and combines them (mean of scale*sign, or
+      the Bernstein majority vote over raw signs).
+  phase 2 (compressed all-gather): each segment owner re-compresses its
+      combined segment (exact for majority output, whose magnitude is
+      bucket-constant; a second error-feedback residual absorbs the
+      requantization loss in mean mode) and ``lax.all_gather``
+      broadcasts the result.
+
+Per-worker wire bytes are ``2*(N-1)/N * (D/8 + 4*n_buckets)`` vs the
+fp32 ring all-reduce's ``2*(N-1)/N * 4*D`` — a ~32x reduction (~1/31
+with the default 1024-element buckets), independent of N. A single
+all_gather of everyone's planes would instead cost ``(N-1)*D/8``
+received bytes — only 8x at N=8 — which is why the reduce-scatter
+shape matters on the slow interconnects this targets.
+
+Overlap: the bucket axis is split into ``chunks`` independent groups,
+each with its own pack -> all_to_all -> combine -> all_gather chain and
+no data dependency on its neighbors, so XLA's async collectives overlap
+the exchange of group *i* with the packing compute of group *i+1*
+(the in-jit analogue of DDP's bucketed backward hooks).
+
+All functions are pure and shard_map-friendly: with ``axis_name=None``
+(world 1) the collectives drop out and the pipeline degenerates to
+local compress/decompress — the single-process form the NumPy oracle
+tests check bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .bitpack import WORD_BITS, pack_bits, unpack_bits
+
+MODES = ("none", "sign", "sign_ef")
+
+
+def _signs(x: jnp.ndarray) -> jnp.ndarray:
+    """±1 with the pack_bits convention (bit = 1 ⟺ value > 0): the
+    residual math must quantize exactly the way peers decode, or the
+    error feedback would track a value nobody applied."""
+    return jnp.where(x > 0, 1.0, -1.0).astype(x.dtype)
+
+
+@dataclass(frozen=True)
+class CommPlan:
+    """Static shape/byte accounting for one compressed exchange.
+
+    Byte counts use the standard ring-collective model (the convention
+    DDP bucket accounting uses): per worker and per step, a ring
+    all-reduce of D fp32 values moves ``2*(N-1)/N * 4*D`` bytes, and
+    each compressed phase moves ``(N-1)/N`` of one worker's full
+    compressed message (planes + scales). They are derived from the
+    actual packed-array sizes, not measured on the NIC — XLA exposes no
+    portable wire counter — and ``tests/test_comm_compress.py`` pins
+    them to the real buffer ``nbytes``.
+    """
+
+    mode: str           # "sign" | "sign_ef" | "fp32" (uncompressed DP)
+    world: int          # data-parallel workers
+    n_params: int       # true flattened gradient length D
+    bucket_size: int    # elements per scale bucket (multiple of 32)
+    chunks: int         # independent overlap groups over the bucket axis
+    nb: int             # buckets per segment
+    padded: int         # world * nb * bucket_size >= n_params
+
+    @property
+    def seg(self) -> int:
+        return self.nb * self.bucket_size
+
+    @property
+    def words(self) -> int:
+        return self.bucket_size // WORD_BITS
+
+    @property
+    def message_bytes(self) -> int:
+        """One worker's full compressed gradient: sign planes + scales."""
+        return self.padded // 8 + 4 * self.world * self.nb
+
+    @property
+    def fp32_bytes_per_step(self) -> int:
+        """Ring all-reduce cost of the uncompressed fp32 gradient."""
+        return int(2 * (self.world - 1) / max(self.world, 1)
+                   * 4 * self.n_params)
+
+    @property
+    def wire_bytes_per_step(self) -> int:
+        if self.world <= 1:
+            return 0
+        if self.mode == "fp32":
+            return self.fp32_bytes_per_step
+        # phase 1 all_to_all + phase 2 all_gather, each (N-1)/N of one
+        # full message per worker
+        return int(2 * (self.world - 1) / self.world * self.message_bytes)
+
+    @property
+    def saved_bytes_per_step(self) -> int:
+        return max(self.fp32_bytes_per_step - self.wire_bytes_per_step, 0)
+
+    @property
+    def wire_ratio(self) -> Optional[float]:
+        """Wire bytes as a fraction of the fp32 exchange (None when
+        there is no exchange to compare against)."""
+        if self.fp32_bytes_per_step == 0:
+            return None
+        return self.wire_bytes_per_step / self.fp32_bytes_per_step
+
+
+def make_plan(
+    n_params: int,
+    *,
+    world: int,
+    mode: str,
+    bucket_size: int = 1024,
+    chunks: int = 4,
+) -> CommPlan:
+    """Size the segment/bucket layout for a D-element gradient.
+
+    ``bucket_size`` must be a multiple of 32 so sign planes pack into
+    whole int32 words with no cross-bucket masking."""
+    if mode not in ("sign", "sign_ef", "fp32"):
+        raise ValueError(
+            f"unknown compression mode {mode!r} "
+            "(have: sign, sign_ef, fp32)"
+        )
+    if bucket_size <= 0 or bucket_size % WORD_BITS:
+        raise ValueError(
+            f"bucket_size must be a positive multiple of {WORD_BITS}, "
+            f"got {bucket_size}"
+        )
+    world = max(int(world), 1)
+    nb = max(-(-n_params // (world * bucket_size)), 1)
+    chunks = max(min(int(chunks), nb), 1)
+    return CommPlan(
+        mode=mode, world=world, n_params=int(n_params),
+        bucket_size=int(bucket_size), chunks=chunks, nb=nb,
+        padded=world * nb * bucket_size,
+    )
+
+
+def compress_buckets(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sign-compress bucketed values: x (..., B) -> (planes (..., B/32)
+    int32, scale (...,) = mean |x| fp32). ``decompress_buckets`` of the
+    result is ``scale * signs(x)`` exactly."""
+    scale = jnp.mean(jnp.abs(x), axis=-1)
+    return pack_bits(x), scale
+
+
+def decompress_buckets(
+    planes: jnp.ndarray, scale: jnp.ndarray, bucket_size: int
+) -> jnp.ndarray:
+    """Inverse of compress_buckets: (..., B/32) planes + (...,) scales
+    -> (..., B) values ``scale * sign``."""
+    return unpack_bits(planes, bucket_size) * scale[..., None]
+
+
+def exchange(
+    flat: jnp.ndarray,
+    plan: CommPlan,
+    *,
+    axis_name: Optional[str],
+    e2: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
+    """Run the two-phase compressed exchange on a padded flat gradient.
+
+    flat: (plan.padded,) this worker's (error-corrected) gradient.
+    e2:   (plan.seg,) this worker's segment-owner residual (sign_ef
+          mode; None for majority/sign mode).
+
+    Returns ``(combined, sent, e2_new)``:
+      combined: (plan.padded,) the decoded global update, identical on
+                every worker (all inputs to the final decode came off
+                the same all_gather);
+      sent:     (plan.padded,) what THIS worker's phase-1 message decodes
+                to — the quantity worker error feedback subtracts;
+      e2_new:   (plan.seg,) updated segment residual (None in sign mode).
+
+    With ``axis_name=None`` (world 1) both collectives are identity and
+    the function reduces to local compress/decompress.
+    """
+    world, nb, B = plan.world, plan.nb, plan.bucket_size
+    x = flat.reshape(world, nb, B)
+    e2_in = None if e2 is None else e2.reshape(nb, B)
+
+    combined, sent, e2_out = [], [], []
+    # Independent per-chunk collectives: no chunk's ops depend on a
+    # neighbor's, so XLA's async collectives overlap chunk i's
+    # all_to_all/all_gather with chunk i+1's packing compute.
+    per = -(-nb // plan.chunks)
+    for c in range(plan.chunks):
+        sl = slice(c * per, min((c + 1) * per, nb))
+        if sl.start >= nb:
+            break
+        xc = x[:, sl]                               # (world, nbc, B)
+        planes, scale = compress_buckets(xc)
+        sent.append(decompress_buckets(planes, scale, B))
+        if axis_name is not None:
+            # phase 1: worker j receives every worker's planes for
+            # segment j (compressed reduce-scatter).
+            planes = jax.lax.all_to_all(
+                planes, axis_name, split_axis=0, concat_axis=0
+            )
+            scale = jax.lax.all_to_all(
+                scale, axis_name, split_axis=0, concat_axis=0
+            )
+        contrib = decompress_buckets(planes, scale, B)  # (world, nbc, B)
+        if plan.mode == "sign":
+            # Bernstein majority vote on raw signs; magnitude = mean of
+            # the contributed bucket scales (constant per bucket, so the
+            # phase-2 recompression below is exact).
+            votes = jnp.sum(unpack_bits(planes, B), axis=0)
+            y = _signs(votes) * jnp.mean(scale, axis=0)[..., None]
+        else:
+            y = jnp.mean(contrib, axis=0)           # (nbc, B)
+        if e2_in is not None:
+            y = y + e2_in[sl]
+        planes2, scale2 = compress_buckets(y)
+        dec2 = decompress_buckets(planes2, scale2, B)
+        if e2_in is not None:
+            e2_out.append(y - dec2)
+        if axis_name is not None:
+            # phase 2: broadcast the owner's combined segment.
+            planes2 = jax.lax.all_gather(planes2, axis_name, axis=0)
+            scale2 = jax.lax.all_gather(scale2, axis_name, axis=0)
+            dec2 = decompress_buckets(planes2, scale2, B)
+        else:
+            dec2 = dec2[None]                       # (1, nbc, B)
+        combined.append(dec2)
+
+    out = jnp.concatenate(combined, axis=1).reshape(plan.padded)
+    sent_flat = jnp.concatenate(sent, axis=1).reshape(plan.padded)
+    e2_new = (
+        jnp.concatenate(e2_out, axis=0).reshape(plan.seg)
+        if e2_out else None
+    )
+    return out, sent_flat, e2_new
+
+
+def pad_flat(flat: jnp.ndarray, plan: CommPlan) -> jnp.ndarray:
+    """Zero-pad the true-D flat gradient to the plan's padded length
+    (zero pads decode to -1 * scale-of-a-partly-real-bucket; they are
+    sliced off before unraveling, and the worker residual keeps the
+    tail's quantization error from accumulating silently)."""
+    return jnp.pad(flat, (0, plan.padded - plan.n_params))
+
+
+def tree_size(tree: Any) -> int:
+    """Flattened element count of a pytree (the D a plan is sized for)."""
+    return sum(
+        int(leaf.size) for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "size")
+    )

@@ -1,13 +1,17 @@
 from .compat import shard_map
 from .fsdp import (
+    compressed_state_shardings,
+    compressed_state_specs,
     fsdp_shardings,
     fsdp_state_shardings,
     make_fsdp_train_step,
+    place_compressed_state,
     shard_state_fsdp,
 )
 from .mesh import make_hybrid_mesh, make_mesh
 from .distributed import initialize_multihost
 from .data_parallel import (
+    make_compressed_dp_train_step,
     make_dp_train_step,
     make_shardmap_dp_train_step,
     shard_batch,
@@ -54,11 +58,15 @@ __all__ = [
     "shard_map",
     "make_mesh",
     "make_hybrid_mesh",
+    "compressed_state_shardings",
+    "compressed_state_specs",
     "fsdp_shardings",
     "fsdp_state_shardings",
     "make_fsdp_train_step",
+    "place_compressed_state",
     "shard_state_fsdp",
     "initialize_multihost",
+    "make_compressed_dp_train_step",
     "make_dp_train_step",
     "make_shardmap_dp_train_step",
     "shard_batch",

@@ -194,3 +194,66 @@ def make_shardmap_dp_train_step(
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=(0,))
+
+
+def make_compressed_dp_train_step(
+    clamp_mask: Any,
+    mesh: Mesh,
+    state: "TrainState",
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    axis: str = "data",
+    remat: bool = False,
+    grad_accum: int = 1,
+    augment: bool = False,
+) -> Callable:
+    """Data-parallel train step with a 1-bit compressed gradient
+    exchange (ops/comm_compress, PERF.md "Gradient comms").
+
+    The body is the standard single-device step body — the DP all-reduce
+    lives INSIDE ``state.tx``: the ``sign_compress`` transformation
+    (train/optim.py) compresses each worker's local gradient to sign
+    bitplanes + per-bucket scales and runs the two-phase
+    all_to_all/all_gather exchange over ``axis``, so no ``pmean`` of
+    gradients appears here (adding one would both double-reduce and
+    defeat the compression). Metrics and BatchNorm running stats still
+    take the plain fp32 pmean — they are O(1) and O(channels), not
+    O(params).
+
+    ``state`` is the template whose opt_state carries the EF residual
+    buffers; their leading world axis is sharded over ``axis``
+    (parallel/fsdp.compressed_state_specs), everything else replicated.
+    """
+    body = make_step_body(
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum,
+        augment=augment,
+    )
+
+    def compressed_train_step(state, images, labels, rng):
+        # Decorrelate per-replica dropout/binarization noise; the body
+        # additionally folds in state.step (same scheme as the
+        # shard_map DP step above).
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        new_state, metrics = body(state, images, labels, rng)
+        metrics = jax.lax.pmean(metrics, axis)
+        bs = new_state.batch_stats
+        if bs:
+            # Per-replica normalization like torch DDP, replicated
+            # running stats kept consistent (see make_shardmap_dp_
+            # train_step).
+            new_state = new_state.replace(
+                batch_stats=jax.lax.pmean(bs, axis)
+            )
+        return new_state, metrics
+
+    from .fsdp import compressed_state_specs
+
+    state_specs = compressed_state_specs(state, axis)
+    shmapped = shard_map(
+        compressed_train_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(axis), P(axis), P()),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0,))

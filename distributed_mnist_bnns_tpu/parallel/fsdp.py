@@ -133,6 +133,62 @@ def make_fsdp_train_step(
     )
 
 
+# -- compressed-DP state layout ---------------------------------------------
+#
+# The 1-bit gradient exchange (ops/comm_compress, PERF.md "Gradient
+# comms") keeps per-worker error-feedback residuals in optimizer state
+# with a leading ``world`` axis. Sharding that axis over 'data' is the
+# ZeRO move this module exists for: the buffers checkpoint as ordinary
+# global arrays (bitwise save/restore) while each device materializes
+# only its own worker's row — one fp32 residual, the cost of a momentum
+# buffer, instead of N of them.
+
+
+def compressed_state_specs(state: Any, axis: str = "data") -> Any:
+    """TrainState-of-PartitionSpecs for the compressed shard_map DP step:
+    everything replicated except SignCompressState buffers, whose
+    leading world axis is sharded over ``axis`` (each worker sees its
+    own (1, ...) residual slice inside the shard_map body)."""
+    from ..train.optim import SignCompressState  # local import (cycle)
+
+    def mark(node):
+        if isinstance(node, SignCompressState):
+            return SignCompressState(
+                ef_residual=P(axis), ef_residual2=P(axis)
+            )
+        return jax.tree.map(lambda _: P(), node)
+
+    opt_specs = jax.tree.map(
+        mark, state.opt_state,
+        is_leaf=lambda n: isinstance(n, SignCompressState),
+    )
+    repl = jax.tree.map(lambda _: P(), state)
+    return repl.replace(opt_state=opt_specs)
+
+
+def compressed_state_shardings(
+    state: Any, mesh: Mesh, axis: str = "data"
+) -> Any:
+    """NamedSharding tree matching ``compressed_state_specs``."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        compressed_state_specs(state, axis),
+        is_leaf=lambda n: isinstance(n, P),
+    )
+
+
+def place_compressed_state(
+    state: Any, mesh: Mesh, axis: str = "data"
+) -> Any:
+    """Place a host/replicated TrainState onto the compressed-DP layout
+    (residual rows to their owning devices, everything else replicated).
+    Multi-process-safe via the same callback placement as FSDP."""
+    return jax.tree.map(
+        lambda leaf, sh: _place_fsdp_leaf(leaf, sh, axis),
+        state, compressed_state_shardings(state, mesh, axis),
+    )
+
+
 def fsdp_memory_fraction(params: Any, mesh: Mesh, axis: str = "data"
                          ) -> float:
     """Fraction of replicated-param bytes each device holds under FSDP

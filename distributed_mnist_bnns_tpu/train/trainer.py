@@ -528,6 +528,17 @@ class TrainConfig:
     data_parallel: Optional[object] = None  # None | "auto" | int devices
     dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
                                    # (ZeRO-style sharded params/opt state)
+    grad_compress: str = "none"    # 1-bit DP gradient exchange (PERF.md
+                                   # "Gradient comms"): "sign" (majority-
+                                   # vote signSGD) | "sign_ef" (error-
+                                   # feedback, EF residuals checkpoint in
+                                   # opt state). gspmd DP only; ~32x
+                                   # fewer bytes on the wire per step.
+    compress_bucket_size: int = 1024  # elements per fp32 scale bucket
+                                   # (multiple of 32)
+    compress_chunks: int = 4       # independent overlap groups: the
+                                   # exchange of group i overlaps the
+                                   # packing compute of group i+1
     pipeline_parallel: int = 1     # >1: GPipe the transformer block stack
                                    # over N devices (parallel/pipeline_model)
     pp_microbatches: int = 0       # microbatches per pipelined step
@@ -651,10 +662,8 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         self.clamp_mask = latent_clamp_mask(params)
-        tx = make_optimizer(
-            config.optimizer, config.learning_rate,
-            clip_grad_norm=config.clip_grad_norm,
-        )
+        self._setup_grad_compress(params)
+        tx = self._build_tx(config.optimizer, config.learning_rate)
         self.state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -729,6 +738,89 @@ class Trainer:
                 f"{config.checkpoint_backend!r} (have: msgpack, orbax)"
             )
 
+    def _setup_grad_compress(self, params: Any) -> None:
+        """Resolve the 1-bit gradient-exchange configuration (PERF.md
+        "Gradient comms"): the DP world size, the shard_map axis the
+        exchange runs over, and the static byte/bucket plan the
+        telemetry counters and bench report. Runs before the optimizer
+        is built — the compression lives inside ``tx``."""
+        cfg = self.config
+        self.comm_plan = None
+        self._compress_axis = None
+        if cfg.grad_compress == "none":
+            return
+        if cfg.grad_compress not in ("sign", "sign_ef"):
+            raise ValueError(
+                f"unknown grad_compress {cfg.grad_compress!r} "
+                "(have: none, sign, sign_ef)"
+            )
+        incompatible = [
+            (cfg.dp_mode != "gspmd", "dp_mode='gspmd'"),
+            (cfg.tensor_parallel > 1, "tensor_parallel=1"),
+            (cfg.pipeline_parallel > 1, "pipeline_parallel=1"),
+            (int(cfg.scan_steps) > 1, "scan_steps=1"),
+            (cfg.device_data, "device_data=False"),
+        ]
+        bad = [need for cond, need in incompatible if cond]
+        if bad:
+            # The exchange is an explicit shard_map collective inside
+            # tx; the scan/epoch/TP/PP/FSDP dispatches jit the plain
+            # step body and would silently train uncompressed.
+            raise ValueError(
+                f"grad_compress={cfg.grad_compress!r} requires "
+                + ", ".join(bad)
+            )
+        from ..ops.comm_compress import make_plan, tree_size
+
+        dp = cfg.data_parallel
+        world = (
+            jax.device_count() if dp == "auto" else int(dp) if dp else 1
+        )
+        world = max(world, 1)
+        if world <= 1:
+            # Legitimate (world-1 EF-signSGD, the oracle-test config)
+            # but easy to reach by forgetting --dp: the gradients are
+            # still sign-quantized while zero wire bytes are saved —
+            # say so instead of silently changing the optimizer.
+            log.warning(
+                "grad_compress=%r with data_parallel<=1: gradients are "
+                "sign-quantized locally but there is no exchange to "
+                "compress (pass --dp auto for the wire savings)",
+                cfg.grad_compress,
+            )
+        self._compress_axis = "data" if world > 1 else None
+        self.comm_plan = make_plan(
+            tree_size(params),
+            world=world,
+            mode=cfg.grad_compress,
+            bucket_size=cfg.compress_bucket_size,
+            chunks=cfg.compress_chunks,
+        )
+
+    def _build_tx(self, name: str, learning_rate: float, **kwargs: Any):
+        """make_optimizer with this run's gradient pre-transform chained
+        in — the one constructor both __init__ and the regime rebuild
+        path use, so an optimizer-class switch cannot silently drop the
+        compressed exchange (it does reset the EF residuals, exactly
+        like the moment buffers — adjust_optimizer semantics)."""
+        grad_transform = None
+        if self.config.grad_compress != "none":
+            from .optim import sign_compress
+
+            grad_transform = sign_compress(
+                mode=self.comm_plan.mode,
+                world=self.comm_plan.world,
+                axis_name=self._compress_axis,
+                bucket_size=self.comm_plan.bucket_size,
+                chunks=self.comm_plan.chunks,
+            )
+        return make_optimizer(
+            name, learning_rate,
+            clip_grad_norm=self.config.clip_grad_norm,
+            grad_transform=grad_transform,
+            **kwargs,
+        )
+
     @staticmethod
     def _build_model(name: str, mk: Dict[str, Any]):
         optional = ("dtype", "backend", "stochastic", "scale", "dropout")
@@ -801,6 +893,20 @@ class Trainer:
             peak_flops=self._peak_flops,
             peak_precision=self._peak_precision,
         )
+        if self.comm_plan is not None and self.comm_plan.mode != "fp32":
+            # One record per run describing the compressed exchange —
+            # the static plan the per-step comm_bytes_total counters
+            # accumulate from (OBSERVABILITY.md).
+            p = self.comm_plan
+            self.telemetry.emit(
+                "comm_compress",
+                mode=p.mode, world=p.world, n_params=p.n_params,
+                bucket_size=p.bucket_size, buckets=p.world * p.nb,
+                chunks=p.chunks,
+                wire_bytes_per_step=p.wire_bytes_per_step,
+                fp32_bytes_per_step=p.fp32_bytes_per_step,
+                wire_ratio=p.wire_ratio,
+            )
 
     def _setup_sanitizer(self) -> None:
         """Build the runtime fences (analysis/guards). Explicit config
@@ -842,6 +948,20 @@ class Trainer:
             n_devices=self._n_devices,
             metrics=metrics,
         )
+        if self.comm_plan is not None and self.comm_plan.world > 1:
+            # Gradient-exchange bytes on the wire (analytic ring model
+            # over the real packed sizes — PERF.md "Gradient comms").
+            p = self.comm_plan
+            reg = self.telemetry.registry
+            reg.counter(
+                "comm_bytes_total",
+                "gradient-exchange bytes on the wire per worker",
+            ).inc(p.wire_bytes_per_step * n, mode=p.mode)
+            if p.saved_bytes_per_step:
+                reg.counter(
+                    "comm_saved_bytes_total",
+                    "wire bytes saved vs the fp32 exchange",
+                ).inc(p.saved_bytes_per_step * n)
 
     def _setup_pipeline_parallel(self, loss_fn) -> None:
         """Switch the model's apply to the GPipe pipelined forward over a
@@ -1056,11 +1176,28 @@ class Trainer:
         self.mesh = make_mesh(data=n)
         if self.config.dp_mode == "fsdp":
             self._set_fsdp_step(loss_fn)
+        elif self.config.grad_compress != "none":
+            from ..parallel import place_compressed_state
+
+            self._set_compressed_dp_step(loss_fn)
+            self.state = place_compressed_state(self.state, self.mesh)
         else:
             self._set_dp_step(loss_fn)
             self.state = replicate(self.state, self.mesh)
+            # Byte accounting for the uncompressed exchange too, so
+            # comm_bytes_total{mode=fp32} gives compressed runs a
+            # measured-in-the-same-model baseline.
+            from ..ops.comm_compress import make_plan, tree_size
+
+            self.comm_plan = make_plan(
+                tree_size(self.state.params), world=n, mode="fp32",
+                bucket_size=self.config.compress_bucket_size,
+            )
         log.info(
-            "data-parallel (%s) over %d devices", self.config.dp_mode, n
+            "data-parallel (%s%s) over %d devices", self.config.dp_mode,
+            f", grad_compress={self.config.grad_compress}"
+            if self.config.grad_compress != "none" else "",
+            n,
         )
 
     def _set_dp_step(self, loss_fn) -> None:
@@ -1072,6 +1209,20 @@ class Trainer:
             augment=self.config.augment,
         )
         self.train_step = self._wrap_mesh_step(dp_step)
+
+    def _set_compressed_dp_step(self, loss_fn) -> None:
+        """DP with the 1-bit compressed gradient exchange: the all-
+        reduce lives inside ``state.tx`` (train/optim.sign_compress)
+        and runs as explicit shard_map collectives; the EF residual
+        rows are sharded over 'data' (PERF.md "Gradient comms")."""
+        from ..parallel import make_compressed_dp_train_step
+
+        step = make_compressed_dp_train_step(
+            self.clamp_mask, self.mesh, self.state, loss_fn=loss_fn,
+            remat=self.config.remat, grad_accum=self.config.grad_accum,
+            augment=self.config.augment,
+        )
+        self.train_step = self._wrap_mesh_step(step)
 
     def _set_fsdp_step(self, loss_fn) -> None:
         """ZeRO-style DP: params/grads/opt state sharded over 'data'."""
@@ -1125,8 +1276,12 @@ class Trainer:
                 shard_batch(data.test_labels[sel], self.mesh),
                 shard_batch(valid, self.mesh),
             )
+            # ONE host round-trip per batch: a per-key float() would pay
+            # a device->host sync per metric (4x the transfers).
+            jax.block_until_ready(out)
+            fetched = jax.device_get(out)
             for k in totals:
-                totals[k] += float(out[k])
+                totals[k] += float(fetched[k])
         return totals
 
     # -- multi-step scan dispatch -------------------------------------------
@@ -1399,10 +1554,9 @@ class Trainer:
             # Optimizer class switch: rebuild transform, fresh moments
             # (adjust_optimizer reconstructs the torch class the same way,
             # utils.py:120-126).
-            tx = make_optimizer(
+            tx = self._build_tx(
                 cfg["optimizer"],
                 cfg.get("learning_rate", self.config.learning_rate),
-                clip_grad_norm=self.config.clip_grad_norm,
                 **regime_hp_kwargs(cfg["optimizer"], cfg),
             )
             self.state = self.state.replace(
@@ -1423,6 +1577,11 @@ class Trainer:
                     self._set_fsdp_step(self._loss_fn)
                 elif self.config.tensor_parallel > 1:
                     self._set_tp_step(self._loss_fn)
+                elif self.config.grad_compress != "none":
+                    # The compressed step's shard_map specs embed the
+                    # opt_state structure (EF residual rows sharded over
+                    # 'data'); the fresh tx state needs a fresh build.
+                    self._set_compressed_dp_step(self._loss_fn)
                 else:
                     self._set_dp_step(self._loss_fn)
             else:

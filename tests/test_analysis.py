@@ -162,6 +162,47 @@ def test_jg003_negative_donated_and_eval_steps():
     assert not active(run_source(src, "lib.py"), "JG003")
 
 
+def test_jg003_sees_train_step_through_shard_map_wrapper():
+    """The compressed-DP step family jits a shard_map-wrapped local
+    body (``shmapped = shard_map(compressed_train_step, ...);
+    jax.jit(shmapped)``): JG003 must resolve through the wrapper
+    binding and still insist on donate_argnums."""
+    src = (
+        "import jax\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import "
+        "shard_map\n"
+        "def make(mesh, specs):\n"
+        "    def compressed_train_step(state, batch):\n"
+        "        return state\n"
+        "    shmapped = shard_map(compressed_train_step, mesh=mesh,\n"
+        "                         in_specs=specs, out_specs=specs)\n"
+        "    return jax.jit(shmapped)\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG003")) == 1
+    ok = src.replace(
+        "jax.jit(shmapped)", "jax.jit(shmapped, donate_argnums=(0,))"
+    )
+    assert not active(run_source(ok, "lib.py"), "JG003")
+
+
+def test_jg003_shard_map_wrapped_eval_step_not_flagged():
+    """The eval exclusion must survive the wrapper look-through: a
+    shard_map-wrapped eval step's state is reused across batches and
+    must NOT be donated."""
+    src = (
+        "import jax\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import "
+        "shard_map\n"
+        "def make(mesh, specs):\n"
+        "    def eval_step(state, batch):\n"
+        "        return state\n"
+        "    shmapped = shard_map(eval_step, mesh=mesh,\n"
+        "                         in_specs=specs, out_specs=specs)\n"
+        "    return jax.jit(shmapped)\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG003")
+
+
 def test_jg003_flags_unhashable_static_default():
     src = (
         "import jax\n"

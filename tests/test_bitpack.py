@@ -52,6 +52,33 @@ def test_popcount_dot_identity():
     assert k - 2 * mism == int(jnp.dot(a, b))
 
 
+def test_unpack_inverts_both_pack_paths_property():
+    """Property test for the sign-plane decode the compressed gradient
+    exchange rides on (ops/comm_compress): ``unpack(pack(x)) == x``
+    bit-for-bit for randomized shapes/K over BOTH pack implementations
+    — the VPU shift-reduce and the MXU int8-matmul path (bitpack
+    previously only round-tripped through the GEMM kernels)."""
+    from distributed_mnist_bnns_tpu.ops.bitpack import pack_bits_mxu
+
+    rng = np.random.RandomState(42)
+    for trial in range(20):
+        lead = tuple(rng.randint(1, 5, size=rng.randint(0, 3)))
+        k = int(rng.randint(1, 400))
+        x = np.sign(rng.randn(*lead, k)).astype(np.float32)
+        x[x == 0] = 1.0
+        xj = jnp.asarray(x)
+        for pack in (pack_bits, pack_bits_mxu):
+            back = unpack_bits(pack(xj), k)
+            np.testing.assert_array_equal(
+                np.asarray(back), x,
+                err_msg=f"{pack.__name__} shape={x.shape} k={k}",
+            )
+        # padded words decode identically (the tail bits are zero and
+        # sliced off by the k argument)
+        back_padded = unpack_bits(pack_bits(xj, pad_words_to=8), k)
+        np.testing.assert_array_equal(np.asarray(back_padded), x)
+
+
 def test_pack_bits_mxu_bit_identical():
     """The MXU (int8-matmul) pack must produce bit-identical words to the
     VPU shift-reduce pack for every K alignment, including K % 32 != 0
