@@ -288,6 +288,7 @@ def _utc_now(epoch_s: float | None = None) -> str:
 SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
     "device_resident_epoch", "train_step_per_backend", "comm",
+    "lm_serve",
 )
 
 
@@ -944,6 +945,137 @@ def _bench_serving(args, deadline):
     return out
 
 
+def _bench_lm_serve(args, deadline):
+    """Continuous-batching LM serving benchmark (--lm-serve-bench):
+    decode tokens/sec and inter-token latency percentiles at 1/4/8
+    concurrent streams through the serve/lm/ engine (paged KV cache,
+    iteration-level scheduling), with the decode GEMMs on pre-packed
+    1-bit bitplanes vs the same artifact carried as dense fp32 kernels —
+    the model-level measurement of PERF.md §3's claim that packed
+    weights win exactly the bandwidth-bound single-position regime
+    continuous decode lives in.
+
+    Weights are fresh inits (throughput is weight-value-independent);
+    the dense variant unpacks each layer's bitplanes into the 'kernel'
+    (carried-fp32) marker, so both variants run the SAME engine,
+    scheduler and cache — only the GEMM weight format differs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_mnist_bnns_tpu.infer_transformer import (
+        _freeze_lm_tensors,
+        make_paged_lm_decoder,
+    )
+    from distributed_mnist_bnns_tpu.models.transformer import BinarizedLM
+    from distributed_mnist_bnns_tpu.obs import MetricsRegistry, Telemetry
+    from distributed_mnist_bnns_tpu.ops.bitpack import unpack_bits
+    from distributed_mnist_bnns_tpu.serve.lm import LMEngine
+    from distributed_mnist_bnns_tpu.serve.lm.engine import (
+        DECODE_ITERATION_SECONDS,
+    )
+
+    interp = jax.default_backend() != "tpu"
+    ctx = args.serving_lm_ctx
+    model = BinarizedLM(
+        vocab=256, max_len=ctx, embed_dim=args.lm_embed_dim,
+        depth=args.lm_depth, num_heads=args.lm_heads, attention="xla",
+    )
+    tokens = jnp.zeros((1, ctx), jnp.int32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, tokens, train=False
+    )
+    frozen = _freeze_lm_tensors(model, variables)
+
+    def densify(fz):
+        """Packed bitplanes -> the carried-fp32 'kernel' marker: the
+        dense-weight baseline through the identical serving stack."""
+        blocks = []
+        for blk in fz["blocks"]:
+            nb = dict(blk)
+            for key in ("q", "k", "v", "out", "mlp1", "mlp2"):
+                layer = blk[key]
+                if "wp" in layer:
+                    k, n = int(layer["k"]), int(layer["n"])
+                    w = unpack_bits(jnp.asarray(layer["wp"]).T, k)[:n].T
+                    nb[key] = {"kernel": np.asarray(w),
+                               "bias": layer["bias"]}
+            blocks.append(nb)
+        out = dict(fz)
+        out["blocks"] = blocks
+        return out
+
+    variants = {"packed_1bit": frozen, "dense_fp32": densify(frozen)}
+    n_new = max(8, min(64, ctx // 4))
+    out = {
+        "ctx": ctx, "embed_dim": args.lm_embed_dim,
+        "depth": args.lm_depth, "n_new_tokens_per_stream": n_new,
+        "interpret_mode": interp,
+    }
+    for vname, fz in variants.items():
+        if time.monotonic() > deadline - 30:
+            out[vname] = "skipped (bench deadline)"
+            continue
+        rows = {}
+        for streams in (1, 4, 8):
+            if time.monotonic() > deadline:
+                break
+            reg = MetricsRegistry()
+            tel = Telemetry(None, registry=reg)
+            dec = make_paged_lm_decoder(
+                fz, slots=streams, page_size=16,
+                prefill_chunk=16, interpret=interp,
+            )
+            eng = LMEngine(dec, queue_depth=streams * 2,
+                           telemetry=tel).start()
+            try:
+                rng = np.random.RandomState(streams)
+                prompts = [
+                    rng.randint(0, 256, size=8 + 4 * i).astype(np.int32)
+                    for i in range(streams)   # staggered lengths
+                ]
+                t0 = time.perf_counter()
+                reqs = [
+                    eng.submit(p, n_new, time.monotonic() + 600)
+                    for p in prompts
+                ]
+                done = 0
+                for r in reqs:
+                    while True:
+                        ev = r.events.get(timeout=600)
+                        if ev["kind"] == "done":
+                            assert ev["status"] == "ok", ev
+                            done += ev["n"]
+                            break
+                wall = time.perf_counter() - t0
+                hist = reg.histogram(DECODE_ITERATION_SECONDS)
+                p50 = hist.percentile(50)
+                p99 = hist.percentile(99)
+                rows[f"streams_{streams}"] = {
+                    "tokens_per_sec": round(done / wall, 1),
+                    "p50_intertoken_ms": (
+                        round(p50 * 1e3, 3) if p50 is not None else None
+                    ),
+                    "p99_intertoken_ms": (
+                        round(p99 * 1e3, 3) if p99 is not None else None
+                    ),
+                    "recompiles_post_warmup": eng.recompiles_post_warmup,
+                }
+            finally:
+                eng.stop()
+        out[vname] = rows
+    pk, dn = out.get("packed_1bit"), out.get("dense_fp32")
+    if (
+        isinstance(pk, dict) and isinstance(dn, dict)
+        and "streams_8" in pk and "streams_8" in dn
+    ):
+        out["packed_speedup_8_streams"] = round(
+            pk["streams_8"]["tokens_per_sec"]
+            / dn["streams_8"]["tokens_per_sec"], 2,
+        )
+    return out
+
+
 def main() -> None:
     # Persist compiled executables across processes/windows: a cold
     # remote compile of the train step can eat a whole short hardware
@@ -1003,6 +1135,12 @@ def main() -> None:
                    help="also bench end-to-end frozen-model serving: "
                         "packed img/s at batch 1/8/64 vs live eval, "
                         "KV-decode tokens/s, artifact cold-start latency")
+    p.add_argument("--lm-serve-bench", action="store_true",
+                   help="also bench continuous-batching LM serving "
+                        "(serve/lm/): decode tokens/sec + p99 "
+                        "inter-token latency at 1/4/8 concurrent "
+                        "streams, packed-bitplane vs dense decode "
+                        "weights")
     p.add_argument("--comm-bench", action="store_true",
                    help="also bench the DP gradient exchange: fp32 psum "
                         "vs 1-bit sign/sign_ef compression (wire "
@@ -1391,6 +1529,13 @@ def main() -> None:
             result["serving"] = _bench_serving(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["serving"] = f"failed: {e!r:.300}"
+
+    if args.lm_serve_bench and time.monotonic() < deadline - 60:
+        try:
+            _progress("lm_serve: continuous-batching decode section")
+            result["lm_serve"] = _bench_lm_serve(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["lm_serve"] = f"failed: {e!r:.300}"
 
     if args.comm_bench and time.monotonic() < deadline - 60:
         try:

@@ -228,6 +228,33 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sv.add_argument("--artifact", required=True,
                     help="path to an export-ed packed .msgpack artifact")
+    sv.add_argument("--lm", action="store_true",
+                    help="serve a packed causal-LM artifact (from "
+                         "`lm --export`) through the continuous-batching "
+                         "generation engine instead of the classifier "
+                         "micro-batcher: paged KV cache, iteration-level "
+                         "scheduling, streaming POST /generate "
+                         "(SERVING.md 'Continuous LM serving')")
+    sv.add_argument("--slots", type=int, default=4,
+                    help="--lm: decode batch width — the ONE compiled "
+                         "decode signature; streams join/leave slots at "
+                         "any iteration")
+    sv.add_argument("--page-size", type=int, default=16,
+                    help="--lm: tokens per KV page")
+    sv.add_argument("--num-pages", type=int, default=None,
+                    help="--lm: KV pool pages (default: every slot can "
+                         "reach max_len simultaneously, + the null page)")
+    sv.add_argument("--prefill-chunk", type=int, default=16,
+                    help="--lm: prompt positions per prefill dispatch")
+    sv.add_argument("--max-len", type=int, default=None,
+                    help="--lm: cap sequences below the artifact's "
+                         "trained window (smaller pages/pools)")
+    sv.add_argument("--max-new-tokens", type=int, default=64,
+                    help="--lm: default generation length when the "
+                         "request doesn't set max_new_tokens")
+    sv.add_argument("--max-prompt-tokens", type=int, default=None,
+                    help="--lm: reject longer prompts with 413 "
+                         "(default: max_len - 1)")
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument("--port", type=int, default=8000,
                     help="0 = pick an ephemeral port (logged)")
@@ -239,11 +266,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="admission bound: requests past it are shed "
                          "with an immediate 503 (reject-new over "
                          "collapse)")
-    sv.add_argument("--deadline-ms", type=float, default=1000.0,
+    sv.add_argument("--deadline-ms", type=float, default=None,
                     help="default per-request deadline (clients may "
                          "send their own deadline_ms); queued work "
                          "past its deadline is cancelled, never "
-                         "computed")
+                         "computed. Default: 1000 for the classifier "
+                         "server, 30000 for --lm (a stream spans many "
+                         "decode iterations)")
     sv.add_argument("--linger-ms", type=float, default=2.0,
                     help="micro-batch coalescing window")
     sv.add_argument("--stall-timeout-s", type=float, default=1.0,
@@ -533,7 +562,7 @@ def main(argv=None) -> int:
             import jax.numpy as _jnp
             from flax import serialization
 
-            from .infer_transformer import generate
+            from .infer_transformer import generate, make_lm_decoder
 
             with open(args.load, "rb") as f:
                 frozen = serialization.msgpack_restore(f.read())
@@ -569,9 +598,14 @@ def main(argv=None) -> int:
                 _jax.default_backend() != "tpu"
                 if args.interpret is None else args.interpret
             )
+            # Build the decoder explicitly (one-decoder-per-artifact
+            # rule): generate(decoder=None) would log the rebuild
+            # warning and count toward lm_decoder_rebuilds_total, a
+            # signal reserved for accidental hot-path rebuilds.
             toks = generate(
                 frozen, prompt, n, temperature=args.temperature,
                 rng=_jax.random.PRNGKey(args.seed), interpret=interpret,
+                decoder=make_lm_decoder(frozen, interpret=interpret),
             )
             out = [int(t) for t in toks[0, prompt.shape[1]:]]
             if vocab == 256:  # byte-level: show as text
@@ -603,6 +637,33 @@ def main(argv=None) -> int:
                 "could not re-pin jax platform to %r (backend already "
                 "initialized)", repin_failed,
             )
+        if args.lm:
+            from .serve.lm import LMServeConfig, LMServer
+
+            lm_server = LMServer(LMServeConfig(
+                artifact=args.artifact,
+                host=args.host,
+                port=args.port,
+                slots=args.slots,
+                page_size=args.page_size,
+                num_pages=args.num_pages,
+                prefill_chunk=args.prefill_chunk,
+                max_len=args.max_len,
+                queue_depth=args.queue_depth,
+                default_deadline_ms=(
+                    30000.0 if args.deadline_ms is None
+                    else args.deadline_ms
+                ),
+                default_max_new_tokens=args.max_new_tokens,
+                max_prompt_tokens=args.max_prompt_tokens,
+                drain_timeout_s=args.drain_timeout_s,
+                telemetry_dir=args.telemetry_dir,
+                chaos=args.chaos,
+                seed=args.seed,
+                interpret=args.interpret,
+            ))
+            return lm_server.run()
+
         from .serve import PackedInferenceServer, ServeConfig
 
         server = PackedInferenceServer(ServeConfig(
@@ -611,7 +672,9 @@ def main(argv=None) -> int:
             port=args.port,
             batch_size=args.batch_size,
             queue_depth=args.queue_depth,
-            default_deadline_ms=args.deadline_ms,
+            default_deadline_ms=(
+                1000.0 if args.deadline_ms is None else args.deadline_ms
+            ),
             linger_ms=args.linger_ms,
             stall_timeout_s=args.stall_timeout_s,
             breaker_threshold=args.breaker_threshold,
@@ -845,7 +908,10 @@ def main(argv=None) -> int:
             args.out,
             input_shape=data.input_shape,
         )
-        trainer.telemetry.emit("export", out=args.out, **info)
+        # info nests under its own field: transformer artifacts carry a
+        # "kind" key that would collide with the event envelope's kind
+        # (same convention as the serve/ reload event).
+        trainer.telemetry.emit("export", out=args.out, info=dict(info))
         trainer.telemetry.close()
         log.info("exported packed model to %s: %s", args.out, info)
         print({"out": args.out, **info})

@@ -32,12 +32,16 @@ them in fp32 — equality tests pin backend="xla".
 
 from __future__ import annotations
 
+import logging
 import time
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
+
+log = logging.getLogger(__name__)
 
 from .models.transformer import (
     BinarizedLM,
@@ -410,6 +414,11 @@ def freeze_bnn_lm(
 # KV-cache incremental decoding — the packed LM's serving loop
 # ---------------------------------------------------------------------------
 
+# Prompt positions per prefill dispatch (generate() and the serve/lm/
+# engine default). One compiled (B, C) prefill program serves any prompt
+# length: full chunks dispatch through it, the tail goes token-at-a-time.
+PREFILL_CHUNK = 16
+
 
 def _block_decode_fn(blk: Dict[str, Any], num_heads: int,
                      interpret: bool) -> Callable:
@@ -440,6 +449,45 @@ def _block_decode_fn(blk: Dict[str, Any], num_heads: int,
         probs = jax.nn.softmax(scores, axis=-1)
         core = jnp.einsum("bhl,blhd->bhd", probs, vc)
         x = x + out_fn(core.reshape(b, e))
+        y = ln_mlp(x)
+        y = nn.hard_tanh(mlp1(y))
+        return x + mlp2(y), kc, vc
+
+    return fn
+
+
+def _block_chunk_fn(blk: Dict[str, Any], num_heads: int, cache_len: int,
+                    interpret: bool) -> Callable:
+    """One block's chunked-prefill step against a (B, L, H, D) KV cache:
+    ``fn(x (B, C, E), kc, vc, start) -> (x, kc, vc)`` — C prompt
+    positions written at [start, start+C) in one dispatch, attending
+    causally (key pos <= query pos) over the whole cache strip. The
+    per-position K/V values are identical to C single-position
+    ``_block_decode_fn`` steps (projections are per-token), so chunked
+    and token-at-a-time prefill build bitwise-identical caches."""
+    lay = _block_layers(blk, interpret)
+    ln_attn, ln_mlp = lay["ln_attn"], lay["ln_mlp"]
+    q_fn, k_fn, v_fn, out_fn = lay["q"], lay["k"], lay["v"], lay["out"]
+    mlp1, mlp2 = lay["mlp1"], lay["mlp2"]
+
+    def fn(x, kc, vc, start):
+        b, c, e = x.shape
+        h = num_heads
+        d = e // h
+        y = ln_attn(x)
+        q = q_fn(y).reshape(b, c, h, d)
+        k = k_fn(y).reshape(b, c, h, d)
+        v = v_fn(y).reshape(b, c, h, d)
+        kc = jax.lax.dynamic_update_slice(kc, k, (0, start, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (0, start, 0, 0))
+        scale = d ** -0.5
+        scores = jnp.einsum("bchd,blhd->bchl", q, kc) * scale
+        qpos = start + jnp.arange(c)
+        mask = jnp.arange(cache_len)[None, :] <= qpos[:, None]  # (C, L)
+        scores = jnp.where(mask[None, :, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        core = jnp.einsum("bchl,blhd->bchd", probs, vc)
+        x = x + out_fn(core.reshape(b, c, e))
         y = ln_mlp(x)
         y = nn.hard_tanh(mlp1(y))
         return x + mlp2(y), kc, vc
@@ -509,22 +557,48 @@ def make_lm_decoder(
     jitted = jax.jit(_step)
 
     def step(caches, tokens, pos):
-        # Host-side bounds check: under jit, an out-of-range pos would
-        # silently clamp both the cache write and the pos-embed lookup
-        # (XLA dynamic_update_slice semantics) and return finite-but-
-        # wrong log-probs; the serving loop drives pos from the host, so
-        # fail loudly here like the full-window path does.
-        if int(pos) >= cache_len:
+        # Host-int bounds check ONLY (a plain integer compare): under
+        # jit, an out-of-range pos would silently clamp both the cache
+        # write and the pos-embed lookup (XLA dynamic_update_slice
+        # semantics) and return finite-but-wrong log-probs. The old
+        # ``int(pos)`` guard forced a device->host sync per token when
+        # pos arrived as a device scalar — the decode hot loop must stay
+        # trace-pure, so device/traced positions skip the check and are
+        # the caller's contract: validate total length upfront at
+        # init/prefill time (generate() does; the paged engine sizes
+        # page tables at admission).
+        if isinstance(pos, (int, np.integer)) and pos >= cache_len:
             raise ValueError(
                 f"decode position {int(pos)} >= cache length {cache_len}"
             )
         return jitted(caches, tokens, pos)
+
+    # -- chunked prefill: C prompt positions per dispatch ---------------
+    chunk_blocks = [
+        _block_chunk_fn(blk, num_heads, cache_len, interpret)
+        for blk in frozen["blocks"]
+    ]
+
+    def _prefill(caches, tokens, start):
+        """(B, C) prompt chunk written at [start, start+C) — caller
+        guarantees start + C <= cache_len (generate() only dispatches
+        full chunks), so the dynamic_update_slice never clamps."""
+        c = tokens.shape[1]
+        qpos = start + jnp.arange(c)
+        x = tok[tokens] + pos_embed[0][jnp.clip(qpos, 0, pos_len - 1)]
+        new = []
+        for blk, (kc, vc) in zip(chunk_blocks, caches):
+            x, kc, vc = blk(x, kc, vc, start)
+            new.append((kc, vc))
+        x = ln_head(x)
+        return tuple(new), nn.log_softmax(x @ head_w + head_b)
 
     # Expose the cache length so callers holding only the (init, step)
     # pair — e.g. generate(decoder=...) — can validate total sequence
     # length upfront instead of failing mid-decode after paid prefill.
     init_caches.cache_len = cache_len
     step.cache_len = cache_len
+    step.prefill = jax.jit(_prefill)
     return init_caches, step
 
 
@@ -563,6 +637,27 @@ def generate(
             f"prompt {prompt.shape[1]} + n_tokens {n_tokens} = {total} "
             f"exceeds the artifact's trained max_len {cache_len}"
         )
+    if decoder is None:
+        # Rebuilding the decoder means fresh jitted closures and a full
+        # XLA re-compile PER CALL — fine for a one-shot CLI sample,
+        # a serving disaster (compile time dwarfs single-position decode
+        # cost). The one-decoder-per-artifact rule (SERVING.md): build
+        # ``make_lm_decoder(frozen)`` once and pass it as ``decoder=``.
+        # The serve/lm/ engine never takes this path; the counter + log
+        # make any accidental hot-path rebuild visible in telemetry.
+        from .obs import default_registry as _default_registry
+
+        _default_registry().counter(
+            "lm_decoder_rebuilds_total",
+            "generate() calls that rebuilt the jitted LM decoder "
+            "(pass decoder=make_lm_decoder(frozen) on hot paths)",
+        ).inc()
+        log.warning(
+            "generate() called without a prebuilt decoder: rebuilding "
+            "jitted closures (full XLA re-compile). Serving loops must "
+            "build make_lm_decoder(frozen) once per artifact and pass "
+            "decoder= (SERVING.md, one-decoder-per-artifact rule)."
+        )
     init, step = decoder or make_lm_decoder(frozen, interpret=interpret)
     # A caller-supplied decoder may have been built with max_len < the
     # artifact's trained length; validate against its actual cache before
@@ -588,8 +683,24 @@ def generate(
         "lm_prefill_tokens_total", "prompt tokens fed through prefill"
     ).inc(int(prompt.shape[0]) * int(prompt.shape[1]))
 
+    # Chunked prefill: feed the prompt in fixed-width (B, C) chunks —
+    # one dispatch per C positions instead of C single-position steps —
+    # falling back to token-at-a-time for the sub-chunk tail (and for
+    # caller-supplied decoders built before prefill existed). Cache
+    # contents are bitwise-identical either way (_block_chunk_fn).
     lp = None
-    for t in range(prompt.shape[1]):           # prefill
+    prefill = getattr(step, "prefill", None)
+    chunk = PREFILL_CHUNK
+    t = 0
+    if prefill is not None:
+        n_prompt = prompt.shape[1]
+        while t + chunk <= n_prompt:
+            caches, clp = prefill(
+                caches, prompt[:, t:t + chunk], jnp.int32(t)
+            )
+            lp = clp[:, -1]
+            t += chunk
+    for t in range(t, prompt.shape[1]):        # sub-chunk tail
         caches, lp = step(caches, prompt[:, t], t)
     out = [prompt]
     if n_tokens > 0 and lp is not None:
@@ -619,3 +730,175 @@ def generate(
             "KV-cache decode wall time per emitted token",
         ).observe((time.perf_counter() - _t0) / n_tokens)
     return result
+
+
+# ---------------------------------------------------------------------------
+# Paged KV-cache decoding — continuous batching (serve/lm/)
+# ---------------------------------------------------------------------------
+
+
+class PagedLMDecoder(NamedTuple):
+    """The compiled pair behind the continuous-batching engine
+    (SERVING.md "Continuous LM serving") plus its fixed geometry.
+
+    Exactly TWO programs exist after warmup, and every dynamic quantity
+    (tokens, page tables, positions, chunk start/length) is an array
+    argument, so the engine admits/evicts sequences at any iteration
+    with zero recompiles:
+
+      * ``prefill(pools, tokens (C,), page_table (P,), start, length)``
+        -> ``(pools, log_probs (C, vocab))`` — one sequence's prompt
+        chunk: K/V written through the page table (padding positions
+        >= ``length`` are redirected to the null page), causal
+        attention over the table, per-position next-token log-probs.
+      * ``decode(pools, tokens (S,), page_tables (S, P), positions
+        (S,))`` -> ``(pools, log_probs (S, vocab))`` — one iteration
+        for all S batch slots at once; inactive slots carry all-null
+        tables and are garbage-out/ignored.
+
+    Both are jitted with the pools donated (``donate``): the KV pool is
+    the engine's dominant buffer and must be updated in place, not
+    copied per token. Callers therefore must NOT reuse a pools value
+    after passing it in — hold only the returned pools.
+    """
+
+    init_pools: Callable
+    prefill: Callable
+    decode: Callable
+    slots: int
+    page_size: int
+    num_pages: int
+    max_pages: int          # page-table width (pages per sequence)
+    max_len: int            # longest sequence (prompt + generated)
+    prefill_chunk: int
+    vocab: int
+    num_blocks: int
+
+
+def make_paged_lm_decoder(
+    frozen: Dict[str, Any], *,
+    slots: int,
+    page_size: int = 16,
+    num_pages: int | None = None,
+    prefill_chunk: int = PREFILL_CHUNK,
+    max_len: int | None = None,
+    interpret: bool = False,
+    donate: bool = True,
+) -> PagedLMDecoder:
+    """Build the paged prefill/decode pair from a ``kind == "lm"``
+    artifact (see :class:`PagedLMDecoder`). ``num_pages`` defaults to
+    enough for every slot to reach ``max_len`` simultaneously, plus the
+    reserved null page — callers running oversubscribed (more admitted
+    work than worst-case pages) size it down and rely on the engine's
+    admission control."""
+    from .ops import paged_kv
+
+    if frozen.get("kind") != "lm":
+        raise ValueError(
+            f"make_paged_lm_decoder needs a kind='lm' artifact, got "
+            f"{frozen.get('kind')!r}"
+        )
+    num_heads = int(frozen["num_heads"])
+    tok = jnp.asarray(frozen["tok_embed"], jnp.float32)
+    pos_embed = jnp.asarray(frozen["pos_embed"], jnp.float32)
+    ln_head = _ln_fn(frozen["ln_head"])
+    head_w = jnp.asarray(frozen["head_w"], jnp.float32)
+    head_b = jnp.asarray(frozen["head_b"], jnp.float32)
+    layers = [_block_layers(blk, interpret) for blk in frozen["blocks"]]
+    embed_dim = int(tok.shape[1])
+    head_dim = embed_dim // num_heads
+    pos_len = int(pos_embed.shape[1])
+    max_len = pos_len if max_len is None else int(max_len)
+    if not 1 <= max_len <= pos_len:
+        raise ValueError(
+            f"max_len {max_len} outside [1, trained pos_embed length "
+            f"{pos_len}]"
+        )
+    slots = int(slots)
+    if slots < 1:
+        raise ValueError(f"need >= 1 batch slot, got {slots}")
+    page_size = int(page_size)
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    prefill_chunk = int(prefill_chunk)
+    if prefill_chunk < 1:
+        raise ValueError(
+            f"prefill_chunk must be >= 1, got {prefill_chunk}"
+        )
+    max_pages = paged_kv.pages_needed(max_len, page_size)
+    if num_pages is None:
+        num_pages = slots * max_pages + 1        # +1: the null page
+    num_pages = int(num_pages)
+    n_blocks = len(layers)
+
+    def init_pools():
+        return paged_kv.init_pools(
+            n_blocks, num_pages, page_size, num_heads, head_dim
+        )
+
+    def _mlp(lay, x):
+        return x + lay["mlp2"](nn.hard_tanh(lay["mlp1"](lay["ln_mlp"](x))))
+
+    def _head(x):
+        return nn.log_softmax(ln_head(x) @ head_w + head_b)
+
+    def _prefill(pools, tokens, page_table, start, length):
+        c = tokens.shape[0]
+        gpos = start + jnp.arange(c)
+        valid = gpos < length
+        x = tok[tokens] + pos_embed[0][jnp.clip(gpos, 0, pos_len - 1)]
+        idx = paged_kv.flat_write_indices(
+            page_table, gpos, page_size, valid=valid
+        )
+        new = []
+        for lay, (kp, vp) in zip(layers, pools):
+            y = lay["ln_attn"](x)
+            q = lay["q"](y).reshape(c, num_heads, head_dim)
+            k = lay["k"](y).reshape(c, num_heads, head_dim)
+            v = lay["v"](y).reshape(c, num_heads, head_dim)
+            kp = paged_kv.write_kv(kp, idx, k)
+            vp = paged_kv.write_kv(vp, idx, v)
+            core = paged_kv.paged_prefill_attention(
+                q, kp, vp, page_table, gpos
+            )
+            x = x + lay["out"](core.reshape(c, embed_dim))
+            x = _mlp(lay, x)
+            new.append((kp, vp))
+        return tuple(new), _head(x)
+
+    def _decode(pools, tokens, page_tables, positions):
+        s = tokens.shape[0]
+        x = tok[tokens] + pos_embed[0][jnp.clip(positions, 0, pos_len - 1)]
+        idx = paged_kv.flat_write_indices(
+            page_tables, positions, page_size
+        )
+        new = []
+        for lay, (kp, vp) in zip(layers, pools):
+            y = lay["ln_attn"](x)
+            q = lay["q"](y).reshape(s, num_heads, head_dim)
+            k = lay["k"](y).reshape(s, num_heads, head_dim)
+            v = lay["v"](y).reshape(s, num_heads, head_dim)
+            kp = paged_kv.write_kv(kp, idx, k)
+            vp = paged_kv.write_kv(vp, idx, v)
+            core = paged_kv.paged_attention(
+                q, kp, vp, page_tables, positions
+            )
+            x = x + lay["out"](core.reshape(s, embed_dim))
+            x = _mlp(lay, x)
+            new.append((kp, vp))
+        return tuple(new), _head(x)
+
+    donate_kw = {"donate_argnums": (0,)} if donate else {}
+    return PagedLMDecoder(
+        init_pools=init_pools,
+        prefill=jax.jit(_prefill, **donate_kw),
+        decode=jax.jit(_decode, **donate_kw),
+        slots=slots,
+        page_size=page_size,
+        num_pages=num_pages,
+        max_pages=max_pages,
+        max_len=max_len,
+        prefill_chunk=prefill_chunk,
+        vocab=int(tok.shape[0]),
+        num_blocks=n_blocks,
+    )

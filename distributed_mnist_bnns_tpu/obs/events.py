@@ -33,6 +33,14 @@ Event kinds (schema v1):
   breaker_close  it closed again after successful half-open probes
   drain          SIGTERM graceful drain completed (flush stats, serve/)
   reload         hot artifact swap on the running server (serve/)
+  export         cli export wrote a packed artifact (path, size info)
+  lm_admit       a generation request took a batch slot (serve/lm/ —
+                 prompt/pages/prefill stats, the iteration it joined at)
+  lm_evict       a generation request left its slot or died queued
+                 (status, tokens emitted, pages freed)
+  lm_decode      periodic decode-iteration snapshot (active streams,
+                 iteration latency, page occupancy, recompile count)
+  lm_decode_error a decode dispatch failed and was retried (serve/lm/)
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's
@@ -119,16 +127,18 @@ class EventLog:
 
     ``emit`` is a no-op on non-primary hosts (see module docstring), so
     call sites need no rank guards. Flush policy: the high-rate kinds —
-    ``step`` (one per hot-loop dispatch) and ``request`` (one per
-    served request, written from the serving engine's single worker
-    thread) — are buffered (a flushed syscall per record would
-    serialize file I/O against the hot path) and flushed every
-    ``flush_every`` records; every other kind — manifest, epoch, error,
-    shed, breaker transitions, drain, run_end — flushes immediately, so
-    a crashed run loses at most the last few high-rate lines, never the
-    milestone records."""
+    ``step`` (one per hot-loop dispatch), ``request`` (one per served
+    request, written from the serving engine's single worker thread)
+    and ``lm_admit``/``lm_evict`` (one per generation stream, written
+    from the LM scheduler thread between decode iterations) — are
+    buffered (a flushed syscall per record would serialize file I/O
+    against the hot path) and flushed every ``flush_every`` records;
+    every other kind — manifest, epoch, error, shed, breaker
+    transitions, drain, run_end — flushes immediately, so a crashed run
+    loses at most the last few high-rate lines, never the milestone
+    records."""
 
-    BUFFERED_KINDS = ("step", "request")
+    BUFFERED_KINDS = ("step", "request", "lm_admit", "lm_evict")
 
     def __init__(
         self, path: str, *, primary_only: bool = True,

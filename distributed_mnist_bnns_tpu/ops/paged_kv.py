@@ -1,0 +1,247 @@
+"""Block-paged KV cache — the allocator + device primitives behind the
+continuous-batching LM engine (serve/lm/, SERVING.md "Continuous LM
+serving").
+
+The contiguous per-sequence (B, L, H, D) cache of
+``infer_transformer.make_lm_decoder`` wastes a full max-length strip per
+batch slot and forces every sequence in a batch to share one lifetime.
+PagedAttention (vLLM, SOSP '23) replaces the strip with fixed-size
+**pages** drawn from one shared pool: a sequence holds a *page table*
+(list of page ids covering its positions so far), pages are allocated as
+the sequence grows and returned to the free list the moment it finishes,
+and the decode step addresses the cache through the table — so requests
+can join and leave the decode batch at any iteration while the jitted
+step only ever sees ONE signature (fixed slot count, fixed table shape).
+
+Layout and conventions:
+
+  * a K (or V) pool is ``(num_pages, page_size, H, D)`` fp32; logical
+    position ``p`` of a sequence lives at page ``table[p // page_size]``,
+    offset ``p % page_size``;
+  * **page 0 is the null page** — never allocated, it absorbs the writes
+    of inactive batch slots and of padding positions (their flat index
+    is forced into page 0), so a fixed-shape scatter needs no masking
+    branches. Null-page contents are garbage by design and are always
+    masked out of attention (positions > the slot's length get -inf
+    before the softmax; exp(-inf) = 0 exactly);
+  * page tables are host-side int32 arrays shaped ``(max_pages,)`` per
+    sequence, 0-filled beyond the allocated prefix — the device never
+    sees a ragged structure.
+
+The allocator is deliberately host-side and trivial (a free list under a
+lock): allocation happens at admission/grow time on the scheduler
+thread, never inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NULL_PAGE = 0
+
+
+def pages_needed(n_tokens: int, page_size: int) -> int:
+    """Pages required to hold ``n_tokens`` positions."""
+    if n_tokens <= 0:
+        return 0
+    return -(-int(n_tokens) // int(page_size))
+
+
+class PageAllocator:
+    """Free-list allocator over ``num_pages`` pages; page 0 reserved.
+
+    ``alloc`` is all-or-nothing: a request that cannot get every page it
+    asked for gets none (the caller re-queues instead of holding a
+    partial reservation that could deadlock admission). Thread-safe —
+    the HTTP handlers query occupancy while the scheduler allocates.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (page 0 is the reserved null page), "
+                f"got {num_pages}"
+            )
+        self.num_pages = int(num_pages)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (excludes the null page)."""
+        return self.num_pages - 1
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def used_count(self) -> int:
+        return self.capacity - self.free_count()
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages currently held, in [0, 1]."""
+        return self.used_count() / max(self.capacity, 1)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` page ids, or None if fewer than ``n`` are free."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Return pages to the free list. Double-free and null-page
+        frees are hard errors — both mean the caller's page-lifetime
+        bookkeeping is corrupt, and silently absorbing them would let
+        two sequences share a page."""
+        with self._lock:
+            held = set(self._free)
+            for p in pages:
+                p = int(p)
+                if p == NULL_PAGE or not 0 < p < self.num_pages:
+                    raise ValueError(f"cannot free page {p}")
+                if p in held:
+                    raise ValueError(f"double free of page {p}")
+                held.add(p)
+                self._free.append(p)
+
+
+def init_pools(
+    num_blocks: int, num_pages: int, page_size: int,
+    num_heads: int, head_dim: int,
+) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]:
+    """Zeroed per-block (K, V) page pools:
+    ``((k0, v0), (k1, v1), ...)``, each ``(num_pages, page_size, H, D)``
+    fp32 — the whole KV memory of the engine, shared by every sequence
+    through page tables."""
+    shape = (int(num_pages), int(page_size), int(num_heads), int(head_dim))
+    # Distinct buffers per pool — the decode/prefill programs donate the
+    # whole pools pytree, and XLA rejects donating one buffer twice.
+    return tuple(
+        (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+        for _ in range(int(num_blocks))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device-side primitives (trace-pure: no host syncs, fixed shapes)
+# ---------------------------------------------------------------------------
+
+
+def flat_write_indices(
+    page_table: jnp.ndarray, positions: jnp.ndarray,
+    page_size: int, valid: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Flat row indices into a ``(num_pages * page_size, ...)`` pool view
+    for writing ``positions`` of the sequence(s) described by
+    ``page_table``.
+
+    Shapes: ``page_table`` (..., P) int32 with leading dims matching
+    ``positions`` (...,) int32 — or a single shared ``(P,)`` table for a
+    batch of positions (the chunked-prefill case). Positions flagged
+    invalid (or whose page index would overrun the table) are redirected
+    into the null page — index arithmetic stays branch-free and
+    in-bounds, matching XLA's clamping gather/scatter semantics without
+    relying on them.
+    """
+    ps = int(page_size)
+    max_pages = page_table.shape[-1]
+    page_idx = jnp.clip(positions // ps, 0, max_pages - 1)
+    if page_table.ndim == 1:
+        page = page_table[page_idx]
+    else:
+        page = jnp.take_along_axis(
+            page_table, page_idx[..., None], axis=-1
+        )[..., 0]
+    in_table = positions // ps < max_pages
+    ok = in_table if valid is None else (valid & in_table)
+    page = jnp.where(ok, page, NULL_PAGE)
+    return page * ps + positions % ps
+
+
+def write_kv(
+    pool: jnp.ndarray, flat_idx: jnp.ndarray, rows: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter ``rows`` (..., H, D) into the pool at ``flat_idx`` rows of
+    its flattened ``(num_pages * page_size, H, D)`` view. Duplicate
+    indices only ever occur inside the null page (invalid positions all
+    map there), where last-writer-wins is fine."""
+    n, ps, h, d = pool.shape
+    flat = pool.reshape(n * ps, h, d)
+    flat = flat.at[flat_idx.reshape(-1)].set(
+        rows.reshape(-1, h, d), mode="drop"
+    )
+    return flat.reshape(n, ps, h, d)
+
+
+def gather_kv(pool: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """Materialize the logical cache strip(s) a page table describes:
+    ``page_table`` (..., P) over a ``(num_pages, page_size, H, D)`` pool
+    -> (..., P * page_size, H, D), where gathered row ``l`` is logical
+    position ``l`` (tables list pages in sequence order). Rows drawn
+    through null-page entries are garbage and MUST be masked downstream
+    (``paged_attention`` does)."""
+    n, ps, h, d = pool.shape
+    gathered = pool[page_table]                    # (..., P, ps, H, D)
+    return gathered.reshape(*page_table.shape[:-1],
+                            page_table.shape[-1] * ps, h, d)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_tables: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Single-position attention through page tables.
+
+    ``q`` (S, H, D) — one query per batch slot; ``page_tables`` (S, P);
+    ``positions`` (S,) — the position being decoded (its K/V must
+    already be written). Keys at logical positions > ``positions[s]``
+    (unwritten tail, null-page garbage, other-sequence leftovers in
+    freed-and-reused pages) are masked to -inf before the softmax, so
+    the result equals contiguous-cache attention over the slot's real
+    prefix exactly.
+    """
+    kc = gather_kv(k_pool, page_tables)            # (S, L, H, D)
+    vc = gather_kv(v_pool, page_tables)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("shd,slhd->shl", q, kc) * scale
+    l = kc.shape[1]
+    mask = jnp.arange(l)[None, :] <= positions[:, None]
+    scores = jnp.where(mask[:, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("shl,slhd->shd", probs, vc)
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    q_positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Chunked-prefill attention for ONE sequence: ``q`` (C, H, D)
+    queries at global positions ``q_positions`` (C,), attending causally
+    (key position <= query position) through the sequence's page table.
+    The chunk's own K/V must be written before the call; padding queries
+    (positions >= the real length) produce garbage rows the caller
+    ignores — their mask row is non-empty so no NaN escapes."""
+    kc = gather_kv(k_pool, page_table)             # (L, H, D)
+    vc = gather_kv(v_pool, page_table)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("chd,lhd->chl", q, kc) * scale
+    l = kc.shape[0]
+    mask = jnp.arange(l)[None, :] <= q_positions[:, None]
+    scores = jnp.where(mask[:, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("chl,lhd->chd", probs, vc)

@@ -11,6 +11,10 @@ Tail-at-Scale failure modes engineered in, not hoped away:
              /admin/reload), hot artifact swap, and the SIGTERM
              graceful drain (stop admitting → flush → exit 0)
   client.py  tiny urllib client used by tests and the CI smoke
+  lm/        continuous-batching LM serving: iteration-level scheduler
+             over a paged KV cache, streaming `/generate` endpoint
+             (``cli serve --lm``; import ``serve.lm`` explicitly — it
+             pulls the jax-heavy decoder, this package root stays light)
 
 The circuit breaker lives in ``resilience.policy.CircuitBreaker`` (so
 training restart loops can reuse it); serving chaos (``infer_slow`` /

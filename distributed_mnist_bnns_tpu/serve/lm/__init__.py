@@ -1,0 +1,28 @@
+"""serve.lm — continuous-batching LM serving (SERVING.md "Continuous
+LM serving").
+
+The generation counterpart of the packed classifier server: requests
+join and leave ONE compiled decode batch at any iteration (Orca-style
+iteration-level scheduling), KV memory is block-paged and freed the
+moment a stream ends (PagedAttention-style page tables,
+ops/paged_kv.py), tokens stream to clients incrementally over chunked
+HTTP, and decode GEMMs run on the artifact's pre-packed 1-bit
+bitplanes — the bandwidth-bound regime the packed kernel wins
+(PERF.md §3).
+
+  engine.py   LMEngine: bounded admission, iteration-level scheduler,
+              chunked prefill at admission, page lifecycle, deadlines,
+              recompile fence armed at budget 0
+  server.py   LMServer: POST /generate (ndjson over chunked HTTP),
+              /healthz, /metrics, SIGTERM graceful drain
+  client.py   stdlib streaming client (tests + CI smoke)
+
+The compiled prefill/decode pair itself lives in
+``infer_transformer.make_paged_lm_decoder``; the page primitives in
+``ops.paged_kv``.
+"""
+
+from .engine import LMEngine, LMRequest
+from .server import LMServeConfig, LMServer
+
+__all__ = ["LMEngine", "LMRequest", "LMServeConfig", "LMServer"]

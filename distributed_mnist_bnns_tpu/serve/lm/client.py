@@ -1,0 +1,99 @@
+"""Minimal stdlib client for the streaming LM server.
+
+Shared by tests/test_lm_serve.py and scripts/lm_serve_smoke.py so both
+speak the exact ndjson-over-chunked-HTTP protocol the server implements.
+``http.client`` decodes chunked transfer encoding transparently, so
+``readline()`` on the response yields one JSON object per emitted token
+as it arrives — the incremental-streaming property the smoke asserts on
+(token timestamps spread over the generation, not one burst at close).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+
+def _post(url: str, body: Dict[str, Any], timeout: float):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def open_stream(
+    base_url: str, prompt: Any, *,
+    max_new_tokens: Optional[int] = None,
+    deadline_ms: Optional[float] = None,
+    temperature: Optional[float] = None,
+    seed: Optional[int] = None,
+    timeout: float = 60.0,
+) -> Tuple[int, Any]:
+    """Start a generation. Returns ``(200, response)`` — read the live
+    stream with :func:`iter_lines` — or ``(code, parsed_error_body)``
+    for sheds/4xx/5xx."""
+    body: Dict[str, Any] = (
+        {"text": prompt} if isinstance(prompt, str)
+        else {"prompt": list(prompt)}
+    )
+    if max_new_tokens is not None:
+        body["max_new_tokens"] = max_new_tokens
+    if deadline_ms is not None:
+        body["deadline_ms"] = deadline_ms
+    if temperature is not None:
+        body["temperature"] = temperature
+    if seed is not None:
+        body["seed"] = seed
+    try:
+        resp = _post(base_url + "/generate", body, timeout)
+        return resp.status, resp
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            parsed = json.loads(raw)
+        except (ValueError, json.JSONDecodeError):
+            parsed = {"error": raw.decode("utf-8", "replace")}
+        return e.code, parsed
+
+
+def iter_lines(resp) -> Iterator[Dict[str, Any]]:
+    """Yield each ndjson event of a 200 stream as it arrives."""
+    with resp:
+        for line in resp:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def generate(
+    base_url: str, prompt: Any, **kw: Any
+) -> Tuple[int, List[Dict[str, Any]]]:
+    """Collect a whole generation. ``(200, [token events..., done])`` or
+    ``(code, [error body])``."""
+    code, resp = open_stream(base_url, prompt, **kw)
+    if code != 200:
+        return code, [resp]
+    return code, list(iter_lines(resp))
+
+
+def healthz(base_url: str, timeout: float = 10.0) -> Tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(
+            base_url + "/healthz", timeout=timeout
+        ) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def metrics(base_url: str, timeout: float = 10.0) -> Tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(
+            base_url + "/metrics", timeout=timeout
+        ) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()

@@ -1,0 +1,796 @@
+"""Continuous-batching LM engine — iteration-level scheduling over a
+paged KV cache (SERVING.md "Continuous LM serving").
+
+The classifier engine (serve/core.py) batches whole requests: admit,
+compute once, resolve. Generation is different — a request is a
+*sequence* of decode iterations, and naive request-level batching leaves
+slots idle while the longest sequence finishes. This engine schedules at
+iteration granularity (Orca, OSDI '22): every decode step runs ALL
+active batch slots through ONE jitted program, and between steps the
+scheduler admits queued requests into freed slots, so sequences join and
+leave the batch mid-generation with **zero post-warmup recompiles** —
+every dynamic quantity (tokens, positions, page tables) is an array
+argument of the single compiled decode signature.
+
+KV memory is block-paged (ops/paged_kv.py, PagedAttention-style): a
+request's cache lives in fixed-size pages allocated at admission and
+returned to the free list the moment the request finishes, errors,
+cancels or blows its deadline — page lifetime is request lifetime, not
+slot lifetime, so a 504 frees its memory immediately.
+
+Admission mirrors serve/core.py's Tail-at-Scale discipline: a bounded
+queue (shed ``queue_full`` past it), per-request deadlines enforced both
+while queued (never prefilled) and mid-stream (evicted between
+iterations), and drain semantics (stop admitting, finish what's
+streaming). Decode GEMMs run on the artifact's pre-packed 1-bit
+bitplanes — single-position decode is exactly the bandwidth-bound
+small-M regime the packed VPU kernel wins (PERF.md §3).
+
+The recompile fence (analysis/guards.py) is armed with **budget 0**
+after warmup: any post-warmup XLA compile is a bug (a shape or
+weak-type leak into the hot loop) and hard-fails the engine rather than
+shipping as silent per-token compile stalls.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...analysis.guards import (
+    RecompileFenceError,
+    Sanitizer,
+    SanitizerConfig,
+)
+from ...ops.paged_kv import PageAllocator, pages_needed
+
+log = logging.getLogger(__name__)
+
+TOKENS_TOTAL = "lm_tokens_total"
+PAGE_OCCUPANCY = "lm_page_occupancy"
+ACTIVE_STREAMS = "lm_active_streams"
+PREFILL_MS = "lm_prefill_ms"
+DECODE_ITERATION_SECONDS = "lm_decode_iteration_seconds"
+REQUESTS_TOTAL = "lm_requests_total"
+SHED_TOTAL = "lm_shed_total"
+DECODE_ERRORS_TOTAL = "lm_decode_errors_total"
+
+# Millisecond buckets for the prefill histogram (the default registry
+# buckets are seconds-scaled; prefill is a handful of chunk dispatches).
+_MS_BUCKETS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+class _PrefillDispatchError(RuntimeError):
+    """A prefill dispatch failed with the pools donated to it — the KV
+    pools may be deleted, unlike host-side failures after the dispatch
+    (telemetry, sampling), which leave them intact. The distinction
+    picks the recovery: pools-lost recovery evicts every active stream,
+    so it must never run for a mere telemetry error."""
+
+
+_req_ids = itertools.count()
+
+
+class LMRequest:
+    """One admitted generation request and its token stream.
+
+    The engine pushes ``{"kind": "token", ...}`` dicts followed by one
+    ``{"kind": "done", "status": ...}`` into ``events``; the transport
+    (HTTP handler, test, bench consumer) drains it. ``cancelled`` is the
+    consumer's back-signal (client disconnect, queued-deadline 504): the
+    scheduler observes it between iterations and frees the pages.
+    """
+
+    __slots__ = (
+        "id", "prompt", "max_new_tokens", "deadline", "temperature",
+        "seed", "rng", "enqueued_at", "events", "cancelled", "status",
+        "tokens", "slot", "n_emitted",
+    )
+
+    def __init__(
+        self, prompt: np.ndarray, max_new_tokens: int, deadline: float,
+        temperature: float = 0.0, seed: int = 0,
+    ):
+        self.id = next(_req_ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = float(deadline)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        # Built eagerly so an invalid seed raises HERE, on the
+        # submitter's thread — not inside the scheduler's admission
+        # path, whose failure recovery assumes a dispatch error and
+        # tears down every active stream's KV state.
+        self.rng = (
+            np.random.default_rng(self.seed)
+            if self.temperature > 0 else None
+        )
+        self.enqueued_at = time.monotonic()
+        self.events: "queue.Queue[Dict[str, Any]]" = queue.Queue()
+        self.cancelled = False
+        self.status: Optional[str] = None
+        self.tokens: List[int] = []
+        self.slot: Optional[int] = None
+        self.n_emitted = 0
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+
+class _Slot:
+    """Host-side state of one batch slot (device state lives in the
+    pools + the engine's position/table arrays)."""
+
+    __slots__ = ("req", "pages", "total_len", "rng", "admitted_iter",
+                 "admitted_at")
+
+    def __init__(self, req: LMRequest, pages: List[int], total_len: int,
+                 admitted_iter: int, admitted_at: float):
+        self.req = req
+        self.pages = pages
+        self.total_len = total_len          # prompt + clamped max_new
+        self.rng = req.rng
+        self.admitted_iter = admitted_iter
+        self.admitted_at = admitted_at      # queue pop, BEFORE prefill
+
+
+class LMEngine:
+    """Single-worker continuous-batching engine over a
+    :class:`~...infer_transformer.PagedLMDecoder`.
+
+    ``submit`` returns an :class:`LMRequest` (stream from its ``events``
+    queue) or a shed-reason string (``queue_full`` | ``draining``), the
+    same admission contract as :class:`~..core.ServeEngine`.
+    """
+
+    def __init__(
+        self,
+        decoder,                       # PagedLMDecoder
+        *,
+        queue_depth: int = 16,
+        telemetry: Any = None,
+        chaos: Any = None,
+        decode_event_every: int = 50,
+        max_consecutive_failures: int = 4,
+        recompile_fence: bool = True,
+    ):
+        self.decoder = decoder
+        self.telemetry = telemetry
+        self.chaos = chaos
+        self.queue_depth = int(queue_depth)
+        self.decode_event_every = max(int(decode_event_every), 1)
+        self.max_consecutive_failures = int(max_consecutive_failures)
+        self.allocator = PageAllocator(decoder.num_pages)
+        self.max_len = int(decoder.max_len)
+        s, p = int(decoder.slots), int(decoder.max_pages)
+        self._page_tables = np.zeros((s, p), np.int32)
+        self._positions = np.zeros(s, np.int32)
+        self._tokens = np.zeros(s, np.int32)
+        self._slots: List[Optional[_Slot]] = [None] * s
+        self._pools = None
+        self._queue: deque[LMRequest] = deque()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self.draining = False
+        self._closed = False           # set by the final queue drain
+        self.batch_seq = 0             # decode iterations dispatched
+        self._consecutive_failures = 0
+        self._compile_baseline: Optional[int] = None
+        self.fence_error: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+
+        from ...obs import default_registry, get_tracker
+
+        self._tracker = get_tracker()
+        reg = telemetry.registry if telemetry is not None else None
+        if reg is None:
+            reg = default_registry()
+        self.registry = reg
+        self.tokens_ctr = reg.counter(
+            TOKENS_TOTAL, "LM tokens processed (phase=prefill|decode)"
+        )
+        self.occupancy_gauge = reg.gauge(
+            PAGE_OCCUPANCY, "fraction of KV pages in use"
+        )
+        self.active_gauge = reg.gauge(
+            ACTIVE_STREAMS, "generation streams holding a batch slot"
+        )
+        self.prefill_hist = reg.histogram(
+            PREFILL_MS, "admission prefill wall time (ms)",
+            buckets=_MS_BUCKETS,
+        )
+        self.iter_hist = reg.histogram(
+            DECODE_ITERATION_SECONDS,
+            "decode iteration wall time (= inter-token latency while "
+            "the batch is stable)",
+        )
+        self.requests_ctr = reg.counter(
+            REQUESTS_TOTAL, "LM requests by final status"
+        )
+        self.shed_ctr = reg.counter(
+            SHED_TOTAL, "LM admission rejections by reason"
+        )
+        self.errors_ctr = reg.counter(
+            DECODE_ERRORS_TOTAL, "decode dispatch failures (retried)"
+        )
+        self._sanitizer = Sanitizer(
+            SanitizerConfig(
+                recompile_fence=recompile_fence,
+                recompile_budget=0,
+                warmup_steps=0,
+            ),
+            telemetry=telemetry,
+            registry=reg,
+        ) if recompile_fence else None
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def active_streams(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def queue_len(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def recompiles_post_warmup(self) -> Optional[int]:
+        if self._compile_baseline is None:
+            return None
+        return self._tracker.count - self._compile_baseline
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "LMEngine":
+        """Warm the two compiled programs (prefill + decode) against the
+        null page, pin the recompile baseline, start the scheduler."""
+        import jax
+        import jax.numpy as jnp
+
+        dec = self.decoder
+        pools = dec.init_pools()
+        zeros_c = np.zeros(dec.prefill_chunk, np.int32)
+        zeros_p = np.zeros(dec.max_pages, np.int32)
+        pools, lp = dec.prefill(
+            pools, jnp.asarray(zeros_c), jnp.asarray(zeros_p),
+            jnp.asarray(np.int32(0)), jnp.asarray(np.int32(0)),
+        )
+        jax.block_until_ready(lp)
+        pools, lp = dec.decode(
+            pools, jnp.asarray(self._tokens),
+            jnp.asarray(self._page_tables), jnp.asarray(self._positions),
+        )
+        jax.block_until_ready(lp)
+        self._pools = pools
+        self._compile_baseline = self._tracker.mark()
+        if self._sanitizer is not None:
+            # Pins the fence baseline at the post-warmup count; every
+            # later after_step enforces budget 0 against it.
+            self._sanitizer.after_step(step=0)
+        self._thread = threading.Thread(
+            target=self._run, name="lm-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, wait for queued + streaming work to finish.
+        Returns False on timeout (callers still stop)."""
+        self.begin_drain()
+        deadline = time.monotonic() + timeout
+        while self.queue_len or self.active_streams:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # -- admission (transport threads) --------------------------------------
+
+    def submit(
+        self, prompt, max_new_tokens: int, deadline: float, *,
+        temperature: float = 0.0, seed: int = 0,
+    ):
+        """Admit or shed. Returns an :class:`LMRequest` or a shed-reason
+        string. Validation beyond shape limits (prompt length vs
+        ``max_len``) is the transport's job — it owns the 4xx replies."""
+        if self.draining or self._stop.is_set():
+            return self._shed("draining")
+        if self.fence_error is not None or (
+            self._thread is not None and not self._thread.is_alive()
+        ):
+            # The scheduler is dead (recompile fence or a fatal crash):
+            # queueing would strand the request until its deadline.
+            # Shed immediately — and visibly (health() reports failed).
+            return self._shed("engine_failed")
+        req = LMRequest(
+            prompt, max_new_tokens, deadline,
+            temperature=temperature, seed=seed,
+        )
+        with self._cond:
+            if self._closed:
+                # The scheduler drained the queue for the last time
+                # (fence trip or stop) BETWEEN the liveness check above
+                # and here — appending would strand the request with no
+                # thread left to pop it.
+                reason = "engine_failed"
+            elif len(self._queue) >= self.queue_depth:
+                reason = "queue_full"
+            else:
+                self._queue.append(req)
+                self._cond.notify()
+                return req
+        return self._shed(reason)
+
+    def _shed(self, reason: str) -> str:
+        self.shed_ctr.inc(reason=reason)
+        self.requests_ctr.inc(status="shed")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "shed", reason=reason, queue_depth=self.queue_len,
+                engine="lm",
+            )
+        return reason
+
+    # -- scheduler ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            try:
+                if self._stop.is_set():
+                    # Before admitting: a stop with requests still
+                    # queued must cancel them, not pay a full prefill
+                    # and stream one token into a 200 it will
+                    # immediately kill.
+                    self._cancel_all("engine stopped")
+                    return
+                self._admit_ready()
+                if self.active_streams == 0:
+                    # Idle (covers draining-with-nothing-left too):
+                    # sleep until work or stop; the loop-top check
+                    # handles the stop on the next pass.
+                    with self._cond:
+                        if not self._queue and not self._stop.is_set():
+                            self._cond.wait(0.02)
+                    continue
+                if self._stop.is_set():
+                    self._cancel_all("engine stopped")
+                    return
+                self._decode_once()
+            except RecompileFenceError as e:
+                # Budget-0 fence: a post-warmup compile means the ONE-
+                # signature contract broke. Fail loudly and visibly.
+                self.fence_error = str(e)
+                log.error("lm-engine recompile fence tripped: %s", e)
+                self._evict_all("error", f"recompile fence: {e}")
+                # Queued work would otherwise strand until its
+                # deadlines; submit() sheds engine_failed from now on.
+                self._cancel_all(f"recompile fence: {e}")
+                return
+            except Exception:
+                log.exception(
+                    "lm-engine iteration %d failed; scheduler continues",
+                    self.batch_seq,
+                )
+                time.sleep(0.01)
+
+    def _free_slot_index(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _purge_dead_queued(self) -> None:
+        """Drop expired/cancelled entries from the bounded queue even
+        when every slot is busy — a 504'd request must not keep holding
+        a queue_depth token and shed live traffic as ``queue_full`` for
+        the rest of some long stream's lifetime."""
+        with self._cond:
+            dead = [r for r in self._queue
+                    if r.expired() or r.cancelled]
+            if not dead:
+                return
+            kept = [r for r in self._queue
+                    if not (r.expired() or r.cancelled)]
+            self._queue.clear()
+            self._queue.extend(kept)
+        for req in dead:
+            # Deadline before cancellation (same precedence as the pop
+            # path below): the 504 waiter sets both.
+            if req.expired():
+                self._finish_unslotted(req, "deadline",
+                                       "deadline exceeded in queue")
+            else:
+                self._finish_unslotted(req, "cancelled",
+                                       "cancelled while queued")
+
+    def _admit_ready(self) -> None:
+        """Pop queued requests into free slots while pages allow —
+        runs between decode iterations, so a request admitted here joins
+        sequences already mid-generation."""
+        self._purge_dead_queued()
+        while True:
+            slot = self._free_slot_index()
+            if slot is None:
+                return
+            with self._cond:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+            # Deadline before cancellation: the 504 path sets BOTH (the
+            # waiter cancels after replying), and "deadline" is the
+            # truth the event log should carry.
+            if req.expired():
+                self._finish_unslotted(req, "deadline",
+                                       "deadline exceeded in queue")
+                continue
+            if req.cancelled:
+                self._finish_unslotted(req, "cancelled",
+                                       "cancelled while queued")
+                continue
+            total = min(
+                len(req.prompt) + req.max_new_tokens, self.max_len
+            )
+            need = pages_needed(total, self.decoder.page_size)
+            if need > self.allocator.capacity:
+                # Would never fit even on an idle engine: failing it now
+                # beats wedging the FIFO head forever.
+                self._finish_unslotted(
+                    req, "error",
+                    f"request needs {need} pages, pool holds "
+                    f"{self.allocator.capacity}",
+                )
+                continue
+            pages = self.allocator.alloc(need)
+            if pages is None:
+                # Not enough KV memory: requeue at the head and let
+                # running sequences finish — eviction frees pages.
+                with self._cond:
+                    self._queue.appendleft(req)
+                return
+            try:
+                self._prefill_into_slot(req, slot, pages, total)
+            except Exception as e:
+                log.exception("lm-engine prefill for request %d failed",
+                              req.id)
+                hazard = isinstance(e, _PrefillDispatchError)
+                cause = e.__cause__ if hazard and e.__cause__ else e
+                detail = (
+                    f"prefill failure: {type(cause).__name__}: {cause}"
+                )
+                st = self._slots[slot]
+                if st is not None and st.req is req:
+                    # The failure landed AFTER the slot assignment
+                    # (e.g. the lm_admit emit raised): the slot owns
+                    # the pages now. _evict frees them exactly once
+                    # and delivers the done event — freeing here would
+                    # hand live pages to the next request.
+                    self._evict(slot, "error", detail)
+                elif req.slot is None and req.status is None:
+                    # Failed before ownership transferred: the pages
+                    # are still the handler's to return.
+                    self.allocator.free(pages)
+                    self._finish_unslotted(req, "error", detail)
+                # else: the request already finished (a post-eviction
+                # emit raised) — its pages are freed and its status
+                # recorded; nothing is owed here.
+                if hazard:
+                    # The pools were donated to the failed dispatch and
+                    # may be deleted — every later iteration would die.
+                    # Same recovery as a decode dispatch failure: fail
+                    # actives, rebuild fresh pools. Host-side failures
+                    # after the dispatches (telemetry, sampling) leave
+                    # the pools intact and must NOT take this path.
+                    self._dispatch_failure(cause)
+
+    def _prefill_into_slot(
+        self, req: LMRequest, slot: int, pages: List[int], total: int
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        dec = self.decoder
+        admitted_at = time.monotonic()      # queue wait ends HERE: the
+        t0 = time.perf_counter()            # queue/prefill split must
+        table = np.zeros(dec.max_pages, np.int32)  # not double-count
+        table[: len(pages)] = pages
+        plen = len(req.prompt)
+        chunk = dec.prefill_chunk
+        padded = -(-plen // chunk) * chunk
+        prompt = np.zeros(padded, np.int32)
+        prompt[:plen] = req.prompt
+        table_j = jnp.asarray(table)
+        length_j = jnp.asarray(np.int32(plen))
+        lp_last = None
+        last_start = 0
+        try:
+            for start in range(0, padded, chunk):
+                self._pools, clp = dec.prefill(
+                    self._pools, jnp.asarray(prompt[start:start + chunk]),
+                    table_j, jnp.asarray(np.int32(start)), length_j,
+                )
+                lp_last = clp
+                last_start = start
+            # sync: admission, not hot loop — a deferred device error
+            # surfaces here, still inside the donation-hazard region
+            lp_host = np.asarray(lp_last)
+        except Exception as e:
+            raise _PrefillDispatchError(
+                f"prefill dispatch for request {req.id}"
+            ) from e
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        self.prefill_hist.observe(prefill_ms)
+        self.tokens_ctr.inc(plen, phase="prefill")
+        st = _Slot(req, pages, total, self.batch_seq, admitted_at)
+        # First generated token comes straight out of prefill: the
+        # prompt's last position predicts position plen.
+        first = self._sample_token(
+            req, lp_host[plen - 1 - last_start], st.rng
+        )
+        self._slots[slot] = st
+        req.slot = slot
+        self._page_tables[slot] = table
+        self._positions[slot] = plen       # next decode writes pos plen
+        self._tokens[slot] = first
+        self.active_gauge.set(self.active_streams)
+        self.occupancy_gauge.set(self.allocator.occupancy())
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "lm_admit",
+                id=req.id, slot=slot, prompt_tokens=plen,
+                max_new_tokens=req.max_new_tokens, pages=len(pages),
+                iteration=self.batch_seq,
+                queue_ms=round((st.admitted_at - req.enqueued_at) * 1e3, 3),
+                prefill_ms=round(prefill_ms, 3),
+                page_occupancy=round(self.allocator.occupancy(), 4),
+            )
+        self._emit_token(req, first)
+        self._maybe_finish(slot)
+
+    def _sample_token(
+        self, req: LMRequest, lp: np.ndarray, rng
+    ) -> int:
+        """Greedy at temperature 0, else categorical from the slot's own
+        host RNG — sampling is host-side numpy so the compiled decode
+        signature stays sampling-free (and per-request temperatures
+        don't multiply program variants)."""
+        if req.temperature > 0 and rng is not None:
+            logits = lp / req.temperature
+            logits = logits - logits.max()
+            p = np.exp(logits)
+            p /= p.sum()
+            return int(rng.choice(len(p), p=p))
+        return int(np.argmax(lp))
+
+    def _emit_token(self, req: LMRequest, token: int) -> None:
+        req.n_emitted += 1
+        req.tokens.append(int(token))
+        self.tokens_ctr.inc(phase="decode")
+        req.events.put({
+            "kind": "token", "i": req.n_emitted - 1, "token": int(token),
+        })
+
+    def _decode_once(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.batch_seq += 1
+        self._expire_active()
+        if self.active_streams == 0:
+            return
+        if self.chaos is not None and self.chaos.active:
+            try:
+                self.chaos.on_infer(step=self.batch_seq)
+            except Exception as e:
+                # Raised BEFORE the dispatch: nothing was donated, the
+                # pools are intact, the iteration can simply be retried
+                # (bounded by max_consecutive_failures).
+                self._record_predispatch_failure(e)
+                return
+        t0 = time.perf_counter()
+        try:
+            self._pools, lp = self.decoder.decode(
+                self._pools,
+                jnp.asarray(self._tokens),
+                jnp.asarray(self._page_tables),
+                jnp.asarray(self._positions),
+            )
+            lp_host = np.asarray(lp)       # the per-iteration sync point
+        except Exception as e:
+            # A failure INSIDE the dispatch cannot be retried: the
+            # pools were donated to it and may already be deleted. Fail
+            # every active stream loudly and rebuild fresh pools so the
+            # engine keeps serving future requests (same compiled
+            # programs — the shapes are unchanged, no recompile).
+            self._dispatch_failure(e)
+            return
+        dt = time.perf_counter() - t0
+        self._consecutive_failures = 0
+        self.iter_hist.observe(dt)
+        if self._sanitizer is not None:
+            self._sanitizer.after_step(step=self.batch_seq)
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            nxt = self._sample_token(st.req, lp_host[slot], st.rng)
+            self._positions[slot] += 1
+            self._tokens[slot] = nxt
+            self._emit_token(st.req, nxt)
+            self._maybe_finish(slot)
+        if self.batch_seq % self.decode_event_every == 0:
+            if self.telemetry is not None:
+                self.telemetry.emit(
+                    "lm_decode",
+                    iteration=self.batch_seq,
+                    active=self.active_streams,
+                    queue_depth=self.queue_len,
+                    iter_ms=round(dt * 1e3, 3),
+                    page_occupancy=round(self.allocator.occupancy(), 4),
+                    recompiles_post_warmup=self.recompiles_post_warmup,
+                )
+
+    def _record_predispatch_failure(self, e: Exception) -> None:
+        self._consecutive_failures += 1
+        self.errors_ctr.inc(kind=type(e).__name__)
+        log.warning(
+            "lm-engine decode iteration %d failed (%s: %s) — attempt "
+            "%d/%d", self.batch_seq, type(e).__name__, e,
+            self._consecutive_failures, self.max_consecutive_failures,
+        )
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "lm_decode_error", iteration=self.batch_seq,
+                error=f"{type(e).__name__}: {e}"[:500],
+                consecutive=self._consecutive_failures,
+            )
+        if self._consecutive_failures >= self.max_consecutive_failures:
+            # The backend is presumed wedged: fail every stream loudly
+            # rather than spinning forever. Chaos-injected transients
+            # (infer_error) stay below the cap and are simply retried —
+            # they fire before the dispatch, so nothing was donated and
+            # the pools are untouched.
+            self._evict_all(
+                "error",
+                f"{self._consecutive_failures} consecutive decode "
+                f"failures (last: {type(e).__name__}: {e})",
+            )
+            self._consecutive_failures = 0
+
+    def _dispatch_failure(self, e: Exception) -> None:
+        """A jitted call failed mid-execution: with donated pools the
+        KV memory is gone, so every active stream dies here — but the
+        ENGINE survives, on freshly initialized pools."""
+        self.errors_ctr.inc(kind=type(e).__name__)
+        log.error(
+            "lm-engine dispatch failed at iteration %d (%s: %s): KV "
+            "pools lost (donated) — failing %d active stream(s), "
+            "rebuilding pools", self.batch_seq, type(e).__name__, e,
+            self.active_streams,
+        )
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "lm_decode_error", iteration=self.batch_seq,
+                error=f"{type(e).__name__}: {e}"[:500],
+                fatal_to_streams=True,
+            )
+        self._evict_all(
+            "error",
+            f"decode dispatch failed, KV state lost "
+            f"({type(e).__name__}: {e})",
+        )
+        self._pools = self.decoder.init_pools()
+
+    def _expire_active(self) -> None:
+        now = time.monotonic()
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if st.req.cancelled:
+                self._evict(slot, "cancelled", "client went away")
+            elif st.req.expired(now):
+                self._evict(slot, "deadline",
+                            "deadline exceeded mid-stream")
+
+    def _maybe_finish(self, slot: int) -> None:
+        st = self._slots[slot]
+        if st is None:
+            return
+        req = st.req
+        if req.n_emitted >= req.max_new_tokens:
+            self._evict(slot, "ok", "")
+        elif len(req.prompt) + req.n_emitted >= st.total_len:
+            self._evict(slot, "ok", "max_len reached")
+
+    # -- eviction / completion ----------------------------------------------
+
+    def _evict(self, slot: int, status: str, detail: str) -> None:
+        st = self._slots[slot]
+        if st is None:
+            return
+        self._slots[slot] = None
+        self._page_tables[slot] = 0
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+        self.allocator.free(st.pages)
+        req = st.req
+        req.slot = None
+        self._finish(req, status, detail, slot=slot,
+                     pages_freed=len(st.pages),
+                     wall_ms=round(
+                         (time.monotonic() - st.admitted_at) * 1e3, 3))
+        self.active_gauge.set(self.active_streams)
+        self.occupancy_gauge.set(self.allocator.occupancy())
+
+    def _evict_all(self, status: str, detail: str) -> None:
+        for slot, st in enumerate(self._slots):
+            if st is not None:
+                self._evict(slot, status, detail)
+
+    def _cancel_all(self, detail: str) -> None:
+        # Close admission under the queue lock FIRST: a submit() racing
+        # past the liveness checks either lands before this (and is
+        # drained below) or observes _closed and sheds — never strands.
+        with self._cond:
+            self._closed = True
+        self._evict_all("cancelled", detail)
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+            self._finish_unslotted(req, "cancelled", detail)
+
+    def _finish_unslotted(
+        self, req: LMRequest, status: str, detail: str
+    ) -> None:
+        self._finish(req, status, detail, slot=None, pages_freed=0,
+                     wall_ms=round(
+                         (time.monotonic() - req.enqueued_at) * 1e3, 3))
+
+    def _finish(
+        self, req: LMRequest, status: str, detail: str, *,
+        slot: Optional[int], pages_freed: int, wall_ms: float,
+    ) -> None:
+        req.status = status
+        self.requests_ctr.inc(status=status)
+        if self.telemetry is not None:
+            fields: Dict[str, Any] = {
+                "id": req.id, "status": status, "slot": slot,
+                "tokens_emitted": req.n_emitted,
+                "pages_freed": pages_freed, "wall_ms": wall_ms,
+                "iteration": self.batch_seq,
+            }
+            if detail:
+                fields["detail"] = detail[:500]
+            try:
+                self.telemetry.emit("lm_evict", **fields)
+            except Exception:
+                # Telemetry must never disrupt serving: the client's
+                # terminal event below is owed regardless, and an
+                # exception escaping _evict would abort the rest of the
+                # iteration's slot loop.
+                log.exception("lm_evict emit failed (telemetry only)")
+        req.events.put({
+            "kind": "done", "status": status, "n": req.n_emitted,
+            "id": req.id, **({"detail": detail} if detail else {}),
+        })

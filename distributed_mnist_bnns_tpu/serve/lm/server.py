@@ -1,0 +1,433 @@
+"""Streaming HTTP front end for the continuous-batching LM engine.
+
+``cli serve --lm <artifact>`` — the generation counterpart of the packed
+classifier server (serve/server.py), sharing its lifecycle discipline
+(bounded admission, deadlines, SIGTERM drain, obs events) but streaming
+**incrementally**: tokens reach the client as they are decoded, over
+chunked transfer encoding, one JSON object per line (ndjson).
+
+  POST /generate      {"prompt": [ints] | "text": str,
+                       "max_new_tokens": int, "deadline_ms": float,
+                       "temperature": float, "seed": int}
+                      -> 200 + ndjson stream:
+                           {"i": 0, "token": 17}
+                           {"i": 1, "token": 3}
+                           ...
+                           {"done": true, "status": "ok", "n": N}
+                      503 shed (queue_full/draining/engine_failed) |
+                      504 deadline before the first token |
+                      400 bad input | 413 prompt too long
+                      A deadline that lands MID-stream cannot change
+                      the already-sent 200: the stream terminates with
+                      {"done": true, "status": "deadline"} instead.
+  GET  /healthz       status, active_streams, queue_depth,
+                      page_occupancy, recompiles_post_warmup
+  GET  /metrics       obs registry snapshot (JSON)
+
+Lifecycle: SIGTERM stops admission (shed ``draining``), lets active
+streams run out (bounded by the drain budget), emits a ``drain`` event,
+exits 0 — crash-only, same as the classifier server, and exercised by
+the CI ``lm-serve-smoke`` (scripts/lm_serve_smoke.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ...resilience.preempt import StopRequest
+from ..httpbase import JsonHandler
+from .engine import LMEngine, LMRequest
+
+log = logging.getLogger(__name__)
+
+# Slack granted past a deadline before the waiter gives up on the first
+# token (same role as server.py's _WAIT_SLACK_S).
+_WAIT_SLACK_S = 0.05
+
+_SHED_HTTP = {"queue_full": 503, "draining": 503, "engine_failed": 503}
+
+
+@dataclass
+class LMServeConfig:
+    """Engine geometry + robustness budgets (CLI flags mirror these)."""
+
+    artifact: str
+    host: str = "127.0.0.1"
+    port: int = 8000                    # 0 = ephemeral (tests)
+    slots: int = 4                      # decode batch width (compiled)
+    page_size: int = 16                 # tokens per KV page
+    num_pages: Optional[int] = None     # None: slots*max_pages + null
+    prefill_chunk: int = 16             # prompt positions per dispatch
+    max_len: Optional[int] = None       # None: the artifact's window
+    queue_depth: int = 16               # admission bound
+    default_deadline_ms: float = 30000.0
+    default_max_new_tokens: int = 64
+    max_prompt_tokens: Optional[int] = None   # None: max_len - 1
+    drain_timeout_s: float = 30.0
+    telemetry_dir: Optional[str] = None
+    chaos: Optional[str] = None
+    seed: int = 0
+    interpret: Optional[bool] = None    # None: Mosaic on TPU else interp
+
+
+class LMServer:
+    """Owns the engine, the streaming HTTP front end and the drain."""
+
+    def __init__(self, config: LMServeConfig):
+        self.config = config
+        from ...obs import Telemetry
+
+        self.telemetry = Telemetry(config.telemetry_dir, heartbeat=False)
+        from ...resilience.chaos import ChaosController
+
+        self.chaos = ChaosController.from_config(
+            config.chaos, seed=config.seed, telemetry=self.telemetry
+        )
+        self.stop_request = StopRequest()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._started_at = time.time()
+        self.engine: Optional[LMEngine] = None
+        self.artifact_info: Dict[str, Any] = {}
+        self.vocab = 0
+
+    def _interpret(self) -> bool:
+        if self.config.interpret is not None:
+            return self.config.interpret
+        import jax
+
+        return jax.default_backend() != "tpu"
+
+    def start(self) -> Tuple[str, int]:
+        cfg = self.config
+        from flax import serialization
+
+        from ...infer_transformer import make_paged_lm_decoder
+
+        with open(cfg.artifact, "rb") as f:
+            frozen = serialization.msgpack_restore(f.read())
+        if frozen.get("info", {}).get("kind") != "lm" and \
+                frozen.get("kind") != "lm":
+            raise ValueError(
+                f"{cfg.artifact} is not a packed LM artifact"
+            )
+        self.artifact_info = dict(frozen.get("info", {}))
+        decoder = make_paged_lm_decoder(
+            frozen,
+            slots=cfg.slots,
+            page_size=cfg.page_size,
+            num_pages=cfg.num_pages,
+            prefill_chunk=cfg.prefill_chunk,
+            max_len=cfg.max_len,
+            interpret=self._interpret(),
+        )
+        self.vocab = decoder.vocab
+        self.engine = LMEngine(
+            decoder,
+            queue_depth=cfg.queue_depth,
+            telemetry=self.telemetry,
+            chaos=self.chaos if self.chaos.active else None,
+        ).start()
+        server = self
+
+        class Handler(_LMHandler):
+            srv = server
+
+        self._httpd = ThreadingHTTPServer((cfg.host, cfg.port), Handler)
+        self._httpd.daemon_threads = True
+        host, port = self._httpd.server_address[:2]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="lm-serve-http",
+            daemon=True,
+        )
+        self._http_thread.start()
+        self.telemetry.manifest(
+            config={
+                "artifact": cfg.artifact,
+                "engine": "lm",
+                "slots": cfg.slots,
+                "page_size": cfg.page_size,
+                "num_pages": decoder.num_pages,
+                "prefill_chunk": cfg.prefill_chunk,
+                "max_len": decoder.max_len,
+                "queue_depth": cfg.queue_depth,
+                "default_deadline_ms": cfg.default_deadline_ms,
+                "chaos": self.chaos.spec or None,
+            },
+            artifact_info=self.artifact_info,
+        )
+        log.info(
+            "lm-serving %s on %s:%d — %d slots, %d pages x %d tokens, "
+            "max_len %d", cfg.artifact, host, port, cfg.slots,
+            decoder.num_pages, cfg.page_size, decoder.max_len,
+        )
+        return host, port
+
+    def health(self) -> Dict[str, Any]:
+        eng = self.engine
+        assert eng is not None
+        if eng.fence_error is not None:
+            status = "failed"          # load balancers must route away
+        elif eng.draining:
+            status = "draining"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "engine": "lm",
+            "slots": eng.decoder.slots,
+            "active_streams": eng.active_streams,
+            "queue_depth": eng.queue_len,
+            "pages_in_use": eng.allocator.used_count(),
+            "page_occupancy": round(eng.allocator.occupancy(), 4),
+            "recompiles_post_warmup": eng.recompiles_post_warmup,
+            "fence_error": eng.fence_error,
+            "max_len": eng.max_len,
+            "uptime_s": round(time.time() - self._started_at, 3),
+        }
+
+    def request_stop(self, reason: str = "stop requested") -> None:
+        self.stop_request.request(reason)
+
+    def drain_and_stop(self) -> Dict[str, Any]:
+        assert self.engine is not None
+        t0 = time.monotonic()
+        queued = self.engine.queue_len
+        streaming = self.engine.active_streams
+        self.engine.begin_drain()
+        flushed = self.engine.drain(timeout=self.config.drain_timeout_s)
+        self.engine.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        stats = {
+            "reason": self.stop_request.reason or "stop requested",
+            "queued_at_drain": queued,
+            "streaming_at_drain": streaming,
+            "flushed": flushed,
+            "requests_total": int(self.engine.requests_ctr.total()),
+            "shed_total": int(self.engine.shed_ctr.total()),
+            "iterations_total": self.engine.batch_seq,
+            "recompiles_post_warmup": self.engine.recompiles_post_warmup,
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        self.telemetry.emit("drain", engine="lm", **stats)
+        self.telemetry.close()
+        log.info("lm server drained and stopped: %s", stats)
+        return stats
+
+    def run(self) -> int:
+        """CLI entry: serve until SIGTERM/SIGINT, graceful-drain, exit
+        0 (the resilience/preempt.py handler pattern — handlers install
+        before start() so a SIGTERM during warmup compiles still drains
+        cleanly)."""
+        with self.stop_request.install():
+            self.start()
+            while not self.stop_request.requested:
+                time.sleep(0.05)
+        self.drain_and_stop()
+        return 0
+
+
+class _LMHandler(JsonHandler):
+    """Streaming per-connection handler; ``srv`` bound by subclassing.
+    JSON/body-cap/timeout plumbing comes from the shared
+    :class:`~..httpbase.JsonHandler`."""
+
+    srv: LMServer
+    logger = log
+
+    def _max_body_bytes(self) -> int:
+        return 1 << 22                # 4 MiB: prompts are token lists
+
+    # -- chunked ndjson streaming --------------------------------------------
+
+    def _start_stream(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _write_line(self, obj: Dict[str, Any]) -> None:
+        data = json.dumps(obj).encode() + b"\n"
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_stream(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        if self.path == "/healthz":
+            self._reply(200, self.srv.health())
+        elif self.path == "/metrics":
+            self._reply(200, self.srv.telemetry.registry.snapshot())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        if self.path == "/generate":
+            self._generate()
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def _parse_prompt(self, body: Dict[str, Any]) -> Optional[np.ndarray]:
+        if "text" in body and "prompt" not in body:
+            if not isinstance(body["text"], str) or not body["text"]:
+                self._reply(400, {"error": "text must be a non-empty "
+                                           "string"})
+                return None
+            raw = body["text"].encode("utf-8")
+            return np.asarray(
+                [b % self.srv.vocab for b in raw], np.int32
+            )
+        try:
+            prompt = np.asarray(body["prompt"], np.int32)
+        except (KeyError, TypeError, ValueError, OverflowError) as e:
+            self._reply(400, {"error": f"bad prompt payload: {e}"})
+            return None
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            self._reply(400, {
+                "error": f"prompt must be a non-empty 1-D token list, "
+                         f"got shape {list(prompt.shape)}",
+            })
+            return None
+        if ((prompt < 0) | (prompt >= self.srv.vocab)).any():
+            self._reply(400, {
+                "error": f"prompt tokens outside [0, {self.srv.vocab})",
+            })
+            return None
+        return prompt
+
+    def _generate(self) -> None:
+        body = self._read_json()
+        if body is None:
+            return
+        engine = self.srv.engine
+        assert engine is not None
+        prompt = self._parse_prompt(body)
+        if prompt is None:
+            return
+        cfg = self.srv.config
+        max_prompt = (
+            cfg.max_prompt_tokens
+            if cfg.max_prompt_tokens is not None else engine.max_len - 1
+        )
+        if prompt.shape[0] > max_prompt:
+            self._reply(413, {
+                "error": f"prompt of {prompt.shape[0]} tokens exceeds "
+                         f"the {max_prompt}-token limit",
+            })
+            return
+        try:
+            max_new = int(body.get(
+                "max_new_tokens", cfg.default_max_new_tokens
+            ))
+            temperature = float(body.get("temperature", 0.0))
+            seed = int(body.get("seed", 0))
+            deadline_ms = float(body.get(
+                "deadline_ms", cfg.default_deadline_ms
+            ))
+        except (TypeError, ValueError) as e:
+            self._reply(400, {"error": f"bad generation knob: {e}"})
+            return
+        if max_new < 1:
+            self._reply(400, {
+                "error": f"max_new_tokens must be >= 1, got {max_new}",
+            })
+            return
+        if not (temperature >= 0):   # also catches NaN
+            self._reply(400, {
+                "error": f"temperature must be >= 0, got {temperature}",
+            })
+            return
+        if seed < 0:
+            self._reply(400, {
+                "error": f"seed must be >= 0, got {seed}",
+            })
+            return
+        if not (math.isfinite(deadline_ms) and deadline_ms > 0):
+            self._reply(400, {
+                "error": f"deadline_ms must be a positive finite "
+                         f"number, got {body.get('deadline_ms')!r}",
+            })
+            return
+        deadline = time.monotonic() + deadline_ms / 1e3
+        req = engine.submit(
+            prompt, max_new, deadline, temperature=temperature, seed=seed,
+        )
+        if isinstance(req, str):       # shed reason
+            self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req})
+            return
+        self._stream_reply(req, deadline)
+
+    def _stream_reply(self, req: LMRequest, deadline: float) -> None:
+        """Wait for the first event (bounded by the deadline — a
+        queued-forever request gets a clean 504 and its would-be pages
+        stay free), then stream until ``done``."""
+        try:
+            ev = req.events.get(
+                timeout=max(deadline - time.monotonic() + _WAIT_SLACK_S,
+                            0.0)
+            )
+        except queue.Empty:
+            req.cancelled = True       # scheduler drops + frees on sight
+            self._reply(504, {"error": "deadline exceeded", "id": req.id})
+            return
+        if ev["kind"] == "done" and not req.tokens:
+            # finished before emitting anything: map to a plain status
+            code = {"deadline": 504, "error": 502}.get(ev["status"], 502)
+            self._reply(code, {
+                "error": ev.get("detail") or ev["status"], "id": req.id,
+            })
+            return
+        try:
+            self._start_stream()
+            while True:
+                if ev["kind"] == "done":
+                    self._write_line({
+                        "done": True, "status": ev["status"],
+                        "n": ev["n"], "id": ev["id"],
+                    })
+                    break
+                self._write_line({"i": ev["i"], "token": ev["token"]})
+                try:
+                    # Wait as long as the request's own deadline allows
+                    # (the engine evicts and sends done(deadline) at
+                    # expiry, so a healthy slow stream is never killed
+                    # here); the +1s grace covers eviction in flight.
+                    # Only a wedged engine runs this timer out.
+                    ev = req.events.get(
+                        timeout=max(deadline - time.monotonic(), 0.0)
+                        + 1.0
+                    )
+                except queue.Empty:
+                    # engine wedged: terminate the stream explicitly,
+                    # and cancel so a recovered engine frees the slot
+                    # and pages instead of decoding a ghost nobody reads
+                    req.cancelled = True
+                    self._write_line({
+                        "done": True, "status": "error", "n": req.n_emitted,
+                        "id": req.id, "detail": "stream stalled",
+                    })
+                    break
+            self._end_stream()
+        except (BrokenPipeError, ConnectionError, OSError):
+            # client went away mid-stream: signal the scheduler so the
+            # pages free at the next iteration instead of decoding a
+            # ghost to completion
+            req.cancelled = True
+            self.close_connection = True

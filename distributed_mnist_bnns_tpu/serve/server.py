@@ -27,13 +27,12 @@ same code the chaos smoke exercises in CI (scripts/serve_smoke.py).
 
 from __future__ import annotations
 
-import json
 import logging
 import math
 import threading
 import time
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -46,6 +45,7 @@ from .core import (
     Request,
     ServeEngine,
 )
+from .httpbase import JsonHandler
 
 log = logging.getLogger(__name__)
 
@@ -289,48 +289,23 @@ class PackedInferenceServer:
         return 0
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandler):
     """Per-connection handler; ``srv`` is bound by the enclosing
     server's subclass. Threaded: N handlers block in ``Request.event``
-    waits while the single engine worker batches behind them."""
+    waits while the single engine worker batches behind them. The JSON/
+    body-cap/timeout plumbing is the shared :class:`~.httpbase.
+    JsonHandler`."""
 
     srv: PackedInferenceServer
-    protocol_version = "HTTP/1.1"
-    # Connection-socket timeout (BaseHTTPRequestHandler applies it in
-    # setup()): a client that declares a Content-Length and never sends
-    # the body must not pin a handler thread forever — resource bounds
-    # have to hold BEFORE admission, not only behind it.
-    timeout = 30.0
+    logger = log
 
-    # route BaseHTTPRequestHandler's stderr chatter into logging
-    def log_message(self, fmt: str, *args: Any) -> None:
-        log.debug("http: " + fmt, *args)
+    def _max_body_bytes(self) -> int:
+        return self.srv.max_body_bytes
 
-    def _reply(self, code: int, payload: Dict[str, Any]) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _read_json(self) -> Optional[Dict[str, Any]]:
-        try:
-            n = int(self.headers.get("Content-Length", 0))
-            if n > self.srv.max_body_bytes:
-                # replying without reading the body desyncs a keep-
-                # alive connection — close it instead of draining GBs
-                self.close_connection = True
-                self._reply(413, {
-                    "error": f"body of {n} bytes exceeds the "
-                             f"{self.srv.max_body_bytes}-byte limit "
-                             "(one micro-batch of examples)",
-                })
-                return None
-            return json.loads(self.rfile.read(n) or b"{}")
-        except (ValueError, json.JSONDecodeError) as e:
-            self._reply(400, {"error": f"bad request body: {e}"})
-            return None
+    def _body_limit_error(self, n: int) -> str:
+        return (f"body of {n} bytes exceeds the "
+                f"{self.srv.max_body_bytes}-byte limit "
+                "(one micro-batch of examples)")
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         if self.path == "/healthz":

@@ -1,0 +1,299 @@
+"""LM-serve smoke (CI): continuous batching must survive chaos.
+
+The generation mirror of scripts/serve_smoke.py: exports a tiny packed
+LM artifact, starts ``cli serve --lm`` as a real subprocess with chaos
+injecting decode stalls and transient backend errors, then drives
+staggered-length concurrent streaming requests through it and asserts:
+
+  * every stream finishes ``ok`` with exactly its requested token count
+    despite the injected faults (transient decode errors are retried —
+    the decode step is pure, a failed attempt mutates nothing);
+  * tokens arrive INCREMENTALLY (the chaos stalls spread the stream in
+    time — a burst-at-close would mean buffering, not streaming);
+  * a late request JOINS MID-STREAM: its ``lm_admit`` iteration falls
+    strictly inside another stream's decode window (event log);
+  * a queued request whose deadline expires before admission gets a
+    prompt **504** and frees nothing (``lm_evict`` with status
+    ``deadline`` and ``pages_freed == 0``);
+  * ZERO post-warmup recompiles (/healthz ``recompiles_post_warmup``) —
+    the one-compiled-signature contract held while sequences joined and
+    left;
+  * every page is back in the pool when traffic ends, and SIGTERM
+    drains to **exit 0** with a ``drain`` event.
+
+Usage: python scripts/lm_serve_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHAOS_SPEC = (
+    "infer_slow@step=4,times=3,delay_s=0.25"   # stalls: streams spread,
+                                               # the queued probe 504s
+    ";infer_error@step=16,times=2"             # transient: retried
+)
+EXPECTED_KINDS = ("lm_admit", "lm_evict", "fault_injected", "drain")
+STREAMS = ((0.0, 24), (0.15, 8), (0.3, 12))    # (start delay s, max_new)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None,
+                        help="work dir (default: a fresh temp dir)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the work dir for inspection")
+    args = parser.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="lm_serve_smoke_")
+    tel_dir = os.path.join(work, "telemetry")
+    artifact = os.path.join(work, "lm_packed.msgpack")
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.infer import export_packed
+    from distributed_mnist_bnns_tpu.models.transformer import BinarizedLM
+    from distributed_mnist_bnns_tpu.obs import load_events
+    from distributed_mnist_bnns_tpu.serve.lm import client as lc
+
+    model = BinarizedLM(
+        vocab=64, max_len=64, embed_dim=32, depth=1, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    export_packed(model, variables, artifact)
+
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+            "serve", "--lm",
+            "--artifact", artifact,
+            "--port", str(port),
+            "--slots", "2",
+            "--page-size", "8",
+            "--prefill-chunk", "8",
+            "--queue-depth", "4",
+            "--telemetry-dir", tel_dir,
+            "--chaos", CHAOS_SPEC,
+            "--interpret",
+            "--log-file", os.path.join(work, "lm_serve.log"),
+        ],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    )
+
+    failures = []
+    results = {}
+    lock = threading.Lock()
+    try:
+        for _ in range(240):   # jax import + warmup compiles are slow
+            try:
+                if lc.healthz(base, timeout=2)[0] == 200:
+                    break
+            except OSError:
+                pass
+            if proc.poll() is not None:
+                print(f"FAIL: server died at startup (rc {proc.returncode})",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.5)
+        else:
+            print("FAIL: server never became healthy", file=sys.stderr)
+            return 1
+
+        def stream(tid: int, delay: float, max_new: int) -> None:
+            time.sleep(delay)
+            stamps = []
+            toks = []
+            done = None
+            try:
+                code, resp = lc.open_stream(
+                    base, [1 + tid, 2, 3], max_new_tokens=max_new,
+                    deadline_ms=120000, timeout=120,
+                )
+                if code == 200:
+                    for ev in lc.iter_lines(resp):
+                        stamps.append(time.monotonic())
+                        if "token" in ev:
+                            toks.append(ev["token"])
+                        else:
+                            done = ev
+            except OSError as e:
+                code = -1
+                print(f"stream[{tid}]: transport error {e}",
+                      file=sys.stderr)
+            with lock:
+                results[tid] = {
+                    "code": code, "tokens": toks, "done": done,
+                    "span_s": (stamps[-1] - stamps[0]) if len(stamps) > 1
+                    else 0.0,
+                }
+
+        threads = [
+            threading.Thread(target=stream, args=(i, d, n))
+            for i, (d, n) in enumerate(STREAMS)
+        ]
+        for t in threads:
+            t.start()
+
+        # With 2 slots and 3 live streams, this probe queues behind them;
+        # the chaos stalls guarantee its 50 ms deadline expires first ->
+        # a prompt 504 whose pages were never allocated.
+        time.sleep(0.5)
+        t0 = time.monotonic()
+        code_504, _body = lc.generate(
+            base, [9, 9], max_new_tokens=4, deadline_ms=50, timeout=30
+        )
+        took_504 = time.monotonic() - t0
+        if code_504 != 504:
+            failures.append(f"queued-deadline probe got {code_504}, "
+                            "want 504")
+        elif took_504 > 5.0:
+            failures.append(f"504 took {took_504:.2f}s — not prompt")
+
+        for t in threads:
+            t.join(timeout=180)
+        if any(t.is_alive() for t in threads):
+            failures.append("stream thread hung")
+        for tid, (_d, max_new) in enumerate(STREAMS):
+            r = results.get(tid)
+            if r is None:
+                failures.append(f"stream {tid} produced no result")
+                continue
+            if r["code"] != 200:
+                failures.append(f"stream {tid} got HTTP {r['code']}")
+                continue
+            if r["done"] is None or r["done"].get("status") != "ok":
+                failures.append(
+                    f"stream {tid} did not finish ok: {r['done']}"
+                )
+            if len(r["tokens"]) != max_new:
+                failures.append(
+                    f"stream {tid} emitted {len(r['tokens'])}/{max_new} "
+                    "tokens"
+                )
+        # incremental streaming: the longest stream must span the chaos
+        # stalls, not arrive as one burst at close
+        if results.get(0, {}).get("span_s", 0.0) < 0.2:
+            failures.append(
+                f"stream 0 arrived as a burst "
+                f"(span {results.get(0, {}).get('span_s')}s) — tokens "
+                "must stream incrementally"
+            )
+
+        code, body = lc.healthz(base)
+        health = json.loads(body) if code == 200 else {}
+        if health.get("recompiles_post_warmup") != 0:
+            failures.append(
+                "post-warmup recompiles: "
+                f"{health.get('recompiles_post_warmup')} (want 0) — the "
+                "one-compiled-signature contract broke"
+            )
+        if health.get("pages_in_use") != 0:
+            failures.append(
+                f"{health.get('pages_in_use')} pages still held after "
+                "all streams ended (page leak)"
+            )
+        if health.get("fence_error"):
+            failures.append(f"fence error: {health['fence_error']}")
+
+        # graceful drain: SIGTERM -> flush -> exit 0
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = proc.wait()
+            failures.append("server did not drain within 60s of SIGTERM")
+        if rc != 0:
+            failures.append(f"server exited {rc} after SIGTERM (want 0)")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    events = load_events(os.path.join(tel_dir, "events.jsonl"))
+    kinds = {e["kind"] for e in events}
+    for kind in EXPECTED_KINDS:
+        if kind not in kinds:
+            failures.append(f"event log is missing a {kind!r} event")
+    admits = [e for e in events if e["kind"] == "lm_admit"]
+    evicts = [e for e in events if e["kind"] == "lm_evict"]
+    # mid-stream join: some admission iteration falls strictly inside
+    # another stream's (admit, evict) decode window
+    joined_mid_stream = any(
+        a["iteration"] > 0
+        and any(
+            b["id"] != a["id"]
+            and b["iteration"] < a["iteration"] < e["iteration"]
+            for b in admits
+            for e in evicts
+            if b["id"] == e["id"]
+        )
+        for a in admits
+    )
+    if not joined_mid_stream:
+        failures.append(
+            "no request joined while another was mid-decode "
+            f"(admit iters {[a['iteration'] for a in admits]}, evict "
+            f"iters {[e['iteration'] for e in evicts]})"
+        )
+    deadline_evicts = [e for e in evicts if e["status"] == "deadline"]
+    if not deadline_evicts:
+        failures.append("no lm_evict with status=deadline (504 path)")
+    elif any(e["pages_freed"] != 0 for e in deadline_evicts):
+        failures.append(
+            "queued-deadline eviction reported pages_freed != 0 — it "
+            "must never have allocated"
+        )
+    drains = [e for e in events if e["kind"] == "drain"]
+    if drains and not drains[-1].get("flushed"):
+        failures.append("drain did not flush streaming work")
+
+    summary = {
+        "streams": {
+            tid: {"code": r["code"], "n_tokens": len(r["tokens"]),
+                  "status": (r["done"] or {}).get("status"),
+                  "span_s": round(r["span_s"], 3)}
+            for tid, r in sorted(results.items())
+        },
+        "queued_deadline_probe": code_504,
+        "events": {k: sum(1 for e in events if e["kind"] == k)
+                   for k in EXPECTED_KINDS},
+        "recompiles_post_warmup": health.get("recompiles_post_warmup"),
+        "drain": drains[-1] if drains else None,
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=2, default=str))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not args.keep and args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
