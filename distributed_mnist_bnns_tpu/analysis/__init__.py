@@ -1,12 +1,15 @@
 """Static analysis + runtime sanitizers for JAX footguns.
 
-Two halves (ANALYSIS.md is the user-facing catalog):
+Three halves (ANALYSIS.md is the user-facing catalog):
 
-* ``analysis.lint`` — an AST linter with repo-tailored rules
-  (JG001-JG006): host syncs inside traced functions, PRNG-key hygiene,
-  jit-boundary hygiene (donation, static-arg hashability, shard_map
-  closures), python control flow on tracers, silent broad excepts, and
-  direct ``jax.shard_map`` use bypassing the version shim. Run it via
+* ``analysis.lint`` — an AST linter with repo-tailored rules: the JAX
+  pack (JG001-JG006: host syncs inside traced functions, PRNG-key
+  hygiene, jit-boundary hygiene, python control flow on tracers, silent
+  broad excepts, direct ``jax.shard_map`` use bypassing the version
+  shim) and the concurrency pack (JG007-JG011,
+  ``analysis/concurrency/``: lock discipline, check-then-act TOCTOU,
+  blocking calls / user callbacks under a held lock, ``Condition.wait``
+  without a predicate loop). Run it via
   ``python -m distributed_mnist_bnns_tpu.cli lint``; CI fails on any
   unsuppressed finding.
 
@@ -15,6 +18,12 @@ Two halves (ANALYSIS.md is the user-facing catalog):
   guard (``jax.transfer_guard('disallow')`` around the jitted step), and
   a NaN/inf fence on the loss. Threaded through ``TrainConfig.sanitize``
   and the ``JG_SANITIZE`` env var (how CI runs tier-1).
+
+* ``analysis.sched`` — the concurrency pack's runtime half: a lock →
+  attribute trace recorder that corroborates JG007 findings against
+  actual executions, and a seeded cooperative scheduler that replays
+  adversarial interleavings deterministically (the race-regression
+  harness in tests/test_concurrency.py).
 """
 
 from .guards import (
@@ -24,11 +33,25 @@ from .guards import (
     SanitizerConfig,
     SanitizerError,
 )
+from .sched import (
+    CoopScheduler,
+    DeadlockError,
+    InstrumentedCondition,
+    InstrumentedLock,
+    TraceRecorder,
+    watch_attrs,
+)
 
 __all__ = [
+    "CoopScheduler",
+    "DeadlockError",
+    "InstrumentedCondition",
+    "InstrumentedLock",
     "NaNFenceError",
     "RecompileFenceError",
     "Sanitizer",
     "SanitizerConfig",
     "SanitizerError",
+    "TraceRecorder",
+    "watch_attrs",
 ]

@@ -1,11 +1,15 @@
-"""AST-based JAX-footgun linter (rules JG001-JG006). See ANALYSIS.md."""
+"""AST-based linter: the JAX-footgun pack (JG001-JG006) and the
+concurrency pack (JG007-JG011, ``analysis/concurrency/``). See
+ANALYSIS.md."""
 
 from .core import (
     Finding,
     LintModule,
+    changed_py_files,
     fix_suppressions,
     format_human,
     format_json,
+    format_sarif,
     run_paths,
     run_source,
 )
@@ -15,9 +19,11 @@ __all__ = [
     "Finding",
     "LintModule",
     "RULES",
+    "changed_py_files",
     "fix_suppressions",
     "format_human",
     "format_json",
+    "format_sarif",
     "run_paths",
     "run_source",
 ]

@@ -381,6 +381,156 @@ def format_json(findings: Sequence[Finding]) -> str:
     )
 
 
+def _sarif_source_root() -> str:
+    """The base result URIs are relativized against: the git toplevel
+    when available (what GitHub code scanning resolves URIs from),
+    else the working directory."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip()
+    except OSError:
+        pass
+    return os.getcwd()
+
+
+def format_sarif(findings: Sequence[Finding]) -> str:
+    """SARIF 2.1.0 — the schema CI annotation uploaders (GitHub code
+    scanning, `sarif-tools`) consume. Result URIs are repo-relative
+    (code scanning matches them against checkout paths; an absolute
+    runner path would silently anchor nothing). Suppressed findings
+    are carried with ``suppressions`` entries (SARIF's own mechanism)
+    so the reasons survive into the annotation UI; unsuppressed ones
+    become ``error``-level results, matching the exit-code gate."""
+    from .rules import RULES
+
+    rules_meta = [
+        {
+            "id": rule.id,
+            "name": rule.name,
+            "shortDescription": {"text": rule.description},
+        }
+        for rule in RULES.values()
+    ]
+    known = {r["id"] for r in rules_meta}
+    root = _sarif_source_root()
+    results = []
+    for f in findings:
+        rel = os.path.relpath(os.path.abspath(f.path), root)
+        uri = f.path if rel.startswith("..") else rel  # outside root: keep
+        result = {
+            "ruleId": f.rule,
+            "level": "note" if f.suppressed else "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": uri.replace(os.sep, "/"),
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [
+                {
+                    "kind": "inSource",
+                    "justification": f.reason or "",
+                }
+            ]
+        results.append(result)
+        if f.rule not in known:  # JG000 meta-findings
+            known.add(f.rule)
+            rules_meta.append({
+                "id": f.rule,
+                "name": "meta",
+                "shortDescription": {
+                    "text": "linter meta-finding (bad suppression or "
+                            "unparsable file)",
+                },
+            })
+    return json.dumps(
+        {
+            "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [
+                {
+                    "tool": {
+                        "driver": {
+                            "name": "jg-lint",
+                            "rules": rules_meta,
+                        }
+                    },
+                    "results": results,
+                }
+            ],
+        },
+        indent=2,
+    )
+
+
+def changed_py_files(
+    base: str = "HEAD", repo_root: Optional[str] = None
+) -> List[str]:
+    """Python files changed vs ``base`` per git (staged, unstaged and
+    untracked), for ``cli lint --changed-only``. Paths come back
+    absolute and existing-only (a deleted file has nothing to lint).
+    Raises ``RuntimeError`` when git is unavailable or the diff fails —
+    the caller decides whether that falls back to a full lint (CI
+    wants loud, a laptop wants convenient)."""
+    import subprocess
+
+    def run(cwd: str, *argv: str) -> List[str]:
+        proc = subprocess.run(
+            argv, cwd=cwd, capture_output=True, text=True, timeout=60,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(argv)} failed: {proc.stderr.strip()}"
+            )
+        return [ln for ln in proc.stdout.splitlines() if ln.strip()]
+
+    try:
+        top = run(
+            repo_root or os.getcwd(), "git", "rev-parse", "--show-toplevel",
+        )[0]
+        # Diff against the merge base (three-dot semantics), not the
+        # ref's tip: `--base origin/main` must scope to what THIS
+        # branch changed, not every file other PRs landed on main.
+        merge_base = run(top, "git", "merge-base", base, "HEAD")[0]
+        # Both listings run from the toplevel: `diff --name-only` is
+        # toplevel-relative regardless, but `ls-files --others` is
+        # cwd-relative AND cwd-scoped — from a subdirectory it would
+        # miss untracked files elsewhere and mis-join the rest.
+        listed = run(
+            top, "git", "diff", "--name-only", "--diff-filter=d",
+            merge_base, "--",
+        )
+        listed += run(
+            top, "git", "ls-files", "--others", "--exclude-standard",
+        )
+    except (OSError, RuntimeError, IndexError) as e:
+        raise RuntimeError(f"cannot compute changed files: {e}") from e
+    out = []
+    for rel in dict.fromkeys(listed):  # dedup, keep order
+        if not rel.endswith(".py"):
+            continue
+        path = os.path.join(top, rel)
+        if os.path.isfile(path):
+            out.append(path)
+    return out
+
+
 def fix_suppressions(findings: Sequence[Finding]) -> int:
     """Append a TODO suppression comment to every unsuppressed finding's
     line (skipping lines that already carry a jg: comment). Returns the

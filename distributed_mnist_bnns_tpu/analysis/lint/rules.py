@@ -522,6 +522,14 @@ def check_shard_map_compat(module: LintModule) -> List[Finding]:
     return out
 
 
+from ..concurrency.rules import (  # noqa: E402 — after Rule is defined
+    check_blocking_in_lock,
+    check_callback_in_lock,
+    check_check_then_act,
+    check_lock_discipline,
+    check_wait_predicate,
+)
+
 RULES: Dict[str, Rule] = {
     r.id: r
     for r in [
@@ -559,6 +567,37 @@ RULES: Dict[str, Rule] = {
             "direct jax.shard_map / jax.experimental.shard_map use "
             "instead of the version shim",
             check_shard_map_compat,
+        ),
+        # Concurrency pack (analysis/concurrency/rules.py): lock
+        # discipline for the threaded serving/telemetry stack.
+        Rule(
+            "JG007", "lock-discipline",
+            "guarded attribute (locked writes or '# guarded-by:') read "
+            "or written outside its lock in a lock-owning class",
+            check_lock_discipline,
+        ),
+        Rule(
+            "JG008", "check-then-act",
+            "state checked under a lock but acted on after release and "
+            "re-acquisition (TOCTOU window)",
+            check_check_then_act,
+        ),
+        Rule(
+            "JG009", "blocking-in-lock",
+            "blocking call (IO, sleep, thread join, jitted dispatch, "
+            "EventLog.emit) while holding a lock",
+            check_blocking_in_lock,
+        ),
+        Rule(
+            "JG010", "callback-in-lock",
+            "user/transition callback invoked under a held lock "
+            "(reentrancy deadlock hazard)",
+            check_callback_in_lock,
+        ),
+        Rule(
+            "JG011", "wait-needs-predicate",
+            "untimed Condition.wait() outside a while-predicate loop",
+            check_wait_predicate,
         ),
     ]
 }

@@ -373,9 +373,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "of a table")
     ln = sub.add_parser(
         "lint",
-        help="run the JAX-footgun linter (rules JG001-JG006, "
-             "ANALYSIS.md) over the package (or given paths); exit 1 "
-             "on any unsuppressed finding",
+        help="run the repo linter (JAX footguns JG001-JG006 + "
+             "concurrency JG007-JG011, ANALYSIS.md) over the package "
+             "(or given paths); exit 1 on any unsuppressed finding",
     )
     ln.add_argument("paths", nargs="*", default=None,
                     help="files/directories to lint (default: the "
@@ -384,7 +384,14 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="JGXXX",
                     help="restrict to the given rule id(s); repeatable")
     ln.add_argument("--format", default="human",
-                    choices=["human", "json"])
+                    choices=["human", "json", "sarif"])
+    ln.add_argument("--changed-only", action="store_true",
+                    help="lint only .py files git reports changed vs "
+                         "--base (plus untracked); overrides positional "
+                         "paths — the fast PR-scoped CI mode")
+    ln.add_argument("--base", default="HEAD", metavar="REF",
+                    help="git ref --changed-only diffs against "
+                         "(default: HEAD; CI uses the merge base)")
     ln.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings (with their "
                          "reasons)")
@@ -506,13 +513,31 @@ def main(argv=None) -> int:
         import os
 
         from .analysis.lint import (
+            changed_py_files,
             fix_suppressions,
             format_human,
             format_json,
+            format_sarif,
             run_paths,
         )
 
-        paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+        if args.changed_only:
+            try:
+                paths = changed_py_files(args.base)
+            except RuntimeError as e:
+                print(f"lint --changed-only: {e}", file=sys.stderr)
+                return 2
+            if not paths:
+                print("lint --changed-only: no changed .py files",
+                      file=sys.stderr)
+                print(format_json([]) if args.format == "json"
+                      else format_sarif([]) if args.format == "sarif"
+                      else "0 finding(s), 0 suppressed")
+                return 0
+        else:
+            paths = args.paths or [
+                os.path.dirname(os.path.abspath(__file__))
+            ]
         findings = run_paths(paths, rule_ids=args.rule)
         if args.fix_suppressions:
             edited = fix_suppressions(findings)
@@ -521,6 +546,8 @@ def main(argv=None) -> int:
             findings = run_paths(paths, rule_ids=args.rule)
         if args.format == "json":
             print(format_json(findings))
+        elif args.format == "sarif":
+            print(format_sarif(findings))
         else:
             print(format_human(
                 findings, show_suppressed=args.show_suppressed

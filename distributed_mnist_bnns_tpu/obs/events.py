@@ -165,6 +165,7 @@ class EventLog:
         return self._active
 
     def emit(self, kind: str, **fields: Any) -> None:
+        # jg: disable=JG007 -- lock-free fast path; the None check is re-done under the lock below, this read only skips the json encode and never acts on the handle
         if not self._active or self._fh is None:
             return
         record = {"v": SCHEMA_VERSION, "kind": kind, "ts": utc_now()}
@@ -173,10 +174,12 @@ class EventLog:
         with self._lock:
             if self._fh is None:  # closed concurrently
                 return
+            # jg: disable=JG009 -- serializing THIS write is the lock's whole job (interleaved TextIOWrapper writes mangle lines); the json encode already ran outside it
             self._fh.write(line)
             self._unflushed += 1
             if (kind not in self.BUFFERED_KINDS
                     or self._unflushed >= self._flush_every):
+                # jg: disable=JG009 -- same critical section: the flush must pair with the write it flushes; the buffered-kind policy bounds how often hot paths hit it
                 self._fh.flush()
                 self._unflushed = 0
 

@@ -160,6 +160,7 @@ class AdmissionQueue:
                 out.append(req)
                 total += req.n
             if out and claim is not None:
+                # jg: disable=JG010 -- holding the lock IS the point (PR 4 drain-race fix): claim flips the engine's busy flag under the queue lock so "queue empty AND worker idle" is never observable with a popped batch pending; it sets one bool and never re-enters the queue
                 claim()
             return out
 

@@ -150,6 +150,7 @@ class PackedInferenceServer:
         front end. Returns the bound (host, port)."""
         cfg = self.config
         fn, info = self._load_and_warm(cfg.artifact)
+        # jg: disable=JG007 -- single-threaded startup: the HTTP front end (the only other reader) starts a few lines below; later writes go through reload_artifact under _reload_lock
         self.artifact_info = dict(info)
         self.engine = ServeEngine(
             fn,
@@ -186,6 +187,7 @@ class PackedInferenceServer:
                 "chaos": self.chaos.spec or None,
                 **cfg.extra,
             },
+            # jg: disable=JG007 -- benign racy read: reload_artifact swaps the whole dict atomically (one STORE_ATTR), so this sees the old or the new mapping, never a torn one
             artifact_info=self.artifact_info,
         )
         log.info(
@@ -238,6 +240,7 @@ class PackedInferenceServer:
             "breaker": self.breaker.state,
             "queue_depth": len(self.queue),
             "batch_size": self.config.batch_size,
+            # jg: disable=JG007 -- benign racy read (atomic dict swap); taking _reload_lock here would stall /healthz behind a reload's load+warm compile, exactly a JG009 shape
             "family": self.artifact_info.get("family"),
             "uptime_s": round(time.time() - self._started_at, 3),
         }

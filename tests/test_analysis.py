@@ -460,6 +460,95 @@ def test_cli_lint_rule_filter_and_failure_exit(tmp_path, capsys):
     assert rc == 1 and "JG002" not in out
 
 
+def test_cli_lint_sarif_output(tmp_path, capsys, monkeypatch):
+    import json
+
+    from distributed_mnist_bnns_tpu.cli import main
+
+    bad = tmp_path / "lib.py"
+    bad.write_text(
+        "import jax\n"
+        "k = jax.random.PRNGKey(0)\n"
+        # jg-suppressed finding with a reason, to check the carry-over
+        "j = jax.random.PRNGKey(1)  # jg: disable=JG002 -- fixture\n"
+    )
+    monkeypatch.chdir(tmp_path)  # source root for URI relativization
+    rc = main(["lint", "--format", "sarif", str(bad)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"JG002", "JG007", "JG011"} <= rule_ids
+    by_level = {}
+    for res in run["results"]:
+        by_level.setdefault(res["level"], []).append(res)
+    assert len(by_level["error"]) == 1        # the unsuppressed PRNGKey
+    assert by_level["error"][0]["ruleId"] == "JG002"
+    (sup,) = by_level["note"]
+    assert sup["suppressions"][0]["justification"] == "fixture"
+    loc = by_level["error"][0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 2
+    # URIs are source-root-relative (GitHub code scanning can't anchor
+    # an absolute runner path to a checkout file)
+    uri = loc["artifactLocation"]["uri"]
+    assert uri.endswith("lib.py") and not uri.startswith("/")
+
+
+def test_cli_lint_changed_only(tmp_path, capsys, monkeypatch):
+    import subprocess
+
+    from distributed_mnist_bnns_tpu.cli import main
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+
+    def git(*argv):
+        subprocess.run(["git", *argv], cwd=repo, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    clean = repo / "clean.py"
+    clean.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+    git("add", "clean.py")
+    git("commit", "-qm", "seed")
+    monkeypatch.chdir(repo)
+    # nothing changed vs HEAD: no files linted, exit 0 — even though a
+    # committed file has a finding
+    rc = main(["lint", "--changed-only"])
+    out = capsys.readouterr()
+    assert rc == 0 and "no changed .py files" in out.err
+    # an untracked file with a finding IS picked up
+    dirty = repo / "dirty.py"
+    dirty.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+    rc = main(["lint", "--changed-only"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "dirty.py" in out and "clean.py" not in out
+    # merge-base semantics: files the BASE branch moved on after the
+    # branch point are not "changed" on this branch
+    git("add", "dirty.py")
+    git("commit", "-qm", "wip")
+    base_branch = subprocess.run(
+        ["git", "rev-parse", "--abbrev-ref", "HEAD"], cwd=repo,
+        check=True, capture_output=True, text=True,
+    ).stdout.strip()
+    git("checkout", "-qb", "feature")
+    git("checkout", "-q", base_branch)
+    other = repo / "other.py"
+    other.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+    git("add", "other.py")
+    git("commit", "-qm", "landed on base after branch point")
+    git("checkout", "-q", "feature")
+    mine = repo / "mine.py"
+    mine.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+    rc = main(["lint", "--changed-only", "--base", base_branch])
+    out = capsys.readouterr().out
+    assert rc == 1 and "mine.py" in out and "other.py" not in out
+
+
 # --------------------------------------------------------------------------
 # runtime sanitizers
 # --------------------------------------------------------------------------
