@@ -288,7 +288,7 @@ def _utc_now(epoch_s: float | None = None) -> str:
 SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
     "device_resident_epoch", "train_step_per_backend", "comm",
-    "lm_serve",
+    "lm_serve", "cold_start",
 )
 
 
@@ -1076,6 +1076,96 @@ def _bench_lm_serve(args, deadline):
     return out
 
 
+def _bench_cold_start(args, deadline):
+    """Cold-start benchmark (--cold-start-bench; PERF.md "Cold start"):
+    time-to-first-token for `cli serve` / `cli serve --lm` and
+    time-to-first-step for the trainer, COLD store vs WARM store, each
+    measured in a fresh subprocess (aot/coldstart.py) with a fresh jax
+    persistent compilation cache — the cold run banks the executables
+    the warm run then boots from, so the pair is exactly the
+    first-deploy vs every-later-deploy comparison the AOT store exists
+    for. The banked claim: warm first_s strictly below cold first_s
+    for both serving engines."""
+    import subprocess
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="bench_cold_")
+    store = os.path.join(work, "aot_store")
+
+    # tiny artifacts — cold-start cost is dominated by trace+compile,
+    # which these shapes exercise end to end (shared constructor with
+    # scripts/aot_smoke.py; the bench sizes its LM up slightly)
+    from distributed_mnist_bnns_tpu.aot.coldstart import (
+        make_tiny_artifacts,
+    )
+
+    cls_artifact, lm_artifact = make_tiny_artifacts(
+        work, lm_vocab=64, lm_max_len=64, lm_embed=64,
+    )
+
+    def run(mode, artifact, aot):
+        env = {
+            **os.environ,
+            # fresh XLA persistent cache per run: isolate the AOT
+            # store's win over the FULL pipeline, not just the compile
+            "JAX_COMPILATION_CACHE_DIR": tempfile.mkdtemp(dir=work),
+        }
+        cmd = [
+            sys.executable, "-m",
+            "distributed_mnist_bnns_tpu.aot.coldstart",
+            "--mode", mode, "--store", store,
+        ]
+        if artifact:
+            cmd += ["--artifact", artifact]
+        if not aot:
+            cmd += ["--no-aot"]
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"coldstart {mode} (aot={aot}) rc {proc.returncode}: "
+                f"{proc.stderr[-500:]}"
+            )
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        rec["wall_s"] = round(wall, 3)
+        return rec
+
+    section = {"store": store}
+    for mode, artifact in (
+        ("serve", cls_artifact), ("lm", lm_artifact), ("train", None),
+    ):
+        if time.monotonic() > deadline - 120:
+            section[mode] = "skipped: budget exhausted"
+            continue
+        _progress(f"cold_start: {mode} cold (store empty, banks)")
+        cold = run(mode, artifact, aot=True)   # empty store: miss+bank
+        _progress(f"cold_start: {mode} warm (store hit)")
+        warm = run(mode, artifact, aot=True)   # same store: hit
+        if cold.get("aot_status") != "miss" or \
+                warm.get("aot_status") != "hit":
+            raise RuntimeError(
+                f"cold_start {mode}: expected miss->hit, got "
+                f"{cold.get('aot_status')}->{warm.get('aot_status')}"
+            )
+        section[mode] = {
+            "cold_boot_s": round(cold["boot_s"], 3),
+            "cold_first_s": round(cold["first_s"], 3),
+            "warm_boot_s": round(warm["boot_s"], 3),
+            "warm_first_s": round(warm["first_s"], 3),
+            "cold_compiles": cold.get("compiles"),
+            "warm_compiles": warm.get("compiles"),
+            "first_speedup": round(
+                cold["first_s"] / max(warm["first_s"], 1e-9), 2
+            ),
+            "warm_beats_cold": warm["first_s"] < cold["first_s"],
+        }
+    return section
+
+
 def main() -> None:
     # Persist compiled executables across processes/windows: a cold
     # remote compile of the train step can eat a whole short hardware
@@ -1141,6 +1231,13 @@ def main() -> None:
                         "inter-token latency at 1/4/8 concurrent "
                         "streams, packed-bitplane vs dense decode "
                         "weights")
+    p.add_argument("--cold-start-bench", action="store_true",
+                   help="measure cold-store vs warm-store boot: "
+                        "time-to-first-token for cli serve and cli "
+                        "serve --lm, time-to-first-step for the "
+                        "trainer, each in a fresh subprocess against "
+                        "the AOT executable store (aot/, PERF.md "
+                        "'Cold start')")
     p.add_argument("--comm-bench", action="store_true",
                    help="also bench the DP gradient exchange: fp32 psum "
                         "vs 1-bit sign/sign_ef compression (wire "
@@ -1543,6 +1640,13 @@ def main() -> None:
             result["comm"] = _bench_comm(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["comm"] = f"failed: {e!r:.300}"
+
+    if args.cold_start_bench and time.monotonic() < deadline - 60:
+        try:
+            _progress("cold_start: AOT store cold-vs-warm boot section")
+            result["cold_start"] = _bench_cold_start(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["cold_start"] = f"failed: {e!r:.300}"
 
     if args.all_backends:
         per_backend = {}

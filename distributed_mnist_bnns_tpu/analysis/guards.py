@@ -167,6 +167,19 @@ class Sanitizer:
 
     # -- step-driven fences (recompile + NaN) --------------------------------
 
+    def pin_baseline(self, count: int) -> None:
+        """Pin the recompile-fence baseline to an explicit tracker
+        count instead of letting ``after_step`` mark it after
+        ``warmup_steps``. This is how an AOT boot-from-store (aot/,
+        PERF.md "Cold start") tightens the fence from budget-N-post-
+        warmup to budget-ZERO-post-BOOT: the server marks the tracker
+        at the very start of boot and pins it here once the store hit
+        confirms nothing should compile from that point on. The
+        classifier server also pins a large sentinel around a hot
+        reload's legitimate off-path compile and re-pins to the real
+        count afterwards."""
+        self._baseline = int(count)
+
     def after_step(
         self,
         step: Optional[int] = None,

@@ -1,0 +1,27 @@
+"""Ahead-of-time compiled-executable store — zero-compile cold starts.
+
+``cli aot build`` compiles the known jit signatures (classifier
+predict, LM chunked-prefill + decode, the train step) into a
+content-addressed on-disk store; trainer and server boots consult the
+store first and install executables instead of tracing (PERF.md "Cold
+start", ``aot_hit``/``aot_miss``/``aot_bank``/``aot_fallback`` events
+in OBSERVABILITY.md). With the store warm, both serving engines boot
+with ZERO XLA compiles and the recompile fence (analysis/guards.py)
+enforces budget 0 from boot.
+"""
+
+from .store import (  # noqa: F401
+    AotKey,
+    AotStore,
+    canonical_extra,
+    format_avals,
+    make_key,
+    sha256_hex,
+)
+from .programs import (  # noqa: F401
+    KNOWN_PROGRAMS,
+    current_code_rev,
+    load_or_compile_train_step,
+    load_packed_aot,
+    load_paged_lm_decoder_aot,
+)

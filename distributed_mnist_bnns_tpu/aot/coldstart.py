@@ -1,0 +1,201 @@
+"""Cold-start measurement worker — one boot, timed, as JSON.
+
+``bench.py --cold-start-bench`` runs this module as a SUBPROCESS (a
+cold start measured in a warm process is a lie: in-process jit caches,
+imported modules and a live backend hide exactly the cost being
+measured), twice per target: once against an empty store (cold — the
+run banks its executables on the way) and once against the store the
+first run just filled (warm). Each subprocess gets a FRESH jax
+persistent compilation cache dir, so the comparison isolates the AOT
+store's contribution over the full trace+lower+compile pipeline, not
+just the XLA half ``.jax_cache`` already skips.
+
+Three modes mirror the three boot paths:
+
+  serve   boot PackedInferenceServer, time to ready and to the first
+          /predict-equivalent response (time-to-first-token for a
+          classifier IS its first response)
+  lm      boot LMServer, time to ready and to the FIRST streamed token
+          of a generation request
+  train   construct the Trainer (includes the AOT step install), time
+          to the first completed optimizer step
+
+Output: one JSON line on stdout::
+
+  {"mode": ..., "aot": ..., "aot_status": hit|miss|disabled,
+   "boot_s": <entry -> server/trainer ready>,
+   "first_s": <entry -> first token/response/step complete>,
+   "compiles": <backend compiles observed in this process>}
+
+``boot_s``/``first_s`` count from module entry (after interpreter +
+import startup, which is identical in both runs and would otherwise
+drown the signal in noise); the parent additionally records the wall
+time of the whole subprocess.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+_T0 = time.perf_counter()
+
+
+def _elapsed() -> float:
+    return time.perf_counter() - _T0
+
+
+def make_tiny_artifacts(
+    work: str, *, lm_vocab: int = 32, lm_max_len: int = 32,
+    lm_embed: int = 32, seed: int = 0,
+):
+    """Export the tiny classifier + LM artifacts the cold-start bench
+    and the aot smoke boot from (untrained — cold-start cost is
+    weight-value-independent). ONE definition for both callers, so an
+    artifact-format change cannot drift between them. Returns
+    ``(classifier_path, lm_path)``."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import serialization
+
+    from ..infer import export_packed
+    from ..infer_transformer import _freeze_lm_tensors
+    from ..models import bnn_mlp_small
+    from ..models.transformer import BinarizedLM
+
+    root = jax.random.PRNGKey(seed)
+    cls_path = os.path.join(work, "cls.msgpack")
+    model = bnn_mlp_small(backend="xla")
+    x = jax.random.normal(jax.random.fold_in(root, 0), (8, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.fold_in(root, 1),
+         "dropout": jax.random.fold_in(root, 2)}, x, train=True,
+    )
+    export_packed(model, variables, cls_path)
+
+    lm_path = os.path.join(work, "lm.msgpack")
+    lm = BinarizedLM(
+        vocab=lm_vocab, max_len=lm_max_len, embed_dim=lm_embed,
+        depth=2, num_heads=2, attention="xla", backend="xla",
+    )
+    lv = lm.init({"params": jax.random.fold_in(root, 3)},
+                 jnp.zeros((1, 8), jnp.int32))
+    frozen = jax.tree.map(
+        lambda v: np.asarray(v) if hasattr(v, "shape") else v,
+        _freeze_lm_tensors(lm, lv),
+    )
+    with open(lm_path, "wb") as f:
+        f.write(serialization.msgpack_serialize(frozen))
+    return cls_path, lm_path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", required=True,
+                   choices=["serve", "lm", "train"])
+    p.add_argument("--artifact", default=None,
+                   help="packed artifact (serve/lm modes)")
+    p.add_argument("--store", required=True, help="AOT store root")
+    p.add_argument("--no-aot", action="store_true",
+                   help="measure the fully-online baseline instead")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--page-size", type=int, default=8)
+    p.add_argument("--model", default="bnn-mlp-small")
+    p.add_argument("--train-batch-size", type=int, default=32)
+    args = p.parse_args(argv)
+    aot = not args.no_aot
+
+    import numpy as np
+
+    from ..obs import get_tracker
+
+    tracker = get_tracker()
+    out = {"mode": args.mode, "aot": aot}
+
+    if args.mode == "serve":
+        from ..serve import PackedInferenceServer, ServeConfig
+
+        srv = PackedInferenceServer(ServeConfig(
+            artifact=args.artifact, port=0, batch_size=args.batch_size,
+            interpret=True, aot=aot, aot_dir=args.store,
+        ))
+        srv.start()
+        out["boot_s"] = _elapsed()
+        req = srv.engine.submit(
+            np.zeros((1, 28, 28, 1), np.float32),
+            deadline=time.monotonic() + 60,
+        )
+        if isinstance(req, str) or not req.event.wait(60):
+            print(json.dumps({**out, "error": f"no response ({req})"}))
+            return 1
+        out["first_s"] = _elapsed()
+        out["aot_status"] = srv.aot_status
+        srv.request_stop()
+        srv.drain_and_stop()
+
+    elif args.mode == "lm":
+        from ..serve.lm import LMServeConfig, LMServer
+
+        srv = LMServer(LMServeConfig(
+            artifact=args.artifact, port=0, slots=args.slots,
+            page_size=args.page_size, interpret=True,
+            aot=aot, aot_dir=args.store,
+        ))
+        srv.start()
+        out["boot_s"] = _elapsed()
+        req = srv.engine.submit(
+            np.array([1, 2, 3], np.int32), 4,
+            time.monotonic() + 60,
+        )
+        if isinstance(req, str):
+            print(json.dumps({**out, "error": f"shed: {req}"}))
+            return 1
+        first = req.events.get(timeout=60)
+        out["first_s"] = _elapsed()
+        out["first_kind"] = first.get("kind")
+        out["aot_status"] = srv.aot_status
+        while first.get("kind") != "done":
+            first = req.events.get(timeout=60)
+        srv.request_stop()
+        srv.drain_and_stop()
+
+    else:  # train
+        import jax.numpy as jnp
+
+        from ..train import TrainConfig, Trainer
+
+        trainer = Trainer(TrainConfig(
+            model=args.model, batch_size=args.train_batch_size,
+            epochs=1, log_interval=10 ** 9,
+            aot=aot, aot_dir=args.store,
+        ))
+        out["boot_s"] = _elapsed()
+        rng = np.random.RandomState(0)
+        images = jnp.asarray(
+            rng.rand(args.train_batch_size, 28, 28, 1).astype(np.float32)
+        )
+        labels = jnp.asarray(
+            (np.arange(args.train_batch_size) % 10).astype(np.int32)
+        )
+        state, metrics = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+        import jax
+
+        jax.block_until_ready(metrics["loss"])
+        out["first_s"] = _elapsed()
+        out["aot_status"] = trainer.aot_status
+
+    out["compiles"] = tracker.count
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,430 @@
+"""The known AOT program signatures and their store loaders.
+
+Four programs cover every hot entry point's first dispatch (PERF.md
+"Cold start"):
+
+  * ``classifier_predict`` — the packed classifier at the server's ONE
+    compiled micro-batch shape (serve/core.py's whole contract);
+  * ``lm_prefill`` / ``lm_decode`` — the continuous-batching engine's
+    exactly-two programs (infer_transformer.make_paged_lm_decoder);
+  * ``train_step`` — the single-device jitted train step (the mesh
+    dispatches re-lower per topology and stay on the online path).
+
+Each loader owns the full key construction for its program — the same
+function serves ``cli aot build`` (bank), server boot (hit → install)
+and hot reload, so the key schema cannot drift between writer and
+reader.
+
+Code revision: each program hashes the source files that define its
+traced computation (``_REV_MODULES``). Conservative by design — an
+edit to any listed module invalidates the program's entries even if
+the traced math is unchanged; a stale executable silently serving old
+code would be far worse, and ``cli aot gc`` prunes the casualties.
+
+The executables embed their closure constants (the artifact's packed
+weights, folded BN thresholds, LM embeddings), which is why every
+artifact-derived key carries the artifact file's sha256 in ``consts``:
+same shapes + different weights MUST miss.
+
+**Donation is disabled in every AOT program.** On jaxlib 0.4.37 (CPU
+PJRT) a deserialized executable with input-output aliasing double-
+frees the donated buffers — measured as nondeterministic glibc heap
+corruption ("corrupted double-linked list" / segfault, ~30% of runs)
+in the chained prefill→decode pools case. The online-jit paths keep
+their donation; the AOT variants pay one extra transient copy of the
+donated operand (KV pools / train state) per dispatch instead.
+``JG_AOT_DONATE=1`` re-enables donation for backends where the
+aliasing round-trips safely — it is part of the key, so flipping it
+cannot alias into the wrong entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .store import AotKey, AotStore, format_avals, make_key, sha256_hex
+
+log = logging.getLogger(__name__)
+
+_PKG = __package__.rsplit(".", 1)[0]  # distributed_mnist_bnns_tpu
+
+# Source modules whose text defines each program's traced computation.
+# Hashing FILES (not live objects) keeps this import-light and makes
+# the revision a pure function of the checked-out tree — what "matches
+# HEAD" means for `cli aot gc`.
+_REV_MODULES: Dict[str, Tuple[str, ...]] = {
+    "classifier_predict": (
+        f"{_PKG}.infer", f"{_PKG}.infer_conv", f"{_PKG}.infer_moe",
+        f"{_PKG}.infer_qnn", f"{_PKG}.infer_transformer",
+        f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
+        f"{_PKG}.ops.xnor_gemm",
+    ),
+    "lm_prefill": (
+        f"{_PKG}.infer_transformer", f"{_PKG}.ops.paged_kv",
+        f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
+        f"{_PKG}.ops.xnor_gemm",
+    ),
+    "lm_decode": (
+        f"{_PKG}.infer_transformer", f"{_PKG}.ops.paged_kv",
+        f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
+        f"{_PKG}.ops.xnor_gemm",
+    ),
+    "train_step": (
+        f"{_PKG}.train.trainer", f"{_PKG}.train.optim",
+        f"{_PKG}.ops.losses", f"{_PKG}.ops.binarize",
+        f"{_PKG}.ops.augment", f"{_PKG}.ops.bitpack",
+        f"{_PKG}.ops.xnor_gemm",
+        f"{_PKG}.models.registry", f"{_PKG}.models.layers",
+        f"{_PKG}.models.mlp", f"{_PKG}.models.cnn",
+        f"{_PKG}.models.bnn_cnn", f"{_PKG}.models.convnet",
+        f"{_PKG}.models.resnet", f"{_PKG}.models.transformer",
+        f"{_PKG}.models.moe",
+    ),
+}
+
+KNOWN_PROGRAMS = tuple(_REV_MODULES)
+
+_rev_cache: Dict[str, str] = {}
+
+
+def aot_donate() -> bool:
+    """Donation for AOT-compiled programs (module docstring): off by
+    default — jaxlib 0.4.37's deserialized executables double-free
+    aliased buffers; ``JG_AOT_DONATE=1`` opts back in elsewhere."""
+    import os
+
+    return os.environ.get("JG_AOT_DONATE", "") == "1"
+
+
+def current_code_rev(name: str) -> str:
+    """sha256 over the source bytes of the program's ``_REV_MODULES``
+    (plus the aot package itself — a store-format change must also
+    invalidate)."""
+    if name in _rev_cache:
+        return _rev_cache[name]
+    if name not in _REV_MODULES:
+        raise KeyError(
+            f"unknown AOT program {name!r} (have: {KNOWN_PROGRAMS})"
+        )
+    h = hashlib.sha256()
+    for mod in _REV_MODULES[name] + (f"{_PKG}.aot.store",):
+        spec = importlib.util.find_spec(mod)
+        if spec is None or not spec.origin:
+            raise RuntimeError(f"cannot locate source of module {mod}")
+        with open(spec.origin, "rb") as f:
+            h.update(f.read())
+        h.update(b"\x00")
+    _rev_cache[name] = h.hexdigest()
+    return _rev_cache[name]
+
+
+def _read_artifact(path: str) -> Tuple[Dict[str, Any], str]:
+    """(frozen dict, sha256 of the file bytes) — the bytes digest is
+    the ``consts`` key component: the executable embeds the weights."""
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        raw = f.read()
+    return serialization.msgpack_restore(raw), sha256_hex(raw)
+
+
+# ---------------------------------------------------------------------------
+# classifier predict
+# ---------------------------------------------------------------------------
+
+
+def classifier_predict_key(
+    artifact_digest: str, *, batch_size: int, input_shape, interpret: bool,
+    family: str = "",
+) -> AotKey:
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct(
+        (int(batch_size), *[int(d) for d in input_shape]), jnp.float32
+    )
+    return make_key(
+        "classifier_predict",
+        avals=format_avals(sds),
+        consts=artifact_digest,
+        extra={"interpret": bool(interpret), "family": family},
+    )
+
+
+def load_packed_aot(
+    path: str, *, batch_size: int, input_shape, interpret: bool,
+    store: AotStore,
+):
+    """AOT-aware ``infer.load_packed`` at ONE batch shape.
+
+    Returns ``(predict_fn, info, aot_meta)``. On a hit the predict fn
+    is the deserialized executable — the artifact's weights never touch
+    the device as arrays (they are baked into the program), no apply fn
+    is built, nothing traces or compiles. On a miss the normal builder
+    runs, is explicitly lowered+compiled at the batch shape, banked,
+    and the ``Compiled`` is returned (so hit and miss serve through the
+    same strict-shape call convention: the micro-batcher always pads to
+    exactly this shape).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    frozen, digest = _read_artifact(path)
+    info = dict(frozen["info"])
+    key = classifier_predict_key(
+        digest, batch_size=batch_size, input_shape=input_shape,
+        interpret=interpret, family=str(info.get("family", "")),
+    )
+
+    def build():
+        from ..infer import _build_any
+
+        fn = _build_any(frozen, interpret)
+        sds = jax.ShapeDtypeStruct(
+            (int(batch_size), *[int(d) for d in input_shape]),
+            jnp.float32,
+        )
+        return fn.lower(sds).compile()
+
+    predict_fn, status = store.load_or_compile(
+        key, build,
+        meta={"artifact": path, "family": info.get("family")},
+    )
+    return predict_fn, info, {"status": status, "digest": key.digest}
+
+
+# ---------------------------------------------------------------------------
+# paged LM decoder (prefill + decode)
+# ---------------------------------------------------------------------------
+
+
+def _lm_geometry(
+    frozen: Dict[str, Any], *, slots: int, page_size: int,
+    num_pages: Optional[int], prefill_chunk: int, max_len: Optional[int],
+) -> Dict[str, int]:
+    """Host-side mirror of ``make_paged_lm_decoder``'s geometry math
+    (validated against the real decoder on every miss, so drift cannot
+    ship silently). Needed so a HIT can build pools and page tables
+    without constructing — i.e. without tracing — the decoder."""
+    from ..ops.paged_kv import pages_needed
+
+    if frozen.get("kind") != "lm":
+        raise ValueError(
+            f"make_paged_lm_decoder needs a kind='lm' artifact, got "
+            f"{frozen.get('kind')!r}"
+        )
+    num_heads = int(frozen["num_heads"])
+    embed_dim = int(np.asarray(frozen["tok_embed"]).shape[1])
+    vocab = int(np.asarray(frozen["tok_embed"]).shape[0])
+    pos_len = int(np.asarray(frozen["pos_embed"]).shape[1])
+    n_blocks = len(frozen["blocks"])
+    max_len = pos_len if max_len is None else int(max_len)
+    if not 1 <= max_len <= pos_len:
+        raise ValueError(
+            f"max_len {max_len} outside [1, trained pos_embed length "
+            f"{pos_len}]"
+        )
+    slots = int(slots)
+    if slots < 1:
+        raise ValueError(f"need >= 1 batch slot, got {slots}")
+    page_size = int(page_size)
+    prefill_chunk = int(prefill_chunk)
+    max_pages = pages_needed(max_len, page_size)
+    if num_pages is None:
+        num_pages = slots * max_pages + 1
+    return {
+        "slots": slots, "page_size": page_size,
+        "num_pages": int(num_pages), "max_pages": max_pages,
+        "max_len": max_len, "prefill_chunk": prefill_chunk,
+        "vocab": vocab, "num_blocks": n_blocks,
+        "num_heads": num_heads, "head_dim": embed_dim // num_heads,
+    }
+
+
+def _lm_avals(geom: Dict[str, int]):
+    """(pools, prefill-args, decode-args) ShapeDtypeStruct trees for
+    the two programs' fixed signatures."""
+    import jax
+    import jax.numpy as jnp
+
+    pool = jax.ShapeDtypeStruct(
+        (geom["num_pages"], geom["page_size"], geom["num_heads"],
+         geom["head_dim"]), jnp.float32,
+    )
+    pools = tuple((pool, pool) for _ in range(geom["num_blocks"]))
+    i32 = jnp.int32
+    s = jax.ShapeDtypeStruct
+    prefill = (pools, s((geom["prefill_chunk"],), i32),
+               s((geom["max_pages"],), i32), s((), i32), s((), i32))
+    decode = (pools, s((geom["slots"],), i32),
+              s((geom["slots"], geom["max_pages"]), i32),
+              s((geom["slots"],), i32))
+    return pools, prefill, decode
+
+
+def lm_decoder_keys(
+    artifact_digest: str, geom: Dict[str, int], *, interpret: bool,
+) -> Tuple[AotKey, AotKey]:
+    _, prefill_avals, decode_avals = _lm_avals(geom)
+    extra = {**geom, "interpret": bool(interpret),
+             "donate": aot_donate()}
+    return (
+        make_key("lm_prefill", avals=format_avals(prefill_avals),
+                 consts=artifact_digest, extra=extra),
+        make_key("lm_decode", avals=format_avals(decode_avals),
+                 consts=artifact_digest, extra=extra),
+    )
+
+
+def load_paged_lm_decoder_aot(
+    path: str, *, slots: int, page_size: int = 16,
+    num_pages: Optional[int] = None, prefill_chunk: int = 16,
+    max_len: Optional[int] = None, interpret: bool = False,
+    store: AotStore,
+):
+    """AOT-aware ``make_paged_lm_decoder`` from an artifact file.
+
+    Returns ``(PagedLMDecoder, info, aot_meta)``. Hit (BOTH programs
+    present): the decoder's ``prefill``/``decode`` are deserialized
+    executables and ``init_pools`` builds the KV pools via
+    ``device_put`` of host zeros — the whole load performs **zero**
+    XLA compiles, which is what lets the engine's recompile fence pin
+    its budget-0 baseline at BOOT instead of post-warmup. Miss: the
+    real decoder is built, both programs are explicitly lowered +
+    compiled (donation preserved), banked, and returned as
+    ``Compiled``s.
+    """
+    import jax
+
+    from ..infer_transformer import PagedLMDecoder
+
+    frozen, digest = _read_artifact(path)
+    info = dict(frozen.get("info", {}))
+    geom = _lm_geometry(
+        frozen, slots=slots, page_size=page_size, num_pages=num_pages,
+        prefill_chunk=prefill_chunk, max_len=max_len,
+    )
+    key_p, key_d = lm_decoder_keys(digest, geom, interpret=interpret)
+    # All-or-nothing: only touch get() (which emits hit/miss events and
+    # counters) when BOTH programs are present — a half-present pair is
+    # a miss for the pair, and must not record an aot_hit for a program
+    # this boot then compiles anyway.
+    loaded_p = loaded_d = None
+    if store.contains(key_p) and store.contains(key_d):
+        loaded_p = store.get(key_p)
+        loaded_d = store.get(key_d) if loaded_p is not None else None
+
+    pool_shape = (geom["num_pages"], geom["page_size"],
+                  geom["num_heads"], geom["head_dim"])
+
+    def init_pools_host():
+        # device_put of host zeros: no broadcast program, no compile —
+        # distinct buffers per pool (the programs donate the pytree and
+        # XLA rejects donating one buffer twice).
+        return tuple(
+            (jax.device_put(np.zeros(pool_shape, np.float32)),
+             jax.device_put(np.zeros(pool_shape, np.float32)))
+            for _ in range(geom["num_blocks"])
+        )
+
+    if loaded_p is not None and loaded_d is not None:
+        decoder = PagedLMDecoder(
+            init_pools=init_pools_host,
+            prefill=loaded_p,
+            decode=loaded_d,
+            slots=geom["slots"], page_size=geom["page_size"],
+            num_pages=geom["num_pages"], max_pages=geom["max_pages"],
+            max_len=geom["max_len"], prefill_chunk=geom["prefill_chunk"],
+            vocab=geom["vocab"], num_blocks=geom["num_blocks"],
+        )
+        return decoder, info, {
+            "status": "hit",
+            "digests": [key_p.digest, key_d.digest],
+        }
+
+    # miss (or half an entry): build the real decoder, compile + bank
+    from ..infer_transformer import make_paged_lm_decoder
+
+    dec = make_paged_lm_decoder(
+        frozen, slots=slots, page_size=page_size, num_pages=num_pages,
+        prefill_chunk=prefill_chunk, max_len=max_len,
+        interpret=interpret,
+        donate=aot_donate(),   # see module docstring: donation +
+                               # deserialize double-frees on 0.4.37
+    )
+    derived = (geom["slots"], geom["page_size"], geom["num_pages"],
+               geom["max_pages"], geom["max_len"],
+               geom["prefill_chunk"], geom["vocab"], geom["num_blocks"])
+    actual = (dec.slots, dec.page_size, dec.num_pages, dec.max_pages,
+              dec.max_len, dec.prefill_chunk, dec.vocab, dec.num_blocks)
+    if derived != actual:
+        raise RuntimeError(
+            f"aot LM geometry drifted from make_paged_lm_decoder: "
+            f"derived {derived} != actual {actual} — fix "
+            f"aot/programs._lm_geometry"
+        )
+    _, prefill_avals, decode_avals = _lm_avals(geom)
+    comp_p = dec.prefill.lower(*prefill_avals).compile()
+    comp_d = dec.decode.lower(*decode_avals).compile()
+    meta = {"artifact": path, **geom}
+    store.put(key_p, comp_p, meta=meta)
+    store.put(key_d, comp_d, meta=meta)
+    decoder = dec._replace(
+        init_pools=init_pools_host, prefill=comp_p, decode=comp_d
+    )
+    return decoder, info, {
+        "status": "miss", "digests": [key_p.digest, key_d.digest],
+    }
+
+
+# ---------------------------------------------------------------------------
+# train step
+# ---------------------------------------------------------------------------
+
+
+def load_or_compile_train_step(
+    store: AotStore, *, jitted_step, state, images_aval, labels_aval,
+    rng, extra: Dict[str, Any],
+):
+    """AOT load/bank for the single-device jitted train step.
+
+    The step's pytree defs are NOT picklable (optax transforms in
+    ``TrainState.tx`` hold closures), so the entry stores the payload
+    only and the trees are reconstructed here from exemplars — the
+    caller's live ``state`` and input avals, which by construction
+    match the signature the executable was compiled for (the key's
+    avals field proves it).
+
+    Returns ``(step_callable, status)`` — the callable is strict about
+    shapes (``Compiled``); the Trainer keeps the online-jit step as a
+    fallback for trailing partial batches.
+    """
+    import jax
+
+    key = make_key(
+        "train_step",
+        avals=format_avals((state, images_aval, labels_aval, rng)),
+        extra=extra,
+    )
+    in_tree = jax.tree_util.tree_structure(
+        ((state, images_aval, labels_aval, rng), {})
+    )
+    metric = jax.ShapeDtypeStruct((), jax.numpy.float32)
+    out_tree = jax.tree_util.tree_structure(
+        (state, {"loss": metric, "accuracy": metric})
+    )
+
+    def build():
+        return jitted_step.lower(
+            state, images_aval, labels_aval, rng
+        ).compile()
+
+    return store.load_or_compile(
+        key, build, in_tree=in_tree, out_tree=out_tree,
+        meta={"model": extra.get("model")},
+    )

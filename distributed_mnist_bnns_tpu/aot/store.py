@@ -1,0 +1,518 @@
+"""Content-addressed store of ahead-of-time compiled XLA executables.
+
+Every trainer run and server boot used to pay online tracing + XLA
+compilation before the first useful step; the persistent ``.jax_cache``
+only removes the XLA half (tracing and lowering still run, and the
+cache key is internal to jax). This store goes the rest of the way:
+``cli aot build`` lowers and compiles the known jit signatures
+(``jax.jit(...).lower().compile()`` +
+``jax.experimental.serialize_executable``) into on-disk entries keyed
+by everything that determines the compiled program:
+
+    (program name, code revision of the traced modules, jax version,
+     backend platform, mesh, input avals (shapes + dtypes), closure
+     constants (artifact digest), static config extras)
+
+A boot that *hits* deserializes the executable and installs it — no
+trace, no lowering, no compile (``aot_hit`` event). A miss (or any
+corrupt / incompatible entry) falls back to the normal trace+compile
+and re-banks the result (``aot_miss`` / ``aot_bank``), so the store is
+self-healing: the worst case is exactly today's cold start.
+
+Robustness follows ``load_checkpoint_resilient``'s digest-verify-then-
+act discipline: the payload's sha256 is checked against the manifest
+BEFORE deserialization, and any failure (truncated payload, manifest
+parse error, jax/backEnd incompatibility surfacing as a deserialize
+error) quarantines the entry (renamed ``*.quarantined``) and emits a
+loud ``aot_fallback`` event with the reason — boot never crashes on a
+bad entry, and the bad bytes are kept aside for post-mortems instead
+of being retried forever.
+
+``jax.export`` (StableHLO) is deliberately NOT the wire format here:
+it is portable across jax versions but re-compiles at load time, which
+is the cost this store exists to remove. ``serialize_executable``
+pickles the backend-serialized *executable* — zero compile on load, at
+the price of keying on jax version + platform (which the key does).
+
+Layout::
+
+    <root>/<name>/<digest>.bin    pickle: {payload, in_tree, out_tree}
+    <root>/<name>/<digest>.json   manifest: key fields + payload sha256
+
+The manifest is written LAST (tmp + atomic rename for both files), so
+a crash mid-bank leaves an orphan ``.bin`` that ``gc`` collects, never
+a manifest pointing at missing/short bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+HITS_TOTAL = "aot_hits_total"
+MISSES_TOTAL = "aot_misses_total"
+BANKS_TOTAL = "aot_banks_total"
+FALLBACKS_TOTAL = "aot_fallbacks_total"
+
+_SCHEMA_V = 1
+
+
+def format_avals(tree: Any) -> str:
+    """Canonical string for a pytree of arrays / ShapeDtypeStructs:
+    ``f32[8,784];i32[8]`` in flattening order. Part of the cache key —
+    any shape or dtype change must miss."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    parts = []
+    for leaf in leaves:
+        shape = ",".join(str(int(d)) for d in leaf.shape)
+        parts.append(f"{jax.dtypes.canonicalize_dtype(leaf.dtype).name}"
+                     f"[{shape}]")
+    return ";".join(parts)
+
+
+def canonical_extra(extra: Dict[str, Any]) -> str:
+    """Deterministic JSON for the static-config key component."""
+    return json.dumps(extra, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class AotKey:
+    """Everything that determines the compiled program. Two keys with
+    equal digests MUST be interchangeable executables; anything that
+    changes the traced computation (code, constants, shapes, static
+    config) or its validity (jax version, backend, mesh) is a field."""
+
+    name: str           # logical program: classifier_predict, lm_decode…
+    code_rev: str       # programs.current_code_rev(name)
+    jax_version: str
+    backend: str        # jax.default_backend() at build time
+    avals: str          # format_avals of the input signature
+    mesh: str = ""      # "" = no mesh; else "axis=size,…" canonical form
+    consts: str = ""    # digest of baked-in constants (artifact bytes)
+    extra: str = ""     # canonical_extra of static config
+
+    @property
+    def digest(self) -> str:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return sha256_hex(blob)
+
+    def asdict(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+
+def make_key(
+    name: str,
+    *,
+    avals: Any,
+    consts: str = "",
+    mesh: str = "",
+    extra: Optional[Dict[str, Any]] = None,
+    code_rev: Optional[str] = None,
+) -> AotKey:
+    """Build an :class:`AotKey` with the environment fields (jax
+    version, backend) and the program's code revision filled in."""
+    import jax
+
+    if code_rev is None:
+        from .programs import current_code_rev
+
+        code_rev = current_code_rev(name)
+    return AotKey(
+        name=name,
+        code_rev=code_rev,
+        jax_version=jax.__version__,
+        backend=jax.default_backend(),
+        avals=avals if isinstance(avals, str) else format_avals(avals),
+        mesh=mesh,
+        consts=consts,
+        extra=canonical_extra(extra or {}),
+    )
+
+
+class AotStore:
+    """On-disk executable store (see module docstring).
+
+    ``telemetry`` (an obs Telemetry/EventLog) receives the
+    ``aot_hit`` / ``aot_miss`` / ``aot_bank`` / ``aot_fallback``
+    events; the hit/miss/bank/fallback counters always land in the
+    metrics registry regardless.
+    """
+
+    def __init__(self, root: Optional[str] = None, *,
+                 telemetry: Any = None, registry: Any = None):
+        from ..utils.platform import default_aot_store_dir
+
+        self.root = default_aot_store_dir(root)
+        self.telemetry = telemetry
+        if registry is None:
+            if telemetry is not None and hasattr(telemetry, "registry"):
+                registry = telemetry.registry
+            else:
+                from ..obs import default_registry
+
+                registry = default_registry()
+        self._hits = registry.counter(
+            HITS_TOTAL, "AOT store hits (boot installed a stored "
+            "executable; no trace, no compile)")
+        self._misses = registry.counter(
+            MISSES_TOTAL, "AOT store misses (normal trace+compile ran)")
+        self._banks = registry.counter(
+            BANKS_TOTAL, "executables serialized into the AOT store")
+        self._fallbacks = registry.counter(
+            FALLBACKS_TOTAL,
+            "corrupt/incompatible entries quarantined (reason label)")
+
+    # -- paths ---------------------------------------------------------------
+
+    def _entry_paths(self, key: AotKey) -> Tuple[str, str]:
+        d = os.path.join(self.root, key.name)
+        return (os.path.join(d, f"{key.digest}.bin"),
+                os.path.join(d, f"{key.digest}.json"))
+
+    def _emit(self, kind: str, **fields: Any) -> None:
+        if self.telemetry is None:
+            return
+        try:
+            self.telemetry.emit(kind, **fields)
+        except Exception:
+            # The store must never take a boot down over telemetry.
+            log.exception("aot %s event emission failed", kind)
+
+    def _quarantine(self, key: AotKey, reason: str, detail: str) -> None:
+        """Move the entry's files aside (``*.quarantined``) so the next
+        boot re-banks a fresh entry instead of re-tripping on the same
+        bad bytes, and the bad bytes stay inspectable."""
+        for path in self._entry_paths(key):
+            try:
+                if os.path.exists(path):
+                    os.replace(path, path + ".quarantined")
+            except OSError:
+                log.exception("aot quarantine of %s failed", path)
+        self._fallbacks.inc(reason=reason)
+        self._emit("aot_fallback", name=key.name, digest=key.digest,
+                   reason=reason, detail=detail[:500])
+        log.warning("aot entry %s/%s quarantined: %s (%s)", key.name,
+                    key.digest[:12], reason, detail[:200])
+
+    # Grace window for a half-written entry: put() renames the payload
+    # and then the manifest; a reader seeing only one file younger than
+    # this treats it as a bank in flight (plain miss), not corruption.
+    _IN_FLIGHT_GRACE_S = 60.0
+
+    def _in_flight(self, path: str) -> bool:
+        try:
+            return (time.time() - os.stat(path).st_mtime
+                    < self._IN_FLIGHT_GRACE_S)
+        except OSError:
+            return True    # vanished underneath us: the writer/another
+            #                reader is active — don't quarantine
+
+    def contains(self, key: AotKey) -> bool:
+        """Both entry files present — NO load, NO events/counters.
+        Multi-program loaders (the LM prefill+decode pair) use this to
+        decide all-or-nothing before any ``get``, so a partial entry
+        set cannot mint a misleading ``aot_hit`` for a program the
+        boot then compiles anyway."""
+        bin_p, man_p = self._entry_paths(key)
+        return os.path.exists(bin_p) and os.path.exists(man_p)
+
+    # -- read ----------------------------------------------------------------
+
+    def get(self, key: AotKey, *, in_tree: Any = None,
+            out_tree: Any = None) -> Optional[Callable]:
+        """Stored executable for ``key``, loaded — or None (plain miss
+        OR quarantined-corrupt entry; either way the caller falls back
+        to trace+compile and should re-bank with :meth:`put`).
+
+        ``in_tree`` / ``out_tree`` (PyTreeDefs) override the trees
+        stored in the entry — required for programs whose treedefs are
+        not picklable (the train step's optax statics); the caller
+        reconstructs them from exemplars.
+        """
+        bin_p, man_p = self._entry_paths(key)
+        if not (os.path.exists(bin_p) and os.path.exists(man_p)):
+            half = bin_p if os.path.exists(bin_p) else (
+                man_p if os.path.exists(man_p) else None
+            )
+            if half is not None and not self._in_flight(half):
+                # half an entry, and old enough that no writer is
+                # plausibly between its two renames: a crashed bank or
+                # a deleted file. A FRESH half is a concurrent put()
+                # mid-bank (payload lands before manifest) — racing
+                # replicas sharing one store must miss quietly, not
+                # destroy each other's in-flight banks.
+                self._quarantine(key, "incomplete_entry",
+                                 "payload or manifest missing")
+            else:
+                self._misses.inc(name=key.name)
+                self._emit("aot_miss", name=key.name, digest=key.digest)
+            return None
+        try:
+            with open(man_p, "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            self._quarantine(key, "corrupt_manifest",
+                             f"{type(e).__name__}: {e}")
+            return None
+        try:
+            with open(bin_p, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            self._quarantine(key, "unreadable_payload",
+                             f"{type(e).__name__}: {e}")
+            return None
+        if sha256_hex(blob) != manifest.get("payload_sha256"):
+            self._quarantine(
+                key, "payload_digest_mismatch",
+                f"{len(blob)} bytes on disk do not hash to the "
+                "manifest's payload_sha256 (truncated or tampered)")
+            return None
+        try:
+            entry = pickle.loads(blob)
+            payload = entry["payload"]
+            stored_in, stored_out = entry["in_tree"], entry["out_tree"]
+        except Exception as e:
+            self._quarantine(key, "corrupt_payload",
+                             f"{type(e).__name__}: {e}")
+            return None
+        in_tree = in_tree if in_tree is not None else stored_in
+        out_tree = out_tree if out_tree is not None else stored_out
+        if in_tree is None or out_tree is None:
+            # Banked without picklable trees and the caller supplied
+            # none: unusable as stored. Not a corruption — don't
+            # quarantine, just miss (the caller knows its trees).
+            self._misses.inc(name=key.name)
+            self._emit("aot_miss", name=key.name, digest=key.digest,
+                       reason="trees_required")
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+
+            loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as e:
+            # The deserialize path is where jax/runtime incompatibility
+            # actually surfaces (a payload built by another jax build or
+            # for a missing device topology) — same fallback as corrupt.
+            self._quarantine(key, "deserialize_error",
+                             f"{type(e).__name__}: {e}")
+            return None
+        self._hits.inc(name=key.name)
+        self._emit("aot_hit", name=key.name, digest=key.digest,
+                   payload_bytes=len(blob))
+        return loaded
+
+    # -- write ---------------------------------------------------------------
+
+    def put(self, key: AotKey, compiled: Any, *,
+            meta: Optional[Dict[str, Any]] = None) -> bool:
+        """Serialize ``compiled`` (a ``jax.stages.Compiled``) under
+        ``key``. Returns False — never raises — when the backend cannot
+        serialize executables or the write fails: banking is an
+        optimization, and a bank failure must never take down the boot
+        that just compiled successfully."""
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            try:
+                trees: Tuple[Any, Any] = (in_tree, out_tree)
+                pickle.dumps(trees)
+            except Exception as e:
+                # Unpicklable treedefs (optax statics in the train
+                # step): store payload-only; get() then needs exemplar
+                # trees from the caller.
+                log.debug(
+                    "aot %s: treedefs not picklable (%s: %s) — entry "
+                    "stored payload-only, loads need exemplar trees",
+                    key.name, type(e).__name__, e,
+                )
+                trees = (None, None)
+            blob = pickle.dumps({
+                "v": _SCHEMA_V, "payload": payload,
+                "in_tree": trees[0], "out_tree": trees[1],
+            })
+            bin_p, man_p = self._entry_paths(key)
+            os.makedirs(os.path.dirname(bin_p), exist_ok=True)
+            manifest = {
+                "v": _SCHEMA_V,
+                "name": key.name,
+                "digest": key.digest,
+                "key": key.asdict(),
+                "payload_sha256": sha256_hex(blob),
+                "payload_bytes": len(blob),
+                "trees_pickled": trees[0] is not None,
+                "created_at": time.time(),
+                "meta": meta or {},
+            }
+            for path, data in (
+                (bin_p, blob),
+                (man_p, json.dumps(manifest, indent=1).encode()),
+            ):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        except Exception as e:
+            self._fallbacks.inc(reason="bank_failed")
+            self._emit("aot_fallback", name=key.name, digest=key.digest,
+                       reason="bank_failed",
+                       detail=f"{type(e).__name__}: {e}"[:500])
+            log.warning("aot bank of %s/%s failed: %s: %s", key.name,
+                        key.digest[:12], type(e).__name__, e)
+            return False
+        self._banks.inc(name=key.name)
+        self._emit("aot_bank", name=key.name, digest=key.digest,
+                   payload_bytes=manifest["payload_bytes"])
+        log.info("aot banked %s/%s (%d bytes)", key.name,
+                 key.digest[:12], manifest["payload_bytes"])
+        return True
+
+    def load_or_compile(
+        self, key: AotKey, build: Callable[[], Any], *,
+        in_tree: Any = None, out_tree: Any = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[Callable, str]:
+        """The central path: hit → loaded executable; miss → ``build()``
+        (which must return a ``Compiled``), re-bank, return it. Returns
+        ``(executable, status)`` with status ``hit`` | ``miss``."""
+        loaded = self.get(key, in_tree=in_tree, out_tree=out_tree)
+        if loaded is not None:
+            return loaded, "hit"
+        compiled = build()
+        self.put(key, compiled, meta=meta)
+        return compiled, "miss"
+
+    # -- inventory (cli aot ls / gc) -----------------------------------------
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Manifest summaries of every entry (including quarantined and
+        orphaned files, flagged as such) — the ``cli aot ls`` view."""
+        out: List[Dict[str, Any]] = []
+        if not os.path.isdir(self.root):
+            return out
+        for name in sorted(os.listdir(self.root)):
+            d = os.path.join(self.root, name)
+            if not os.path.isdir(d):
+                continue
+            files = sorted(os.listdir(d))
+            manifests = {f[:-5] for f in files if f.endswith(".json")}
+            payloads = {f[:-4] for f in files if f.endswith(".bin")}
+            for digest in sorted(manifests | payloads):
+                row: Dict[str, Any] = {
+                    "name": name, "digest": digest,
+                    "orphan": digest not in manifests
+                    or digest not in payloads,
+                }
+                bin_p = os.path.join(d, f"{digest}.bin")
+                if os.path.exists(bin_p):
+                    st = os.stat(bin_p)
+                    row["bytes"] = st.st_size
+                    row["age_s"] = max(time.time() - st.st_mtime, 0.0)
+                man_p = os.path.join(d, f"{digest}.json")
+                if digest in manifests:
+                    try:
+                        with open(man_p, "r", encoding="utf-8") as f:
+                            m = json.load(f)
+                        row["key"] = m.get("key", {})
+                        row["created_at"] = m.get("created_at")
+                        row.setdefault("bytes", m.get("payload_bytes"))
+                    except (OSError, ValueError):
+                        row["orphan"] = True
+                out.append(row)
+            quarantined = [f for f in files if f.endswith(".quarantined")]
+            if quarantined:
+                out.append({
+                    "name": name, "digest": None,
+                    "quarantined": len(quarantined),
+                })
+        return out
+
+    def gc(self, *, dry_run: bool = False) -> Dict[str, Any]:
+        """Prune entries that can never hit again: code-rev mismatch
+        against the CURRENT source tree (the store must not grow
+        without bound across revisions), unknown program names,
+        orphaned halves, and quarantined files. Entries for other
+        jax versions/backends are also stale by construction — a
+        different environment writes different digests — and are
+        removed with reason ``environment``."""
+        import jax
+
+        from .programs import KNOWN_PROGRAMS, current_code_rev
+
+        removed: List[Dict[str, str]] = []
+        kept = 0
+        if not os.path.isdir(self.root):
+            return {"removed": removed, "kept": 0, "dry_run": dry_run}
+        current = {n: current_code_rev(n) for n in KNOWN_PROGRAMS}
+        for name in sorted(os.listdir(self.root)):
+            d = os.path.join(self.root, name)
+            if not os.path.isdir(d):
+                continue
+            # Decide per ENTRY (the manifest speaks for its payload),
+            # so dry-run reports every file a real run would delete and
+            # "kept" never counts a payload its manifest dooms.
+            doomed: Dict[str, str] = {}   # fname -> reason
+            for fname in sorted(os.listdir(d)):
+                if fname.endswith(".quarantined") or fname.endswith(".tmp"):
+                    doomed[fname] = "quarantined"
+                elif fname.endswith(".bin"):
+                    if not os.path.exists(
+                        os.path.join(d, fname[:-4] + ".json")
+                    ):
+                        doomed[fname] = "orphan_payload"
+                elif fname.endswith(".json"):
+                    digest = fname[:-5]
+                    reason = None
+                    if not os.path.exists(os.path.join(d, digest + ".bin")):
+                        reason = "orphan_manifest"
+                    elif name not in current:
+                        reason = "unknown_program"
+                    else:
+                        try:
+                            with open(os.path.join(d, fname), "r",
+                                      encoding="utf-8") as f:
+                                key = json.load(f).get("key", {})
+                        except (OSError, ValueError):
+                            reason = "corrupt_manifest"
+                        else:
+                            if key.get("code_rev") != current[name]:
+                                reason = "stale_code_rev"
+                            elif (key.get("jax_version") != jax.__version__
+                                  or key.get("backend")
+                                  != jax.default_backend()):
+                                reason = "environment"
+                    if reason is not None:
+                        doomed[fname] = reason
+                        if reason != "orphan_manifest":
+                            # a pruned manifest takes its payload along
+                            doomed.setdefault(digest + ".bin", reason)
+            for fname in sorted(os.listdir(d)):
+                reason = doomed.get(fname)
+                if reason is None:
+                    kept += 1
+                    continue
+                removed.append({"name": name, "file": fname,
+                                "reason": reason})
+                if not dry_run:
+                    try:
+                        os.remove(os.path.join(d, fname))
+                    except OSError:
+                        log.exception("aot gc could not remove %s/%s",
+                                      name, fname)
+        return {"removed": removed, "kept": kept, "dry_run": dry_run}

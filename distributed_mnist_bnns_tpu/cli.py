@@ -192,6 +192,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "memory bounded per microbatch (1F1B-class) "
                              "at the cost of recompute in backward")
         sp.add_argument("--log-file", default="log.txt")
+        sp.add_argument("--aot", action="store_true",
+                        help="consult the AOT executable store for the "
+                             "jitted train step (aot/, PERF.md 'Cold "
+                             "start'): hit = first step pays no trace/"
+                             "compile; miss = compile once and bank. "
+                             "Also enabled by JG_AOT=1")
+        sp.add_argument("--aot-dir", default=None,
+                        help="AOT store root (default: JG_AOT_STORE or "
+                             "<repo>/.jax_aot)")
         # multi-host rendezvous (replaces MASTER_ADDR/MASTER_PORT env://)
         sp.add_argument("--nodes", type=int, default=1)
         sp.add_argument("--node-rank", type=int, default=0)
@@ -305,6 +314,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run the packed kernels in interpreter mode "
                          "(default: auto - real Mosaic on TPU, "
                          "interpreter elsewhere)")
+    sv.add_argument("--aot", action="store_true",
+                    help="boot from the AOT executable store (aot/, "
+                         "PERF.md 'Cold start'): a warm store serves "
+                         "the first request with ZERO XLA compiles and "
+                         "arms the recompile fence at budget 0 from "
+                         "boot; a miss compiles as usual and re-banks. "
+                         "Build the store with `cli aot build`")
+    sv.add_argument("--aot-dir", default=None,
+                    help="AOT store root (default: JG_AOT_STORE or "
+                         "<repo>/.jax_aot)")
     sv.add_argument("--log-file", default="log.txt")
     inf = sub.add_parser(
         "infer",
@@ -399,6 +418,69 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append a TODO suppression comment to every "
                          "unsuppressed finding line (backlog burndown; "
                          "reasons still have to be written by hand)")
+    ao = sub.add_parser(
+        "aot",
+        help="ahead-of-time executable store (aot/, PERF.md 'Cold "
+             "start'): build compiles the known jit signatures into a "
+             "content-addressed store so `serve`/`serve --lm`/`train "
+             "--aot` boot with zero XLA compiles; ls/gc manage it",
+    )
+    asub = ao.add_subparsers(dest="aot_cmd", required=True)
+    ab = asub.add_parser(
+        "build",
+        help="lower+compile+bank the known signatures: any of a packed "
+             "classifier artifact (--artifact, at the serving batch "
+             "shape), a packed LM artifact (--lm-artifact, the "
+             "prefill+decode pair at the engine geometry), and the "
+             "single-device train step (--train). Keys match the "
+             "serving/trainer load paths exactly — the same loader "
+             "functions run on both sides",
+    )
+    ab.add_argument("--store", default=None,
+                    help="store root (default: JG_AOT_STORE or "
+                         "<repo>/.jax_aot)")
+    ab.add_argument("--artifact", default=None,
+                    help="packed classifier artifact (from `export`)")
+    ab.add_argument("--batch-size", type=int, default=32,
+                    help="the server's ONE compiled micro-batch shape")
+    ab.add_argument("--input-shape", type=int, nargs="+",
+                    default=[28, 28, 1])
+    ab.add_argument("--lm-artifact", default=None,
+                    help="packed LM artifact (from `lm --export`)")
+    ab.add_argument("--slots", type=int, default=4)
+    ab.add_argument("--page-size", type=int, default=16)
+    ab.add_argument("--num-pages", type=int, default=None)
+    ab.add_argument("--prefill-chunk", type=int, default=16)
+    ab.add_argument("--max-len", type=int, default=None)
+    ab.add_argument("--interpret", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="packed-kernel interpreter mode; must match "
+                         "the serving flag (part of the cache key)")
+    ab.add_argument("--train", action="store_true",
+                    help="also bank the single-device train step for "
+                         "the --model/--train-batch-size config")
+    ab.add_argument("--model", default="bnn-mlp-large")
+    ab.add_argument("--infl-ratio", type=int, default=3)
+    ab.add_argument("--train-batch-size", type=int, default=64)
+    ab.add_argument("--train-input-shape", type=int, nargs="+",
+                    default=[28, 28, 1])
+    ab.add_argument("--optimizer", default="adam")
+    ab.add_argument("--lr", type=float, default=0.01)
+    ab.add_argument("--loss", default="ce",
+                    choices=["ce", "hinge", "sqrt_hinge"])
+    ab.add_argument("--seed", type=int, default=42)
+    al = asub.add_parser("ls", help="list store entries (key, size, age)")
+    al.add_argument("--store", default=None)
+    al.add_argument("--json", action="store_true")
+    ag = asub.add_parser(
+        "gc",
+        help="prune entries that can never hit again: code revision no "
+             "longer matching the current tree, other jax versions/"
+             "backends, unknown programs, orphans, quarantined bytes",
+    )
+    ag.add_argument("--store", default=None)
+    ag.add_argument("--dry-run", action="store_true")
+    ag.add_argument("--json", action="store_true")
     return p
 
 
@@ -462,8 +544,126 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         grad_accum=args.grad_accum,
         scan_steps=args.scan_steps,
         device_data=args.device_data,
+        aot=getattr(args, "aot", False),
+        aot_dir=getattr(args, "aot_dir", None),
     )
     return Trainer(config, input_shape=input_shape)
+
+
+def _cmd_aot(args) -> int:
+    """`cli aot build|ls|gc` — manage the AOT executable store (aot/).
+    build runs the SAME loader functions the serving/trainer boot paths
+    use, so a banked key can never drift from the key a boot looks up."""
+    import json
+
+    from .aot import AotStore
+
+    if args.aot_cmd == "ls":
+        store = AotStore(args.store)
+        rows = store.entries()
+        if args.json:
+            print(json.dumps(rows, default=str))
+            return 0
+        print(f"aot store {store.root}: {len(rows)} entr"
+              f"{'y' if len(rows) == 1 else 'ies'}")
+        for r in rows:
+            if r.get("digest") is None:
+                print(f"  {r['name']}: {r['quarantined']} quarantined "
+                      "file(s) (run `aot gc`)")
+                continue
+            key = r.get("key", {})
+            age = r.get("age_s")
+            age_s = f"{age / 3600:.1f}h" if age is not None else "?"
+            size = r.get("bytes")
+            size_s = f"{size / 1024:.0f}KiB" if size else "?"
+            flag = "  ORPHAN" if r.get("orphan") else ""
+            print(f"  {r['name']}/{r['digest'][:12]}  {size_s:>8}  "
+                  f"age {age_s:>6}  rev {key.get('code_rev', '?')[:12]}"
+                  f"  {key.get('backend', '?')}/jax "
+                  f"{key.get('jax_version', '?')}  "
+                  f"avals {key.get('avals', '?')[:60]}{flag}")
+        return 0
+
+    if args.aot_cmd == "gc":
+        store = AotStore(args.store)
+        res = store.gc(dry_run=args.dry_run)
+        if args.json:
+            print(json.dumps(res))
+            return 0
+        verb = "would remove" if args.dry_run else "removed"
+        print(f"aot gc {store.root}: {verb} {len(res['removed'])} "
+              f"file(s), kept {res['kept']}")
+        for r in res["removed"]:
+            print(f"  {r['name']}/{r['file']}  ({r['reason']})")
+        return 0
+
+    # build
+    if not (args.artifact or args.lm_artifact or args.train):
+        print("aot build: nothing to build — pass --artifact, "
+              "--lm-artifact and/or --train", file=sys.stderr)
+        return 2
+    import jax
+
+    from .aot import load_packed_aot, load_paged_lm_decoder_aot
+
+    store = AotStore(args.store)
+    interpret = (
+        jax.default_backend() != "tpu"
+        if args.interpret is None else args.interpret
+    )
+    built = []
+    if args.artifact:
+        _, info, meta = load_packed_aot(
+            args.artifact,
+            batch_size=args.batch_size,
+            input_shape=tuple(args.input_shape),
+            interpret=interpret,
+            store=store,
+        )
+        built.append({
+            "program": "classifier_predict", "artifact": args.artifact,
+            "family": info.get("family"), **meta,
+        })
+    if args.lm_artifact:
+        _, info, meta = load_paged_lm_decoder_aot(
+            args.lm_artifact,
+            slots=args.slots,
+            page_size=args.page_size,
+            num_pages=args.num_pages,
+            prefill_chunk=args.prefill_chunk,
+            max_len=args.max_len,
+            interpret=interpret,
+            store=store,
+        )
+        built.append({
+            "program": "lm_prefill+lm_decode",
+            "artifact": args.lm_artifact, **meta,
+        })
+    if args.train:
+        from .train import TrainConfig, Trainer
+
+        model_kwargs = (
+            {"infl_ratio": args.infl_ratio}
+            if args.model.startswith("bnn-mlp") else {}
+        )
+        trainer = Trainer(
+            TrainConfig(
+                model=args.model, model_kwargs=model_kwargs,
+                batch_size=args.train_batch_size,
+                optimizer=args.optimizer, learning_rate=args.lr,
+                loss=args.loss, seed=args.seed,
+                aot=True, aot_dir=store.root,
+            ),
+            input_shape=tuple(args.train_input_shape),
+        )
+        built.append({
+            "program": "train_step", "model": args.model,
+            "status": trainer.aot_status,
+        })
+    # "hit" = the entry was already banked and verified loadable —
+    # build is idempotent.
+    print(json.dumps({"store": store.root, "built": built}))
+    return 0
 
 
 def _fit_resumable(fit_fn):
@@ -553,6 +753,9 @@ def main(argv=None) -> int:
                 findings, show_suppressed=args.show_suppressed
             ))
         return 1 if any(not f.suppressed for f in findings) else 0
+
+    if args.cmd == "aot":
+        return _cmd_aot(args)
 
     if args.cmd == "telemetry":
         # Pure host-side log reading: no jax backend, no logging setup
@@ -688,6 +891,8 @@ def main(argv=None) -> int:
                 chaos=args.chaos,
                 seed=args.seed,
                 interpret=args.interpret,
+                aot=args.aot,
+                aot_dir=args.aot_dir,
             ))
             return lm_server.run()
 
@@ -713,6 +918,8 @@ def main(argv=None) -> int:
             chaos=args.chaos,
             seed=args.seed,
             interpret=args.interpret,
+            aot=args.aot,
+            aot_dir=args.aot_dir,
         ))
         return server.run()
 

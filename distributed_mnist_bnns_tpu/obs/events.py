@@ -41,6 +41,12 @@ Event kinds (schema v1):
   lm_decode      periodic decode-iteration snapshot (active streams,
                  iteration latency, page occupancy, recompile count)
   lm_decode_error a decode dispatch failed and was retried (serve/lm/)
+  aot_hit        a boot installed a stored AOT executable — no trace,
+                 no compile (aot/, PERF.md "Cold start")
+  aot_miss       the AOT store had no entry; normal compile + re-bank
+  aot_bank       an executable was serialized into the AOT store
+  aot_fallback   a corrupt/incompatible AOT entry was quarantined and
+                 the boot fell back to online compile (reason field)
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's

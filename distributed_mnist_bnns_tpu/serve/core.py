@@ -35,6 +35,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..analysis.guards import RecompileFenceError
+
 log = logging.getLogger(__name__)
 
 REQUESTS_TOTAL = "serve_requests_total"
@@ -186,6 +188,7 @@ class ServeEngine:
         telemetry: Any = None,
         stall_timeout_s: float = 1.0,
         linger_s: float = 0.002,
+        sanitizer: Any = None,
     ):
         self.predict_fn = predict_fn
         self.batch_size = int(batch_size)
@@ -195,6 +198,15 @@ class ServeEngine:
         self.telemetry = telemetry
         self.stall_timeout_s = float(stall_timeout_s)
         self.linger_s = float(linger_s)
+        # Recompile fence (analysis/guards.Sanitizer), armed by the
+        # server when the boot came fully from the AOT store: the ONE
+        # compiled batch shape means there is nothing left to compile,
+        # so any post-boot XLA compile is a bug (a shape leak minting a
+        # second jit signature) and must fail loudly rather than ship
+        # as silent per-batch compile stalls. None = unfenced (today's
+        # behavior for cold boots).
+        self.sanitizer = sanitizer
+        self.fence_error: Optional[str] = None
         self.batch_seq = 0
         self.draining = False
         self._stop = threading.Event()
@@ -228,6 +240,12 @@ class ServeEngine:
         string (``draining`` | ``breaker_open`` | ``queue_full``)."""
         if self.draining or self._stop.is_set():
             return self._shed("draining")
+        if self.fence_error is not None:
+            # The fence killed the worker: queueing would strand the
+            # request until its deadline. Shed immediately and visibly
+            # (health() reports failed) — same contract as the LM
+            # engine's engine_failed.
+            return self._shed("engine_failed")
         if not self.breaker.admits():
             return self._shed("breaker_open")
         req = Request(images, deadline)
@@ -268,6 +286,15 @@ class ServeEngine:
                 continue
             try:
                 self._process(reqs)
+            except RecompileFenceError as e:
+                # Budget-0 fence (AOT boot-from-store): a post-boot
+                # compile broke the zero-compile contract. The batch's
+                # requests were already resolved (the fence check runs
+                # after delivery); fail the ENGINE loudly — /healthz
+                # reports failed, submit() sheds engine_failed.
+                self.fence_error = str(e)
+                log.error("serve-engine recompile fence tripped: %s", e)
+                return
             except Exception:
                 # The worker must outlive ANY per-batch failure (e.g. a
                 # full disk erroring the telemetry write): a dead worker
@@ -352,6 +379,10 @@ class ServeEngine:
             offset += r.n
             self._finish(r, "ok", log_probs=rows, infer_s=dt,
                          queue_s=waits[r.id])
+        if self.sanitizer is not None:
+            # After delivery, so a trip never strands this batch's
+            # clients waiting on their deadlines.
+            self.sanitizer.after_step(step=self.batch_seq)
 
     def _finish(self, req: Request, status: str, *,
                 log_probs: Optional[np.ndarray] = None, error: str = "",

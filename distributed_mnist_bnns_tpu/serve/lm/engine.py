@@ -162,6 +162,7 @@ class LMEngine:
         decode_event_every: int = 50,
         max_consecutive_failures: int = 4,
         recompile_fence: bool = True,
+        boot_compile_baseline: Optional[int] = None,
     ):
         self.decoder = decoder
         self.telemetry = telemetry
@@ -184,6 +185,12 @@ class LMEngine:
         self._closed = False           # set by the final queue drain
         self.batch_seq = 0             # decode iterations dispatched
         self._consecutive_failures = 0
+        # AOT boot-from-store (aot/, PERF.md "Cold start"): the server
+        # passes the tracker mark it took BEFORE loading the decoder,
+        # tightening the budget-0 fence from post-warmup to post-BOOT —
+        # with stored executables, even the warmup dispatches must not
+        # compile. None (cold boot) keeps the post-warmup baseline.
+        self._boot_baseline = boot_compile_baseline
         self._compile_baseline: Optional[int] = None
         self.fence_error: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
@@ -261,9 +268,14 @@ class LMEngine:
         pools = dec.init_pools()
         zeros_c = np.zeros(dec.prefill_chunk, np.int32)
         zeros_p = np.zeros(dec.max_pages, np.int32)
+        # Scalars go in as 0-d int32 ndarrays (device_put), NOT numpy
+        # scalars: jnp.asarray(np.int32(0)) eagerly compiles a convert
+        # program, which an AOT boot-from-store (budget-0 fence pinned
+        # at the BOOT mark) counts as a fence violation.
         pools, lp = dec.prefill(
             pools, jnp.asarray(zeros_c), jnp.asarray(zeros_p),
-            jnp.asarray(np.int32(0)), jnp.asarray(np.int32(0)),
+            jnp.asarray(np.asarray(0, np.int32)),
+            jnp.asarray(np.asarray(0, np.int32)),
         )
         jax.block_until_ready(lp)
         pools, lp = dec.decode(
@@ -272,11 +284,15 @@ class LMEngine:
         )
         jax.block_until_ready(lp)
         self._pools = pools
-        self._compile_baseline = self._tracker.mark()
+        self._compile_baseline = (
+            self._boot_baseline if self._boot_baseline is not None
+            else self._tracker.mark()
+        )
         if self._sanitizer is not None:
-            # Pins the fence baseline at the post-warmup count; every
+            # Pin the fence baseline: post-warmup for a cold boot, the
+            # server's pre-load BOOT mark for an AOT store hit; every
             # later after_step enforces budget 0 against it.
-            self._sanitizer.after_step(step=0)
+            self._sanitizer.pin_baseline(self._compile_baseline)
         self._thread = threading.Thread(
             target=self._run, name="lm-engine", daemon=True
         )
@@ -520,14 +536,17 @@ class LMEngine:
         prompt = np.zeros(padded, np.int32)
         prompt[:plen] = req.prompt
         table_j = jnp.asarray(table)
-        length_j = jnp.asarray(np.int32(plen))
+        # 0-d ndarrays, not numpy scalars: a scalar would eagerly
+        # compile a convert program and trip the boot-pinned fence.
+        length_j = jnp.asarray(np.asarray(plen, np.int32))
         lp_last = None
         last_start = 0
         try:
             for start in range(0, padded, chunk):
                 self._pools, clp = dec.prefill(
                     self._pools, jnp.asarray(prompt[start:start + chunk]),
-                    table_j, jnp.asarray(np.int32(start)), length_j,
+                    table_j, jnp.asarray(np.asarray(start, np.int32)),
+                    length_j,
                 )
                 lp_last = clp
                 last_start = start

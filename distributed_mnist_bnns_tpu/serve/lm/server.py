@@ -78,6 +78,13 @@ class LMServeConfig:
     chaos: Optional[str] = None
     seed: int = 0
     interpret: Optional[bool] = None    # None: Mosaic on TPU else interp
+    aot: bool = False                   # consult the AOT executable
+                                        # store (aot/): hit = zero-
+                                        # compile boot, fence budget 0
+                                        # from BOOT; miss = compile +
+                                        # re-bank
+    aot_dir: Optional[str] = None       # store root (JG_AOT_STORE /
+                                        # <repo>/.jax_aot default)
 
 
 class LMServer:
@@ -100,6 +107,7 @@ class LMServer:
         self.engine: Optional[LMEngine] = None
         self.artifact_info: Dict[str, Any] = {}
         self.vocab = 0
+        self.aot_status: Optional[str] = None
 
     def _interpret(self) -> bool:
         if self.config.interpret is not None:
@@ -110,33 +118,59 @@ class LMServer:
 
     def start(self) -> Tuple[str, int]:
         cfg = self.config
-        from flax import serialization
+        from ...obs import get_tracker
 
-        from ...infer_transformer import make_paged_lm_decoder
+        # Boot mark BEFORE the artifact load: an AOT store hit must
+        # perform zero compiles from HERE (the fence baseline), not
+        # merely post-warmup.
+        boot_mark = get_tracker().mark()
+        if cfg.aot:
+            from ...aot import AotStore, load_paged_lm_decoder_aot
 
-        with open(cfg.artifact, "rb") as f:
-            frozen = serialization.msgpack_restore(f.read())
-        if frozen.get("info", {}).get("kind") != "lm" and \
-                frozen.get("kind") != "lm":
-            raise ValueError(
-                f"{cfg.artifact} is not a packed LM artifact"
+            decoder, info, aot_meta = load_paged_lm_decoder_aot(
+                cfg.artifact,
+                slots=cfg.slots,
+                page_size=cfg.page_size,
+                num_pages=cfg.num_pages,
+                prefill_chunk=cfg.prefill_chunk,
+                max_len=cfg.max_len,
+                interpret=self._interpret(),
+                store=AotStore(cfg.aot_dir, telemetry=self.telemetry),
             )
-        self.artifact_info = dict(frozen.get("info", {}))
-        decoder = make_paged_lm_decoder(
-            frozen,
-            slots=cfg.slots,
-            page_size=cfg.page_size,
-            num_pages=cfg.num_pages,
-            prefill_chunk=cfg.prefill_chunk,
-            max_len=cfg.max_len,
-            interpret=self._interpret(),
-        )
+            self.artifact_info = info
+            self.aot_status = aot_meta["status"]
+        else:
+            from flax import serialization
+
+            from ...infer_transformer import make_paged_lm_decoder
+
+            with open(cfg.artifact, "rb") as f:
+                frozen = serialization.msgpack_restore(f.read())
+            if frozen.get("info", {}).get("kind") != "lm" and \
+                    frozen.get("kind") != "lm":
+                raise ValueError(
+                    f"{cfg.artifact} is not a packed LM artifact"
+                )
+            self.artifact_info = dict(frozen.get("info", {}))
+            decoder = make_paged_lm_decoder(
+                frozen,
+                slots=cfg.slots,
+                page_size=cfg.page_size,
+                num_pages=cfg.num_pages,
+                prefill_chunk=cfg.prefill_chunk,
+                max_len=cfg.max_len,
+                interpret=self._interpret(),
+            )
+            self.aot_status = "disabled"
         self.vocab = decoder.vocab
         self.engine = LMEngine(
             decoder,
             queue_depth=cfg.queue_depth,
             telemetry=self.telemetry,
             chaos=self.chaos if self.chaos.active else None,
+            boot_compile_baseline=(
+                boot_mark if self.aot_status == "hit" else None
+            ),
         ).start()
         server = self
 
@@ -163,6 +197,7 @@ class LMServer:
                 "queue_depth": cfg.queue_depth,
                 "default_deadline_ms": cfg.default_deadline_ms,
                 "chaos": self.chaos.spec or None,
+                "aot": self.aot_status,
             },
             artifact_info=self.artifact_info,
         )
@@ -193,6 +228,7 @@ class LMServer:
             "recompiles_post_warmup": eng.recompiles_post_warmup,
             "fence_error": eng.fence_error,
             "max_len": eng.max_len,
+            "aot": self.aot_status,
             "uptime_s": round(time.time() - self._started_at, 3),
         }
 

@@ -56,6 +56,7 @@ _WAIT_SLACK_S = 0.05
 
 _SHED_HTTP = {
     "queue_full": 503, "breaker_open": 503, "draining": 503,
+    "engine_failed": 503,
 }
 
 
@@ -80,6 +81,14 @@ class ServeConfig:
     chaos: Optional[str] = None      # RESILIENCE.md spec (or JG_CHAOS)
     seed: int = 0
     interpret: Optional[bool] = None  # None: Mosaic on TPU, else interp
+    aot: bool = False                # consult the AOT executable store
+                                     # (aot/, PERF.md "Cold start"):
+                                     # hit = zero-compile boot + the
+                                     # recompile fence armed at budget
+                                     # 0 from BOOT; miss = normal
+                                     # compile, re-banked for next time
+    aot_dir: Optional[str] = None    # store root (default: JG_AOT_STORE
+                                     # or <repo>/.jax_aot)
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -110,6 +119,19 @@ class PackedInferenceServer:
         self._started_at = time.time()
         self.engine: Optional[ServeEngine] = None
         self.artifact_info: Dict[str, Any] = {}
+        self._aot_store = None
+        if config.aot:
+            from ..aot import AotStore
+
+            self._aot_store = AotStore(
+                config.aot_dir, telemetry=self.telemetry
+            )
+        self.aot_status: Optional[str] = None
+        from ..obs import get_tracker
+
+        self._tracker = get_tracker()
+        self._boot_mark: Optional[int] = None
+        self._engine_sanitizer = None
         # Request-body cap: a full micro-batch of JSON floats (~32
         # chars/value incl. separators) plus headroom, floored at 1 MiB.
         # Enforced BEFORE the body is read — overload protection must
@@ -133,15 +155,34 @@ class PackedInferenceServer:
     def _load_and_warm(self, path: str):
         """load_packed + one padded-shape call, OFF the serving path:
         the compile happens before the swap (or before the first
-        request), so traffic never waits on XLA."""
-        from ..infer import load_packed
+        request), so traffic never waits on XLA.
 
-        fn, info = load_packed(path, interpret=self._interpret())
+        With ``aot`` enabled the AOT store is consulted first: a hit
+        deserializes the stored executable (no trace, no compile — the
+        warm call below just faults the program in); a miss compiles
+        exactly as before and re-banks the executable. Returns
+        ``(fn, info, aot_meta)``.
+        """
+        if self._aot_store is not None:
+            from ..aot import load_packed_aot
+
+            fn, info, meta = load_packed_aot(
+                path,
+                batch_size=self.config.batch_size,
+                input_shape=self.config.input_shape,
+                interpret=self._interpret(),
+                store=self._aot_store,
+            )
+        else:
+            from ..infer import load_packed
+
+            fn, info = load_packed(path, interpret=self._interpret())
+            meta = {"status": "disabled"}
         warm = np.zeros(
             (self.config.batch_size, *self.config.input_shape), np.float32
         )
         np.asarray(fn(warm))
-        return fn, info
+        return fn, info, meta
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -149,7 +190,27 @@ class PackedInferenceServer:
         """Load + warm the artifact, start the engine and the HTTP
         front end. Returns the bound (host, port)."""
         cfg = self.config
-        fn, info = self._load_and_warm(cfg.artifact)
+        # Boot mark BEFORE the artifact load: "zero compiles post-boot"
+        # means from here, not from post-warmup.
+        self._boot_mark = self._tracker.mark()
+        fn, info, aot_meta = self._load_and_warm(cfg.artifact)
+        # jg: disable=JG007 -- single-threaded startup (the HTTP front end starts below); later writes happen inside reload_artifact under _reload_lock
+        self.aot_status = aot_meta.get("status")
+        # jg: disable=JG007 -- same single-threaded-startup read as the write one line up
+        if self.aot_status == "hit":
+            # Everything came from the store: nothing is left to
+            # compile, so arm the recompile fence at budget ZERO from
+            # the boot mark (ROADMAP item 3's tightened contract; the
+            # cold path keeps today's unfenced behavior and re-banks).
+            from ..analysis.guards import Sanitizer, SanitizerConfig
+
+            self._engine_sanitizer = Sanitizer(
+                SanitizerConfig(recompile_fence=True,
+                                recompile_budget=0, warmup_steps=0),
+                telemetry=self.telemetry,
+                registry=self.telemetry.registry,
+            )
+            self._engine_sanitizer.pin_baseline(self._boot_mark)
         # jg: disable=JG007 -- single-threaded startup: the HTTP front end (the only other reader) starts a few lines below; later writes go through reload_artifact under _reload_lock
         self.artifact_info = dict(info)
         self.engine = ServeEngine(
@@ -161,6 +222,7 @@ class PackedInferenceServer:
             telemetry=self.telemetry,
             stall_timeout_s=cfg.stall_timeout_s,
             linger_s=cfg.linger_ms / 1e3,
+            sanitizer=self._engine_sanitizer,
         ).start()
         server = self
 
@@ -185,6 +247,8 @@ class PackedInferenceServer:
                 "breaker_threshold": cfg.breaker_threshold,
                 "breaker_reset_s": cfg.breaker_reset_s,
                 "chaos": self.chaos.spec or None,
+                # jg: disable=JG007 -- benign racy read (atomic str attr): manifest records the boot-time status; reload re-writes it atomically
+                "aot": self.aot_status,
                 **cfg.extra,
             },
             # jg: disable=JG007 -- benign racy read: reload_artifact swaps the whole dict atomically (one STORE_ATTR), so this sees the old or the new mapping, never a torn one
@@ -222,26 +286,58 @@ class PackedInferenceServer:
         half-built one."""
         path = path or self.config.artifact
         with self._reload_lock:  # serialize concurrent admin calls
-            fn, info = self._load_and_warm(path)
-            assert self.engine is not None
-            self.engine.swap_predictor(fn)
-            self.artifact_info = dict(info)
+            if self._engine_sanitizer is not None:
+                # A reload that MISSES the store compiles off-path —
+                # legitimately. Park the budget-0 fence on a sentinel
+                # for the duration (the worker keeps serving and keeps
+                # calling after_step), then re-pin to the post-reload
+                # count so the zero-compile contract resumes. A reload
+                # served FROM the store re-pins to an unchanged count.
+                self._engine_sanitizer.pin_baseline(1 << 62)
+            try:
+                fn, info, aot_meta = self._load_and_warm(path)
+                assert self.engine is not None
+                self.engine.swap_predictor(fn)
+                self.artifact_info = dict(info)
+                # /healthz must describe the SERVING artifact's load,
+                # not the boot's — a reload that missed the store is
+                # visible (alongside the nonzero recompiles_post_boot
+                # its off-path compile produced).
+                self.aot_status = aot_meta.get("status")
+            finally:
+                if self._engine_sanitizer is not None:
+                    self._engine_sanitizer.pin_baseline(
+                        self._tracker.count
+                    )
         # info nests under its own field: transformer artifacts carry a
         # "kind" key that would collide with the event envelope's kind.
-        self.telemetry.emit("reload", artifact=path, info=dict(info))
+        self.telemetry.emit("reload", artifact=path, info=dict(info),
+                            aot=aot_meta.get("status"))
         log.info("hot-reloaded artifact %s (%s)", path, info.get("family"))
         return dict(info)
 
     def health(self) -> Dict[str, Any]:
+        eng = self.engine
+        if eng is not None and eng.fence_error is not None:
+            status = "failed"          # load balancers must route away
+        elif eng is not None and eng.draining:
+            status = "draining"
+        else:
+            status = "ok"
         return {
-            "status": "draining" if (
-                self.engine is not None and self.engine.draining
-            ) else "ok",
+            "status": status,
             "breaker": self.breaker.state,
             "queue_depth": len(self.queue),
             "batch_size": self.config.batch_size,
             # jg: disable=JG007 -- benign racy read (atomic dict swap); taking _reload_lock here would stall /healthz behind a reload's load+warm compile, exactly a JG009 shape
             "family": self.artifact_info.get("family"),
+            # jg: disable=JG007 -- benign racy read (atomic str attr swap); same rationale as family above — /healthz must not block behind a reload compile
+            "aot": self.aot_status,
+            "recompiles_post_boot": (
+                self._tracker.count - self._boot_mark
+                if self._boot_mark is not None else None
+            ),
+            "fence_error": eng.fence_error if eng is not None else None,
             "uptime_s": round(time.time() - self._started_at, 3),
         }
 

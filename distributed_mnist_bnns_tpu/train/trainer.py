@@ -591,6 +591,17 @@ class TrainConfig:
     handle_preemption: bool = True  # SIGTERM/SIGINT -> graceful stop at
                                    # the next step boundary + mid-epoch
                                    # checkpoint + Preempted (exit 75)
+    aot: bool = False              # consult the AOT executable store
+                                   # (aot/, PERF.md "Cold start") for
+                                   # the jitted train step: hit =
+                                   # time-to-first-step pays no trace/
+                                   # compile; miss = compile once and
+                                   # re-bank. Single-device dispatch
+                                   # only (mesh topologies re-lower
+                                   # online). False also consults the
+                                   # JG_AOT env var.
+    aot_dir: Optional[str] = None  # store root (default JG_AOT_STORE
+                                   # or <repo>/.jax_aot)
 
 
 def _prefetch_chunks(items, size: int = 2):
@@ -699,6 +710,8 @@ class Trainer:
         self.batch_meter = AverageMeter()
         self._setup_telemetry(input_shape)
         self._setup_sanitizer()
+        self.aot_status: Optional[str] = None
+        self._maybe_aot_train_step(input_shape)
         # Preemption + chaos (resilience/, RESILIENCE.md): the stop flag
         # is polled at step boundaries; the chaos controller is inactive
         # unless TrainConfig.chaos / JG_CHAOS scripts faults. A chaos
@@ -932,6 +945,107 @@ class Trainer:
             if cfg.nan_check_every is not None:
                 san.nan_check_every = max(int(cfg.nan_check_every), 1)
         self.sanitizer = Sanitizer(san, telemetry=self.telemetry)
+
+    def _maybe_aot_train_step(self, input_shape) -> None:
+        """AOT executable store for the single-device jitted train step
+        (aot/, PERF.md "Cold start"): on a hit, ``self.train_step``
+        becomes the deserialized executable — the first step pays no
+        trace, no lowering, no compile; a miss compiles once (exactly
+        today's cost, just explicitly) and banks the executable for the
+        next cold start. The online-jit step is kept as a fallback for
+        any non-standard batch (a trailing partial batch, a regime
+        switch that drifted an aval), so AOT can never change WHAT
+        runs, only when it compiles. ``TrainConfig.aot`` or ``JG_AOT``
+        enables; mesh/scan/device-data dispatches stay online (their
+        topology-specific lowerings are re-derived per run)."""
+        import os
+
+        cfg = self.config
+        if not (cfg.aot or os.environ.get("JG_AOT")):
+            return
+        if (
+            self.mesh is not None
+            or int(cfg.scan_steps) > 1
+            or cfg.device_data
+            or cfg.pipeline_parallel > 1
+            or cfg.tensor_parallel > 1
+            or cfg.grad_compress != "none"
+            or jax.process_count() > 1
+        ):
+            self.aot_status = "unsupported_dispatch"
+            log.info(
+                "aot: train-step store covers the single-device jit "
+                "dispatch only; this run's dispatch (mesh/scan/device-"
+                "data) stays on the online path"
+            )
+            return
+        from ..aot import AotStore, load_or_compile_train_step
+
+        from ..aot.programs import aot_donate
+
+        donate = aot_donate()
+        mk = {k: cfg.model_kwargs[k] for k in sorted(cfg.model_kwargs)}
+        extra = {
+            "model": cfg.model, "model_kwargs": mk,
+            "optimizer": cfg.optimizer, "loss": cfg.loss,
+            "label_smoothing": cfg.label_smoothing,
+            "augment": cfg.augment, "precision": cfg.precision,
+            "grad_accum": cfg.grad_accum, "remat": cfg.remat,
+            "clip_grad_norm": cfg.clip_grad_norm,
+            "backend": cfg.backend, "donate": donate,
+        }
+        images_aval = jax.ShapeDtypeStruct(
+            (cfg.batch_size, *input_shape), jnp.float32
+        )
+        labels_aval = jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32)
+        # The AOT variant is compiled WITHOUT state donation (unless
+        # JG_AOT_DONATE=1): jaxlib 0.4.37's deserialized executables
+        # double-free donated buffers (aot/programs.py). One transient
+        # state copy per step is the price; the online fallback keeps
+        # its donation.
+        aot_jit = make_train_step(
+            self.clamp_mask, loss_fn=self._loss_fn, remat=cfg.remat,
+            grad_accum=cfg.grad_accum, augment=cfg.augment,
+            donate=donate,
+        )
+        try:
+            store = AotStore(cfg.aot_dir, telemetry=self.telemetry)
+            compiled, status = load_or_compile_train_step(
+                store,
+                jitted_step=aot_jit,
+                state=self.state,
+                images_aval=images_aval,
+                labels_aval=labels_aval,
+                rng=self.rng,
+                extra=extra,
+            )
+        except Exception:
+            # The store is an optimization; training must never fail
+            # over it (a full disk, an unserializable backend, …).
+            log.exception("aot train-step load failed; online jit path")
+            self.aot_status = "error"
+            return
+        self.aot_status = status
+        fallback = self.train_step
+        expected = tuple(images_aval.shape)
+        dead = []  # aval drift kills the executable, not the run
+
+        def step(state, images, labels, rng):
+            if not dead and tuple(images.shape) == expected:
+                try:
+                    return compiled(state, images, labels, rng)
+                except (TypeError, ValueError) as e:
+                    # e.g. a checkpoint restore / regime change altered
+                    # an aval the key was built from — aval checking
+                    # runs before execution, so state was not donated.
+                    dead.append(str(e))
+                    log.warning(
+                        "aot train step rejected its inputs (%s); "
+                        "falling back to the online jit permanently", e,
+                    )
+            return fallback(state, images, labels, rng)
+
+        self.train_step = step
 
     def _record_step(self, per_step_s: float, n: int, seen: int,
                      metrics: Optional[Dict[str, float]] = None) -> None:

@@ -79,6 +79,27 @@ def enable_persistent_compilation_cache(path: str | None = None) -> str:
     return cache_dir
 
 
+def default_aot_store_dir(path: str | None = None) -> str:
+    """Resolve the AOT executable store directory (aot/, PERF.md "Cold
+    start"): ``JG_AOT_STORE`` wins, then ``path``, then
+    ``<repo-root>/.jax_aot`` derived from this package's location — the
+    same derivation (and the same env-wins precedence) as the
+    ``.jax_cache`` persistent compilation cache above, so every entry
+    point (cli serve, cli aot build, bench, tests) shares one store with
+    a no-arg call. Unlike the compilation cache this stores fully
+    *loaded-and-keyed* executables: a hit skips tracing AND lowering,
+    not just the XLA compile."""
+    return (
+        os.environ.get("JG_AOT_STORE")
+        or path
+        or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            ".jax_aot",
+        )
+    )
+
+
 def pin_platform(
     platform: str, virtual_device_count: int | None = None
 ) -> bool:

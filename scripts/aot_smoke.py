@@ -1,0 +1,242 @@
+"""AOT smoke (CI): zero-compile cold starts must actually be zero.
+
+The end-to-end proof of PERF.md "Cold start": export tiny classifier
+and LM artifacts, `cli aot build` the store in one subprocess, then
+boot the REAL `cli serve` and `cli serve --lm` servers from it (fresh
+processes, fresh jax persistent cache) and assert from /healthz that
+
+  * the boot was an AOT hit,
+  * ``recompiles_post_boot`` / ``recompiles_post_warmup`` == 0 — from
+    BOOT, not merely post-warmup (the fence baseline is pinned at the
+    pre-load mark on a hit),
+  * real traffic round-trips (predict + a streamed generation),
+  * a hot reload served FROM the store keeps the count at zero,
+  * SIGTERM drains to exit 0 (the budget-0 fence stayed green for the
+    whole run).
+
+Usage: python scripts/aot_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(base: str, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(base: str, path: str, body: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _wait_healthy(base: str, proc, failures, what: str) -> bool:
+    for _ in range(240):
+        try:
+            code, _h = _get(base, "/healthz", timeout=2)
+            if code == 200:
+                return True
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            failures.append(
+                f"{what}: server died at startup (rc {proc.returncode})"
+            )
+            return False
+        time.sleep(0.5)
+    failures.append(f"{what}: never became healthy")
+    return False
+
+
+def _drain(proc, failures, what: str) -> None:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        failures.append(f"{what}: no drain within 60s of SIGTERM")
+        return
+    if rc != 0:
+        failures.append(f"{what}: exited {rc} after SIGTERM (want 0)")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None)
+    parser.add_argument("--keep", action="store_true")
+    args = parser.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="aot_smoke_")
+    store = os.path.join(work, "aot_store")
+    failures: list = []
+
+    # artifacts (in-process; backend-independent numpy msgpack) — the
+    # shared constructor with bench.py --cold-start-bench
+    from distributed_mnist_bnns_tpu.aot.coldstart import (
+        make_tiny_artifacts,
+    )
+
+    cls_artifact, lm_artifact = make_tiny_artifacts(work)
+
+    def env_fresh_cache():
+        return {
+            **os.environ, "JAX_PLATFORMS": "cpu",
+            "JAX_COMPILATION_CACHE_DIR": tempfile.mkdtemp(dir=work),
+        }
+
+    # -- build the store (a subprocess, as an operator would)
+    build = subprocess.run(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli", "aot",
+         "build", "--store", store,
+         "--artifact", cls_artifact, "--batch-size", "8",
+         "--lm-artifact", lm_artifact, "--slots", "2",
+         "--page-size", "8", "--interpret"],
+        env=env_fresh_cache(), cwd=REPO,
+        capture_output=True, text=True, timeout=600,
+    )
+    if build.returncode != 0:
+        print(f"FAIL: aot build rc {build.returncode}: "
+              f"{build.stderr[-800:]}", file=sys.stderr)
+        return 1
+    print("aot build:", build.stdout.strip())
+
+    ls = subprocess.run(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli", "aot",
+         "ls", "--store", store, "--json"],
+        env=env_fresh_cache(), cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    entries = json.loads(ls.stdout) if ls.returncode == 0 else []
+    names = {e.get("name") for e in entries}
+    for want in ("classifier_predict", "lm_prefill", "lm_decode"):
+        if want not in names:
+            failures.append(f"aot ls: store is missing {want!r}")
+
+    # -- classifier server from the warm store
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+         "serve", "--artifact", cls_artifact, "--port", str(port),
+         "--batch-size", "8", "--interpret",
+         "--aot", "--aot-dir", store,
+         "--log-file", os.path.join(work, "serve.log")],
+        env=env_fresh_cache(), cwd=REPO,
+    )
+    try:
+        if _wait_healthy(base, proc, failures, "serve"):
+            _, h = _get(base, "/healthz")
+            if h.get("aot") != "hit":
+                failures.append(f"serve: aot={h.get('aot')!r}, want hit")
+            if h.get("recompiles_post_boot") != 0:
+                failures.append(
+                    "serve: recompiles_post_boot="
+                    f"{h.get('recompiles_post_boot')}, want 0"
+                )
+            img = [[[0.1 * ((i + j) % 7)] for j in range(28)]
+                   for i in range(28)]
+            code, body = _post(base, "/predict", {"images": [img]})
+            if code != 200:
+                failures.append(f"serve: predict returned {code}")
+            # hot reload served FROM the store: zero compiles must hold
+            code, _b = _post(base, "/admin/reload", {}, timeout=120)
+            if code != 200:
+                failures.append(f"serve: reload returned {code}")
+            _, h = _get(base, "/healthz")
+            if h.get("recompiles_post_boot") != 0:
+                failures.append(
+                    "serve: post-reload recompiles_post_boot="
+                    f"{h.get('recompiles_post_boot')}, want 0 (reload "
+                    "must be served from the store)"
+                )
+            if h.get("status") != "ok":
+                failures.append(f"serve: status {h.get('status')!r} "
+                                "(fence must stay green)")
+        _drain(proc, failures, "serve")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # -- LM server from the warm store
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+         "serve", "--lm", "--artifact", lm_artifact,
+         "--port", str(port), "--slots", "2", "--page-size", "8",
+         "--interpret", "--aot", "--aot-dir", store,
+         "--log-file", os.path.join(work, "lm_serve.log")],
+        env=env_fresh_cache(), cwd=REPO,
+    )
+    try:
+        if _wait_healthy(base, proc, failures, "lm"):
+            _, h = _get(base, "/healthz")
+            if h.get("aot") != "hit":
+                failures.append(f"lm: aot={h.get('aot')!r}, want hit")
+            if h.get("recompiles_post_warmup") != 0:
+                failures.append(
+                    "lm: recompiles_post_warmup="
+                    f"{h.get('recompiles_post_warmup')}, want 0 from "
+                    "boot"
+                )
+            code, body = _post(
+                base, "/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 6}, timeout=120,
+            )
+            lines = [json.loads(ln) for ln in body.strip().splitlines()]
+            if code != 200 or not lines or \
+                    lines[-1].get("status") != "ok":
+                failures.append(f"lm: generate {code}: {body[:200]}")
+            _, h = _get(base, "/healthz")
+            if h.get("recompiles_post_warmup") != 0:
+                failures.append(
+                    "lm: post-traffic recompiles="
+                    f"{h.get('recompiles_post_warmup')}, want 0"
+                )
+            if h.get("status") != "ok":
+                failures.append(f"lm: status {h.get('status')!r}")
+        _drain(proc, failures, "lm")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    print(json.dumps({"store_entries": sorted(names),
+                      "ok": not failures}))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not args.keep and args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
