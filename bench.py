@@ -288,7 +288,7 @@ def _utc_now(epoch_s: float | None = None) -> str:
 SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
     "device_resident_epoch", "train_step_per_backend", "comm",
-    "lm_serve", "cold_start",
+    "comm_fsdp", "lm_serve", "cold_start",
 )
 
 
@@ -569,6 +569,8 @@ def _bench_comm(args, deadline):
         plan = trainer.comm_plan
         row = {
             "wire_bytes_per_step": plan.wire_bytes_per_step,
+            "wire_bytes_rs": plan.wire_bytes_rs,
+            "wire_bytes_ag": plan.wire_bytes_ag,
             "wire_ratio_vs_fp32": (
                 round(plan.wire_ratio, 5)
                 if plan.wire_ratio is not None else None
@@ -592,6 +594,145 @@ def _bench_comm(args, deadline):
         out["bytes_reduction_sign"] = (
             round(base_bytes / sign["wire_bytes_per_step"], 1)
             if sign["wire_bytes_per_step"] else None
+        )
+    return out
+
+
+def _bench_comm_fsdp(args, deadline):
+    """Compressed-FSDP section (--comm-bench; PERF.md "Gradient comms —
+    compressed FSDP"): fp32 GSPMD FSDP (the reduce-scatter + all-gather
+    pair) vs the 1-bit exchange with the ZeRO-sharded base optimizer
+    (sign_ef), per-phase wire bytes/step and measured step time, plus
+    the fused scan_steps=4 composition with its post-warmup compile
+    count (the zero-compile contract the perf gate pins). Same caveat
+    as the DP section: on a single-host CPU mesh the step-time column
+    is compute-bound, the byte columns are the portable result."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_mnist_bnns_tpu.obs import get_tracker
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    n = jax.device_count()
+    out = {
+        "devices": n,
+        "model": args.model,
+        "batch_size": args.comm_batch_size,
+        "backend": args.backend,
+        "device_kind": str(jax.devices()[0].device_kind),
+    }
+    if n < 2:
+        out["note"] = "single device: no FSDP exchange to measure"
+        return out
+    bs = -(-args.comm_batch_size // n) * n
+    if args.model.startswith("xnor-resnet"):
+        input_shape = (32, 32, 3)
+    else:
+        input_shape = (28, 28, 1)
+    key = jax.random.PRNGKey(0)
+    images = np.asarray(jax.random.normal(
+        key, (bs, *input_shape), jnp.float32
+    ))
+    labels = np.asarray(jax.random.randint(key, (bs,), 0, 10))
+    tracker = get_tracker()
+    variants = {}
+    for name, mode, scan_steps in (
+        ("fp32", "none", 1),
+        ("sign_ef", "sign_ef", 1),
+        ("sign_ef_scan4", "sign_ef", 4),
+    ):
+        if time.monotonic() > deadline:
+            variants[name] = "skipped (bench deadline)"
+            continue
+        trainer = Trainer(
+            TrainConfig(
+                model=args.model, batch_size=bs, optimizer="adam",
+                learning_rate=0.01, backend=args.backend, seed=0,
+                data_parallel="auto", dp_mode="fsdp",
+                grad_compress=mode, scan_steps=scan_steps,
+            ),
+            input_shape=input_shape,
+        )
+        steps = min(args.steps, args.comm_steps)
+        if scan_steps > 1:
+            scan = trainer._get_train_scan()
+            s_images = np.broadcast_to(
+                images, (scan_steps, *images.shape)
+            ).copy()
+            s_labels = np.broadcast_to(
+                labels, (scan_steps, *labels.shape)
+            ).copy()
+            state = {"metrics": None}
+
+            def one():
+                trainer.state, state["metrics"] = scan(
+                    trainer.state, s_images, s_labels, trainer.rng
+                )
+                return state["metrics"]
+
+            def fetch(metrics):
+                state["loss"] = float(metrics["loss"])
+
+            for _ in range(max(1, args.warmup)):
+                one()
+            fetch(state["metrics"])  # compile + settle = warmup done
+            c0 = tracker.count
+            dt, _ = _measure(
+                one, fetch, max(5, args.warmup),
+                max(1, -(-steps // scan_steps)), args.reps, deadline,
+            )
+            compiles_post_warmup = tracker.count - c0
+            loss = state["loss"]
+            if dt is not None:
+                dt = dt / scan_steps  # amortized per optimizer step
+        else:
+            # warm separately so the compile count covers ONLY the
+            # post-warmup steps (the gated metric)
+            for _ in range(max(1, args.warmup)):
+                trainer.state, m = trainer.train_step(
+                    trainer.state, images, labels, trainer.rng
+                )
+            float(m["loss"])
+            c0 = tracker.count
+            dt, loss = _bench_train_step(
+                trainer, images, labels, steps,
+                args.warmup, args.reps, deadline,
+            )
+            compiles_post_warmup = tracker.count - c0
+        plan = trainer.comm_plan
+        row = {
+            "layout": plan.layout,
+            "scan_steps": scan_steps,
+            "wire_bytes_per_step": plan.wire_bytes_per_step,
+            "wire_bytes_rs": plan.wire_bytes_rs,
+            "wire_bytes_ag": plan.wire_bytes_ag,
+            "wire_ratio_vs_fp32": (
+                round(plan.wire_ratio, 5)
+                if plan.wire_ratio is not None else None
+            ),
+            "n_params": plan.n_params,
+            "compiles_post_warmup": compiles_post_warmup,
+        }
+        if dt is None:
+            row["step_time_ms"] = "below measurement floor"
+        else:
+            row.update(
+                step_time_ms=round(dt * 1e3, 3),
+                images_per_sec=round(bs / dt, 1),
+                loss_finite=math.isfinite(loss),
+            )
+        variants[name] = row
+    out["variants"] = variants
+    comp = variants.get("sign_ef")
+    base = variants.get("fp32")
+    if isinstance(comp, dict) and isinstance(base, dict):
+        out["bytes_reduction_sign_ef"] = (
+            round(
+                base["wire_bytes_per_step"] / comp["wire_bytes_per_step"],
+                1,
+            )
+            if comp["wire_bytes_per_step"] else None
         )
     return out
 
@@ -1238,7 +1379,7 @@ def main() -> None:
                         "trainer, each in a fresh subprocess against "
                         "the AOT executable store (aot/, PERF.md "
                         "'Cold start')")
-    p.add_argument("--comm-bench", action="store_true",
+    p.add_argument("--comm-bench", action="store_true",  # + comm_fsdp
                    help="also bench the DP gradient exchange: fp32 psum "
                         "vs 1-bit sign/sign_ef compression (wire "
                         "bytes/step + step time per mode; PERF.md "
@@ -1640,6 +1781,11 @@ def main() -> None:
             result["comm"] = _bench_comm(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["comm"] = f"failed: {e!r:.300}"
+        try:
+            _progress("comm_fsdp: compressed-FSDP exchange section")
+            result["comm_fsdp"] = _bench_comm_fsdp(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["comm_fsdp"] = f"failed: {e!r:.300}"
 
     if args.cold_start_bench and time.monotonic() < deadline - 60:
         try:

@@ -111,8 +111,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--scan-steps", type=int, default=1,
                         help="fuse N train steps into one lax.scan dispatch "
                              "(device-resident inner loop; single-device, "
-                             "--dp-mode gspmd incl. multi-host, or "
-                             "single-process fsdp)")
+                             "--dp-mode gspmd incl. multi-host, "
+                             "single-process fsdp, and either DP mode "
+                             "combined with --grad-compress)")
         sp.add_argument("--device-data", action="store_true",
                         help="keep the whole dataset on device and run "
                              "each epoch as ONE dispatch (dataset must "
@@ -162,13 +163,17 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fsdp = ZeRO-style sharded params/opt state")
         sp.add_argument("--grad-compress", default="none",
                         choices=["none", "sign", "sign_ef"],
-                        help="1-bit DP gradient exchange (PERF.md "
+                        help="1-bit gradient exchange (PERF.md "
                              "'Gradient comms'): sign bitplanes + per-"
                              "bucket fp32 scales, ~32x fewer wire bytes "
                              "per step; sign = majority-vote signSGD, "
                              "sign_ef = error feedback (residuals "
                              "checkpoint in the optimizer state). "
-                             "gspmd DP only")
+                             "Composes with --dp-mode fsdp (compressed "
+                             "reduce-scatter + 1-bit update all-gather "
+                             "over ZeRO-sharded optimizer state) and "
+                             "with --scan-steps; TP/PP/device-data "
+                             "rejected")
         sp.add_argument("--compress-bucket-size", type=int, default=1024,
                         help="elements per compression scale bucket "
                              "(multiple of 32)")

@@ -24,8 +24,11 @@ Event kinds (schema v1):
   rollback       restore skipped corrupt generation(s) (resilience)
   restart        the retry loop rebuilt the trainer (cause, attempt,
                  backoff — resilience/policy)
-  comm_compress  the DP run's 1-bit gradient-exchange plan (mode,
-                 buckets, wire bytes/step vs fp32 — PERF.md)
+  comm_compress  the run's 1-bit gradient-exchange plan (mode, layout=
+                 dp|fsdp, buckets, per-phase rs/ag wire bytes/step vs
+                 fp32 — PERF.md)
+  metrics        final registry snapshot (counters/gauges/histograms)
+                 emitted once at run close, just before run_end
   request        one served prediction request's final status (serve/)
   shed           admission rejected a request (queue_full |
                  breaker_open | draining — serve/)

@@ -108,6 +108,11 @@ class Telemetry:
             self.heartbeat.stop()
             self.heartbeat = None
         if self.events is not None:
+            # Final registry snapshot as ONE event: counters the run
+            # accumulated (comm_bytes_total phases, shed/fault counts,
+            # …) become post-mortem-readable from the event log alone,
+            # without a live /metrics endpoint to scrape.
+            self.events.emit("metrics", registry=self.registry.snapshot())
             self.events.emit(
                 "run_end",
                 wall_seconds=round(time.time() - self._t0, 3),

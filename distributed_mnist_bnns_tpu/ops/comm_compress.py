@@ -84,6 +84,10 @@ class CommPlan:
     chunks: int         # independent overlap groups over the bucket axis
     nb: int             # buckets per segment
     padded: int         # world * nb * bucket_size >= n_params
+    layout: str = "dp"  # "dp" (replicated update) | "fsdp" (ZeRO: the
+                        # segment owner runs the optimizer, phase 2
+                        # broadcasts the 1-bit update delta) — labels
+                        # telemetry; the byte model is identical
 
     @property
     def seg(self) -> int:
@@ -103,6 +107,26 @@ class CommPlan:
         """Ring all-reduce cost of the uncompressed fp32 gradient."""
         return int(2 * (self.world - 1) / max(self.world, 1)
                    * 4 * self.n_params)
+
+    @property
+    def wire_bytes_rs(self) -> int:
+        """Reduce-scatter-phase bytes per worker per step: (N-1)/N of
+        one full message (compressed modes: the all_to_all of sign
+        planes + scales; fp32: the RS half of the ring all-reduce, which
+        is also what a GSPMD FSDP gradient reduce-scatter moves)."""
+        if self.world <= 1:
+            return 0
+        if self.mode == "fp32":
+            return int((self.world - 1) / self.world * 4 * self.n_params)
+        return int((self.world - 1) / self.world * self.message_bytes)
+
+    @property
+    def wire_bytes_ag(self) -> int:
+        """All-gather-phase bytes per worker per step (compressed: the
+        broadcast of the owner's recompressed segment — under 'fsdp'
+        layout that segment is the 1-bit update delta replacing the
+        fp32 param all-gather; fp32: the AG half of the pair)."""
+        return self.wire_bytes_per_step - self.wire_bytes_rs
 
     @property
     def wire_bytes_per_step(self) -> int:
@@ -134,6 +158,7 @@ def make_plan(
     mode: str,
     bucket_size: int = 1024,
     chunks: int = 4,
+    layout: str = "dp",
 ) -> CommPlan:
     """Size the segment/bucket layout for a D-element gradient.
 
@@ -143,6 +168,10 @@ def make_plan(
         raise ValueError(
             f"unknown compression mode {mode!r} "
             "(have: sign, sign_ef, fp32)"
+        )
+    if layout not in ("dp", "fsdp"):
+        raise ValueError(
+            f"unknown comm layout {layout!r} (have: dp, fsdp)"
         )
     if bucket_size <= 0 or bucket_size % WORD_BITS:
         raise ValueError(
@@ -155,7 +184,7 @@ def make_plan(
     return CommPlan(
         mode=mode, world=world, n_params=int(n_params),
         bucket_size=int(bucket_size), chunks=chunks, nb=nb,
-        padded=world * nb * bucket_size,
+        padded=world * nb * bucket_size, layout=layout,
     )
 
 
@@ -175,6 +204,109 @@ def decompress_buckets(
     return unpack_bits(planes, bucket_size) * scale[..., None]
 
 
+def _chunk_slices(plan: CommPlan):
+    """The bucket-axis slices of the independent overlap groups: no
+    chunk's ops depend on a neighbor's, so XLA's async collectives
+    overlap chunk i's all_to_all/all_gather with chunk i+1's packing
+    compute."""
+    per = -(-plan.nb // plan.chunks)
+    for c in range(plan.chunks):
+        sl = slice(c * per, min((c + 1) * per, plan.nb))
+        if sl.start >= plan.nb:
+            return
+        yield sl
+
+
+def reduce_scatter_compressed(
+    flat: jnp.ndarray,
+    plan: CommPlan,
+    *,
+    axis_name: Optional[str],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Phase 1 alone — the 1-bit compressed reduce-scatter.
+
+    flat: (plan.padded,) this worker's (error-corrected) gradient.
+
+    Returns ``(own, sent)``:
+      own:  (plan.seg,) the combined global gradient for the segment
+            THIS worker owns (the quantity a ZeRO owner feeds its
+            sharded optimizer — FSDP layout stops here and phase 2
+            carries the update delta instead);
+      sent: (plan.padded,) what this worker's phase-1 message decodes
+            to — the quantity worker error feedback subtracts.
+
+    With ``axis_name=None`` (world 1) the all_to_all is identity and
+    this is local compress/combine.
+    """
+    world, nb, B = plan.world, plan.nb, plan.bucket_size
+    x = flat.reshape(world, nb, B)
+    own, sent = [], []
+    for sl in _chunk_slices(plan):
+        xc = x[:, sl]                               # (world, nbc, B)
+        planes, scale = compress_buckets(xc)
+        sent.append(decompress_buckets(planes, scale, B))
+        if axis_name is not None:
+            # worker j receives every worker's planes for segment j
+            # (compressed reduce-scatter).
+            planes = jax.lax.all_to_all(
+                planes, axis_name, split_axis=0, concat_axis=0
+            )
+            scale = jax.lax.all_to_all(
+                scale, axis_name, split_axis=0, concat_axis=0
+            )
+        if plan.mode == "sign":
+            # Bernstein majority vote on raw signs; magnitude = mean of
+            # the contributed bucket scales (constant per bucket, so the
+            # phase-2 recompression is exact).
+            votes = jnp.sum(unpack_bits(planes, B), axis=0)
+            y = _signs(votes) * jnp.mean(scale, axis=0)[..., None]
+        else:
+            contrib = decompress_buckets(planes, scale, B)
+            y = jnp.mean(contrib, axis=0)           # (nbc, B)
+        own.append(y)
+    own_flat = jnp.concatenate(own, axis=0).reshape(plan.seg)
+    sent_flat = jnp.concatenate(sent, axis=1).reshape(plan.padded)
+    return own_flat, sent_flat
+
+
+def all_gather_compressed(
+    seg: jnp.ndarray,
+    plan: CommPlan,
+    *,
+    axis_name: Optional[str],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Phase 2 alone — the 1-bit compressed all-gather/broadcast.
+
+    seg: (plan.seg,) this worker's owned-segment values (the combined
+    gradient under DP layout; the optimizer's update delta under FSDP
+    layout, where this broadcast REPLACES the fp32 all-gather of
+    updated param shards).
+
+    Returns ``(full, own_dec)``:
+      full:    (plan.padded,) the decoded broadcast, identical on every
+               worker;
+      own_dec: (plan.seg,) what this worker's own segment decodes to —
+               the quantity the owner-side error feedback subtracts.
+    """
+    nb, B = plan.nb, plan.bucket_size
+    y = seg.reshape(nb, B)
+    full, own_dec = [], []
+    for sl in _chunk_slices(plan):
+        planes, scale = compress_buckets(y[sl])
+        dec = decompress_buckets(planes, scale, B)   # (nbc, B)
+        own_dec.append(dec)
+        if axis_name is not None:
+            planes = jax.lax.all_gather(planes, axis_name, axis=0)
+            scale = jax.lax.all_gather(scale, axis_name, axis=0)
+            dec_full = decompress_buckets(planes, scale, B)
+        else:
+            dec_full = dec[None]                     # (1, nbc, B)
+        full.append(dec_full)
+    full_flat = jnp.concatenate(full, axis=1).reshape(plan.padded)
+    own_flat = jnp.concatenate(own_dec, axis=0).reshape(plan.seg)
+    return full_flat, own_flat
+
+
 def exchange(
     flat: jnp.ndarray,
     plan: CommPlan,
@@ -182,7 +314,11 @@ def exchange(
     axis_name: Optional[str],
     e2: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
-    """Run the two-phase compressed exchange on a padded flat gradient.
+    """Run the two-phase compressed exchange on a padded flat gradient
+    (``reduce_scatter_compressed`` -> owner residual -> ``all_gather_
+    compressed`` — the DP composition; the FSDP path interposes the
+    sharded optimizer update between the phases instead, see
+    train/optim.sign_compress_fsdp).
 
     flat: (plan.padded,) this worker's (error-corrected) gradient.
     e2:   (plan.seg,) this worker's segment-owner residual (sign_ef
@@ -199,62 +335,12 @@ def exchange(
     With ``axis_name=None`` (world 1) both collectives are identity and
     the function reduces to local compress/decompress.
     """
-    world, nb, B = plan.world, plan.nb, plan.bucket_size
-    x = flat.reshape(world, nb, B)
-    e2_in = None if e2 is None else e2.reshape(nb, B)
-
-    combined, sent, e2_out = [], [], []
-    # Independent per-chunk collectives: no chunk's ops depend on a
-    # neighbor's, so XLA's async collectives overlap chunk i's
-    # all_to_all/all_gather with chunk i+1's packing compute.
-    per = -(-nb // plan.chunks)
-    for c in range(plan.chunks):
-        sl = slice(c * per, min((c + 1) * per, nb))
-        if sl.start >= nb:
-            break
-        xc = x[:, sl]                               # (world, nbc, B)
-        planes, scale = compress_buckets(xc)
-        sent.append(decompress_buckets(planes, scale, B))
-        if axis_name is not None:
-            # phase 1: worker j receives every worker's planes for
-            # segment j (compressed reduce-scatter).
-            planes = jax.lax.all_to_all(
-                planes, axis_name, split_axis=0, concat_axis=0
-            )
-            scale = jax.lax.all_to_all(
-                scale, axis_name, split_axis=0, concat_axis=0
-            )
-        contrib = decompress_buckets(planes, scale, B)  # (world, nbc, B)
-        if plan.mode == "sign":
-            # Bernstein majority vote on raw signs; magnitude = mean of
-            # the contributed bucket scales (constant per bucket, so the
-            # phase-2 recompression below is exact).
-            votes = jnp.sum(unpack_bits(planes, B), axis=0)
-            y = _signs(votes) * jnp.mean(scale, axis=0)[..., None]
-        else:
-            y = jnp.mean(contrib, axis=0)           # (nbc, B)
-        if e2_in is not None:
-            y = y + e2_in[sl]
-        planes2, scale2 = compress_buckets(y)
-        dec2 = decompress_buckets(planes2, scale2, B)
-        if e2_in is not None:
-            e2_out.append(y - dec2)
-        if axis_name is not None:
-            # phase 2: broadcast the owner's combined segment.
-            planes2 = jax.lax.all_gather(planes2, axis_name, axis=0)
-            scale2 = jax.lax.all_gather(scale2, axis_name, axis=0)
-            dec2 = decompress_buckets(planes2, scale2, B)
-        else:
-            dec2 = dec2[None]                       # (1, nbc, B)
-        combined.append(dec2)
-
-    out = jnp.concatenate(combined, axis=1).reshape(plan.padded)
-    sent_flat = jnp.concatenate(sent, axis=1).reshape(plan.padded)
-    e2_new = (
-        jnp.concatenate(e2_out, axis=0).reshape(plan.seg)
-        if e2_out else None
-    )
-    return out, sent_flat, e2_new
+    y, sent = reduce_scatter_compressed(flat, plan, axis_name=axis_name)
+    if e2 is not None:
+        y = y + e2
+    combined, own_dec = all_gather_compressed(y, plan, axis_name=axis_name)
+    e2_new = None if e2 is None else y - own_dec
+    return combined, sent, e2_new
 
 
 def pad_flat(flat: jnp.ndarray, plan: CommPlan) -> jnp.ndarray:

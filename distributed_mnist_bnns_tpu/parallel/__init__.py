@@ -12,6 +12,7 @@ from .mesh import make_hybrid_mesh, make_mesh
 from .distributed import initialize_multihost
 from .data_parallel import (
     make_compressed_dp_train_step,
+    make_compressed_fsdp_train_step,
     make_dp_train_step,
     make_shardmap_dp_train_step,
     shard_batch,
@@ -67,6 +68,7 @@ __all__ = [
     "shard_state_fsdp",
     "initialize_multihost",
     "make_compressed_dp_train_step",
+    "make_compressed_fsdp_train_step",
     "make_dp_train_step",
     "make_shardmap_dp_train_step",
     "shard_batch",

@@ -196,34 +196,22 @@ def make_shardmap_dp_train_step(
     return jax.jit(shmapped, donate_argnums=(0,))
 
 
-def make_compressed_dp_train_step(
+def _make_compressed_train_step(
     clamp_mask: Any,
     mesh: Mesh,
     state: "TrainState",
     *,
-    loss_fn: Callable = cross_entropy_loss,
-    axis: str = "data",
-    remat: bool = False,
-    grad_accum: int = 1,
-    augment: bool = False,
+    loss_fn: Callable,
+    axis: str,
+    remat: bool,
+    grad_accum: int,
+    augment: bool,
+    scan_steps: int,
 ) -> Callable:
-    """Data-parallel train step with a 1-bit compressed gradient
-    exchange (ops/comm_compress, PERF.md "Gradient comms").
-
-    The body is the standard single-device step body — the DP all-reduce
-    lives INSIDE ``state.tx``: the ``sign_compress`` transformation
-    (train/optim.py) compresses each worker's local gradient to sign
-    bitplanes + per-bucket scales and runs the two-phase
-    all_to_all/all_gather exchange over ``axis``, so no ``pmean`` of
-    gradients appears here (adding one would both double-reduce and
-    defeat the compression). Metrics and BatchNorm running stats still
-    take the plain fp32 pmean — they are O(1) and O(channels), not
-    O(params).
-
-    ``state`` is the template whose opt_state carries the EF residual
-    buffers; their leading world axis is sharded over ``axis``
-    (parallel/fsdp.compressed_state_specs), everything else replicated.
-    """
+    """Shared implementation of the compressed-DP and compressed-FSDP
+    train dispatches (the two differ only in what lives inside
+    ``state.tx`` and therefore in the state-spec tree
+    ``compressed_state_specs`` derives)."""
     body = make_step_body(
         clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum,
         augment=augment,
@@ -249,11 +237,113 @@ def make_compressed_dp_train_step(
     from .fsdp import compressed_state_specs
 
     state_specs = compressed_state_specs(state, axis)
-    shmapped = shard_map(
-        compressed_train_step,
-        mesh=mesh,
-        in_specs=(state_specs, P(axis), P(axis), P()),
-        out_specs=(state_specs, P()),
-        check_vma=False,
-    )
+    if scan_steps > 1:
+        # The fused multi-step dispatch (make_train_scan) composed with
+        # the compressed exchange: the scan must live INSIDE the
+        # shard_map so the exchange's all_to_all/all_gather run per
+        # iteration over the mapped axis. The exchange transform is
+        # pure (no Python-level bucket state), so every iteration keeps
+        # the per-chunk pack/exchange overlap; inputs are (S, B, ...)
+        # chunks sharded P(None, axis).
+        def compressed_train_scan_step(state, images, labels, rng):
+            def scan_body(st, xs):
+                st, m = compressed_train_step(st, xs[0], xs[1], rng)
+                return st, m
+
+            state, ms = jax.lax.scan(scan_body, state, (images, labels))
+            return state, jax.tree.map(jnp.mean, ms)
+
+        shmapped = shard_map(
+            compressed_train_scan_step,
+            mesh=mesh,
+            in_specs=(state_specs, P(None, axis), P(None, axis), P()),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+    else:
+        shmapped = shard_map(
+            compressed_train_step,
+            mesh=mesh,
+            in_specs=(state_specs, P(axis), P(axis), P()),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
     return jax.jit(shmapped, donate_argnums=(0,))
+
+
+def make_compressed_dp_train_step(
+    clamp_mask: Any,
+    mesh: Mesh,
+    state: "TrainState",
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    axis: str = "data",
+    remat: bool = False,
+    grad_accum: int = 1,
+    augment: bool = False,
+    scan_steps: int = 1,
+) -> Callable:
+    """Data-parallel train step with a 1-bit compressed gradient
+    exchange (ops/comm_compress, PERF.md "Gradient comms").
+
+    The body is the standard single-device step body — the DP all-reduce
+    lives INSIDE ``state.tx``: the ``sign_compress`` transformation
+    (train/optim.py) compresses each worker's local gradient to sign
+    bitplanes + per-bucket scales and runs the two-phase
+    all_to_all/all_gather exchange over ``axis``, so no ``pmean`` of
+    gradients appears here (adding one would both double-reduce and
+    defeat the compression). Metrics and BatchNorm running stats still
+    take the plain fp32 pmean — they are O(1) and O(channels), not
+    O(params).
+
+    ``state`` is the template whose opt_state carries the EF residual
+    buffers; their leading world axis is sharded over ``axis``
+    (parallel/fsdp.compressed_state_specs), everything else replicated.
+
+    ``scan_steps > 1`` fuses S steps into one lax.scan dispatch inside
+    the shard_map (signature then takes (S, B, ...) chunks, metrics
+    averaged over the S steps — make_train_scan semantics).
+    """
+    return _make_compressed_train_step(
+        clamp_mask, mesh, state, loss_fn=loss_fn, axis=axis, remat=remat,
+        grad_accum=grad_accum, augment=augment, scan_steps=scan_steps,
+    )
+
+
+def make_compressed_fsdp_train_step(
+    clamp_mask: Any,
+    mesh: Mesh,
+    state: "TrainState",
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    axis: str = "data",
+    remat: bool = False,
+    grad_accum: int = 1,
+    augment: bool = False,
+    scan_steps: int = 1,
+) -> Callable:
+    """FSDP/ZeRO train step over the 1-bit compressed exchange
+    (ops/comm_compress + train/optim.sign_compress_fsdp; PERF.md
+    "Gradient comms — compressed FSDP").
+
+    Same shard_map shape as the compressed-DP step — the ZeRO-ness
+    lives inside ``state.tx``: ``sign_compress_fsdp`` reduce-scatters
+    1-bit gradients to segment owners, runs the BASE optimizer on the
+    owner's (1, seg) moment rows (optimizer state sharded 1/N over
+    ``axis``, laid out by ``compressed_state_specs``), and broadcasts
+    the 1-bit update delta in place of the fp32 param all-gather.
+    Params stay replicated across workers (each device needs them for
+    fwd/bwd anyway) and bitwise consistent, because every worker
+    applies the identical decoded delta; the FSDP memory saving is the
+    sharded optimizer state + EF residuals — see PERF.md for the
+    ZeRO-1-vs-ZeRO-3 trade against the fp32 GSPMD FSDP path.
+
+    ``state`` is the template whose opt_state carries the
+    FsdpCompressState (EF residuals + flat-segment base-optimizer
+    rows); ``scan_steps > 1`` fuses S steps into one scanned dispatch
+    exactly like the DP variant.
+    """
+    return _make_compressed_train_step(
+        clamp_mask, mesh, state, loss_fn=loss_fn, axis=axis, remat=remat,
+        grad_accum=grad_accum, augment=augment, scan_steps=scan_steps,
+    )

@@ -133,7 +133,7 @@ def make_fsdp_train_step(
     )
 
 
-# -- compressed-DP state layout ---------------------------------------------
+# -- compressed-DP / compressed-FSDP state layout ---------------------------
 #
 # The 1-bit gradient exchange (ops/comm_compress, PERF.md "Gradient
 # comms") keeps per-worker error-feedback residuals in optimizer state
@@ -141,26 +141,50 @@ def make_fsdp_train_step(
 # ZeRO move this module exists for: the buffers checkpoint as ordinary
 # global arrays (bitwise save/restore) while each device materializes
 # only its own worker's row — one fp32 residual, the cost of a momentum
-# buffer, instead of N of them.
+# buffer, instead of N of them. The compressed-FSDP layout
+# (train/optim.sign_compress_fsdp) extends the same rule to the BASE
+# optimizer's state: its moments live in (world, seg) flat-segment rows
+# inside FsdpCompressState.inner, so adam's mu/nu cost 1/N per device —
+# ZeRO's optimizer-state sharding, expressed as the same leading-axis
+# PartitionSpec.
 
 
 def compressed_state_specs(state: Any, axis: str = "data") -> Any:
-    """TrainState-of-PartitionSpecs for the compressed shard_map DP step:
-    everything replicated except SignCompressState buffers, whose
-    leading world axis is sharded over ``axis`` (each worker sees its
-    own (1, ...) residual slice inside the shard_map body)."""
-    from ..train.optim import SignCompressState  # local import (cycle)
+    """TrainState-of-PartitionSpecs for the compressed shard_map steps
+    (DP and FSDP layouts): everything replicated except the compression
+    state, whose leading world axis is sharded over ``axis`` (each
+    worker sees its own (1, ...) slice inside the shard_map body).
+    For FsdpCompressState that covers the wrapped base optimizer's
+    (world, seg) moment rows too; its scalar leaves (step counts) stay
+    replicated."""
+    from ..train.optim import (  # local import (cycle)
+        FsdpCompressState,
+        SignCompressState,
+    )
 
     def mark(node):
         if isinstance(node, SignCompressState):
             return SignCompressState(
                 ef_residual=P(axis), ef_residual2=P(axis)
             )
+        if isinstance(node, FsdpCompressState):
+            return FsdpCompressState(
+                ef_residual=P(axis),
+                ef_residual2=P(axis),
+                inner=jax.tree.map(
+                    lambda leaf: (
+                        P(axis) if getattr(leaf, "ndim", 0) >= 1 else P()
+                    ),
+                    node.inner,
+                ),
+            )
         return jax.tree.map(lambda _: P(), node)
 
     opt_specs = jax.tree.map(
         mark, state.opt_state,
-        is_leaf=lambda n: isinstance(n, SignCompressState),
+        is_leaf=lambda n: isinstance(
+            n, (SignCompressState, FsdpCompressState)
+        ),
     )
     repl = jax.tree.map(lambda _: P(), state)
     return repl.replace(opt_state=opt_specs)

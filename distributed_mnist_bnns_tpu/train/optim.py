@@ -29,9 +29,11 @@ import optax
 
 from ..ops.comm_compress import (
     CommPlan,
+    all_gather_compressed,
     exchange,
     make_plan,
     pad_flat,
+    reduce_scatter_compressed,
     tree_size,
 )
 
@@ -173,6 +175,169 @@ def sign_compress(
     return optax.GradientTransformation(init, update)
 
 
+class FsdpCompressState(NamedTuple):
+    """State of the compressed-FSDP exchange (``sign_compress_fsdp``).
+
+    Like :class:`SignCompressState`, every array carries a leading
+    ``world`` axis — row *i* belongs to worker *i* — so the buffers are
+    ordinary global arrays in the checkpointed optimizer state (bitwise
+    save/restore) while the compressed shard_map step shards that axis
+    over 'data' (parallel/fsdp.compressed_state_specs). The difference
+    from the DP layout: the BASE optimizer's state rides in here too,
+    flattened to the (world, seg) ZeRO segment layout, because under
+    FSDP the segment owner — not every replica — runs the optimizer.
+
+    ef_residual:  (world, padded) worker gradient-compression error
+                  (EF-SignSGD; (world, 0) in stateless ``sign`` mode).
+    ef_residual2: (world, seg) segment-owner residual of the UPDATE
+                  broadcast — the 1-bit param-all-gather's quantization
+                  error, fed into the next step's delta (1-bit Adam's
+                  server error applied to the model-update stream;
+                  (world, 0) in ``sign`` mode).
+    inner:        the wrapped base optimizer's state over the
+                  (world, seg) flat param segments (e.g. adam's mu/nu
+                  rows — per-device cost 1/N of the replicated moments).
+    """
+
+    ef_residual: jnp.ndarray
+    ef_residual2: jnp.ndarray
+    inner: Any
+
+
+def sign_compress_fsdp(
+    inner: optax.GradientTransformation,
+    *,
+    mode: str,
+    world: int = 1,
+    axis_name: Optional[str] = None,
+    bucket_size: int = 1024,
+    chunks: int = 4,
+) -> optax.GradientTransformation:
+    """1-bit compressed FSDP/ZeRO exchange wrapping a base optimizer.
+
+    Where :func:`sign_compress` chains in FRONT of a replicated
+    optimizer (every worker redundantly applies the decoded global
+    gradient), this transform puts the optimizer INSIDE the exchange,
+    the ZeRO way:
+
+      1. compressed reduce-scatter: sign planes + fp32 bucket scales
+         ``all_to_all`` to segment owners, each owner combining the
+         ``world`` contributions (ops/comm_compress.reduce_scatter_
+         compressed) — the gradient never travels in fp32;
+      2. sharded update: the owner runs ``inner`` on its (1, seg) flat
+         segment with its (1, seg) moment rows — optimizer state is
+         sharded 1/N over 'data', the ZeRO property;
+      3. compressed all-gather: the owner's UPDATE DELTA (not the fp32
+         param shard) broadcasts as packed bitplanes; every worker
+         applies the identical decoded delta, so params stay replicated
+         and bitwise consistent without an fp32 param all-gather.
+
+    ``mode="sign_ef"`` keeps two-stage error feedback: the worker
+    residual absorbs step 1's quantization loss, the owner residual
+    absorbs step 3's (both in the state, ZeRO-sharded). ``mode="sign"``
+    is the stateless majority vote with an unguarded delta broadcast.
+
+    ``inner`` must be ELEMENTWISE (sgd/adam/adamax/adagrad/adadelta/
+    rprop/rmsprop/asgd): it sees flattened segments, so layerwise
+    optimizers (lars/lamb trust ratios) would silently compute norms
+    over arbitrary slices — the Trainer rejects them up front.
+
+    Like ``sign_compress``: with ``axis_name`` set, ``update`` must run
+    inside the shard_map that owns the axis (state buffers sliced to a
+    leading axis of 1); ``init`` always runs outside on the global
+    params; ``world=1`` degenerates to the collective-free local form
+    (the NumPy-oracle test configuration). The transform is pure —
+    no Python-level state — so it is scan-body-safe: ``lax.scan`` of
+    the step body fuses multiple exchanges into one dispatch with the
+    per-chunk overlap intact inside every iteration.
+    """
+    if mode not in ("sign", "sign_ef"):
+        raise ValueError(
+            f"unknown compression mode {mode!r} (have: sign, sign_ef)"
+        )
+    if axis_name is None and world != 1:
+        raise ValueError("world > 1 requires an axis_name to exchange over")
+
+    def _plan(n: int) -> CommPlan:
+        return make_plan(
+            n, world=world, mode=mode, bucket_size=bucket_size,
+            chunks=chunks, layout="fsdp",
+        )
+
+    def _seg_params(params, plan: CommPlan):
+        """The (world, seg) ZeRO layout of the flattened params."""
+        flat, _ = jax.flatten_util.ravel_pytree(params)
+        flat = pad_flat(flat.astype(jnp.float32), plan)
+        return flat.reshape(world, plan.seg)
+
+    def init(params):
+        plan = _plan(tree_size(params))
+        ef_rows = plan.padded if mode == "sign_ef" else 0
+        ef2_rows = plan.seg if mode == "sign_ef" else 0
+        return FsdpCompressState(
+            ef_residual=jnp.zeros((world, ef_rows), jnp.float32),
+            ef_residual2=jnp.zeros((world, ef2_rows), jnp.float32),
+            inner=inner.init(_seg_params(params, plan)),
+        )
+
+    def update(updates, state, params=None):
+        flat, unravel = jax.flatten_util.ravel_pytree(updates)
+        plan = _plan(flat.size)
+        flat = pad_flat(flat.astype(jnp.float32), plan)
+        if mode == "sign_ef":
+            corrected = flat + state.ef_residual[0]
+        else:
+            corrected = flat
+        # phase rs: every worker's planes for segment j land on owner j
+        own, sent = reduce_scatter_compressed(
+            corrected, plan, axis_name=axis_name
+        )
+        # ZeRO update: the owner's sharded moment rows see the exact
+        # combined gradient of the segment it owns. The local view of
+        # the inner state has its world axis sliced to 1, matching the
+        # (1, seg) gradient row.
+        if params is not None:
+            seg_all = _seg_params(params, plan)
+            idx = (
+                jax.lax.axis_index(axis_name) if axis_name is not None
+                else 0
+            )
+            seg_p = jax.lax.dynamic_slice_in_dim(seg_all, idx, 1, axis=0)
+        else:  # pragma: no cover - params always passed in this framework
+            seg_p = None
+        delta, new_inner = inner.update(own[None], state.inner, seg_p)
+        delta = delta[0]                              # (seg,)
+        if mode == "sign_ef":
+            delta = delta + state.ef_residual2[0]
+        # phase ag: the 1-bit update delta replaces the fp32 param
+        # all-gather; every worker decodes the identical full delta.
+        full, own_dec = all_gather_compressed(
+            delta, plan, axis_name=axis_name
+        )
+        new_updates = unravel(full[: plan.n_params])
+        if mode != "sign_ef":
+            return new_updates, FsdpCompressState(
+                ef_residual=state.ef_residual,
+                ef_residual2=state.ef_residual2,
+                inner=new_inner,
+            )
+        # Zero the residual tails covering pad positions (they never
+        # reach the model — see sign_compress for the rationale).
+        e1_new = (corrected - sent).at[plan.n_params:].set(0.0)
+        if axis_name is not None:
+            seg0 = jax.lax.axis_index(axis_name) * plan.seg
+        else:
+            seg0 = 0
+        valid2 = seg0 + jnp.arange(plan.seg) < plan.n_params
+        e2_new = jnp.where(valid2, delta - own_dec, 0.0)
+        return new_updates, FsdpCompressState(
+            ef_residual=e1_new[None], ef_residual2=e2_new[None],
+            inner=new_inner,
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
 OPTIMIZER_REGISTRY: Dict[str, Callable[..., optax.GradientTransformation]] = {
     "sgd": optax.sgd,
     "asgd": _asgd,
@@ -197,6 +362,9 @@ _HP_KEYS = ("learning_rate", "momentum", "b1", "b2", "eps", "weight_decay")
 def make_optimizer(
     name: str, learning_rate: float, *, clip_grad_norm: float | None = None,
     grad_transform: optax.GradientTransformation | None = None,
+    grad_transform_wrapper: Callable[
+        [optax.GradientTransformation], optax.GradientTransformation
+    ] | None = None,
     **kwargs: Any,
 ) -> optax.GradientTransformation:
     """Build a registry optimizer wrapped in inject_hyperparams so the
@@ -210,7 +378,16 @@ def make_optimizer(
     schedule). ``grad_transform`` (e.g. ``sign_compress``) chains after
     the clip and before the optimizer, inside the same wrapper for the
     same reason — its state (the EF residuals) rides in ``opt_state``
-    and therefore checkpoints with it."""
+    and therefore checkpoints with it. ``grad_transform_wrapper``
+    (e.g. ``sign_compress_fsdp``) instead WRAPS the base optimizer —
+    the compressed-FSDP exchange runs the optimizer inside itself on
+    the owner's ZeRO segment — and is mutually exclusive with
+    ``grad_transform``."""
+    if grad_transform is not None and grad_transform_wrapper is not None:
+        raise ValueError(
+            "grad_transform and grad_transform_wrapper are mutually "
+            "exclusive (chain-in-front vs wrap-the-optimizer)"
+        )
     try:
         base_ctor = OPTIMIZER_REGISTRY[name.lower()]
     except KeyError:
@@ -224,10 +401,13 @@ def make_optimizer(
         pre.append(optax.clip_by_global_norm(clip_grad_norm))
     if grad_transform is not None:
         pre.append(grad_transform)
-    if pre:
+    if pre or grad_transform_wrapper is not None:
 
         def ctor(*a, **kw):
-            return optax.chain(*pre, base_ctor(*a, **kw))
+            base = base_ctor(*a, **kw)
+            if grad_transform_wrapper is not None:
+                base = grad_transform_wrapper(base)
+            return optax.chain(*pre, base) if pre else base
 
         # inject_hyperparams introspects the ctor signature:
         ctor.__signature__ = inspect.signature(base_ctor)
@@ -259,6 +439,26 @@ def regime_hp_kwargs(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
         for k in _HP_KEYS
         if k != "learning_rate" and k in cfg and k in sig.parameters
     }
+
+
+def _hp_like(old: Any, value: Any) -> jnp.ndarray:
+    """A hyperparam write that PRESERVES the old leaf's placement: the
+    new scalar lands on the same sharding (mesh-replicated stays
+    mesh-replicated). A bare ``jnp.asarray`` would produce an
+    uncommitted default-device array, and any dispatch whose jit
+    derives in_shardings from its args (the compressed shard_map step
+    family) would see a different input layout and silently recompile
+    — one stray post-warmup compile per hyperparam flip, which the
+    budget-0 recompile fence of the scan-composition tests forbids."""
+    new = jnp.asarray(value, dtype=jnp.asarray(old).dtype)
+    sharding = getattr(old, "sharding", None)
+    # Only mesh placements are pinned: an uncommitted scalar (fresh
+    # tx.init state after a regime optimizer switch) must STAY
+    # uncommitted — device_put would commit it to one device and clash
+    # with the mesh-resident rest of the state at the next dispatch.
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        new = jax.device_put(new, sharding)
+    return new
 
 
 class RegimeSchedule:
@@ -302,5 +502,5 @@ class RegimeSchedule:
             return opt_state
         for k in _HP_KEYS:
             if k in cfg and k in hp:
-                hp[k] = jnp.asarray(cfg[k], dtype=jnp.asarray(hp[k]).dtype)
+                hp[k] = _hp_like(hp[k], cfg[k])
         return opt_state

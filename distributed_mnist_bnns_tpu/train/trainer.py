@@ -528,12 +528,16 @@ class TrainConfig:
     data_parallel: Optional[object] = None  # None | "auto" | int devices
     dp_mode: str = "gspmd"         # "gspmd" (replicated state) | "fsdp"
                                    # (ZeRO-style sharded params/opt state)
-    grad_compress: str = "none"    # 1-bit DP gradient exchange (PERF.md
+    grad_compress: str = "none"    # 1-bit gradient exchange (PERF.md
                                    # "Gradient comms"): "sign" (majority-
                                    # vote signSGD) | "sign_ef" (error-
                                    # feedback, EF residuals checkpoint in
-                                   # opt state). gspmd DP only; ~32x
-                                   # fewer bytes on the wire per step.
+                                   # opt state). Composes with dp_mode
+                                   # "fsdp" (compressed reduce-scatter +
+                                   # 1-bit update all-gather, base
+                                   # optimizer ZeRO-sharded) and with
+                                   # scan_steps; TP/PP/device_data
+                                   # rejected. ~32x fewer wire bytes.
     compress_bucket_size: int = 1024  # elements per fp32 scale bucket
                                    # (multiple of 32)
     compress_chunks: int = 4       # independent overlap groups: the
@@ -768,17 +772,19 @@ class Trainer:
                 "(have: none, sign, sign_ef)"
             )
         incompatible = [
-            (cfg.dp_mode != "gspmd", "dp_mode='gspmd'"),
             (cfg.tensor_parallel > 1, "tensor_parallel=1"),
             (cfg.pipeline_parallel > 1, "pipeline_parallel=1"),
-            (int(cfg.scan_steps) > 1, "scan_steps=1"),
             (cfg.device_data, "device_data=False"),
         ]
         bad = [need for cond, need in incompatible if cond]
         if bad:
             # The exchange is an explicit shard_map collective inside
-            # tx; the scan/epoch/TP/PP/FSDP dispatches jit the plain
-            # step body and would silently train uncompressed.
+            # tx; the TP/PP/epoch dispatches jit the plain step body
+            # (or own a different mesh) and would silently train
+            # uncompressed. FSDP and scan_steps>1 DO compose: the
+            # fsdp layout wraps the base optimizer in the exchange
+            # (sign_compress_fsdp) and the scan dispatch moves inside
+            # the shard_map (make_compressed_*_train_step(scan_steps)).
             raise ValueError(
                 f"grad_compress={cfg.grad_compress!r} requires "
                 + ", ".join(bad)
@@ -808,6 +814,7 @@ class Trainer:
             mode=cfg.grad_compress,
             bucket_size=cfg.compress_bucket_size,
             chunks=cfg.compress_chunks,
+            layout="fsdp" if cfg.dp_mode == "fsdp" else "dp",
         )
 
     def _build_tx(self, name: str, learning_rate: float, **kwargs: Any):
@@ -815,22 +822,52 @@ class Trainer:
         in — the one constructor both __init__ and the regime rebuild
         path use, so an optimizer-class switch cannot silently drop the
         compressed exchange (it does reset the EF residuals, exactly
-        like the moment buffers — adjust_optimizer semantics)."""
-        grad_transform = None
-        if self.config.grad_compress != "none":
-            from .optim import sign_compress
+        like the moment buffers — adjust_optimizer semantics).
 
-            grad_transform = sign_compress(
-                mode=self.comm_plan.mode,
-                world=self.comm_plan.world,
-                axis_name=self._compress_axis,
-                bucket_size=self.comm_plan.bucket_size,
-                chunks=self.comm_plan.chunks,
-            )
+        dp_mode='fsdp' + compression wraps the base optimizer INSIDE
+        the exchange instead (sign_compress_fsdp): the segment owner
+        runs it on flattened ZeRO segments, so layerwise optimizers
+        (lars/lamb trust ratios over per-leaf norms) cannot express
+        their math there and are rejected loudly — here rather than in
+        the transform, so a regime switching to lamb mid-run fails at
+        the rebuild with the same message."""
+        grad_transform = None
+        grad_transform_wrapper = None
+        if self.config.grad_compress != "none":
+            from .optim import sign_compress, sign_compress_fsdp
+
+            if self.config.dp_mode == "fsdp":
+                if name.lower() in ("lars", "lamb"):
+                    raise ValueError(
+                        f"optimizer {name!r} does not compose with "
+                        "grad_compress under dp_mode='fsdp': the "
+                        "compressed-FSDP exchange runs the optimizer on "
+                        "flattened ZeRO segments, where layerwise trust "
+                        "ratios would silently compute norms over "
+                        "arbitrary slices (use an elementwise optimizer, "
+                        "or dp_mode='gspmd')"
+                    )
+                grad_transform_wrapper = lambda inner: sign_compress_fsdp(
+                    inner,
+                    mode=self.comm_plan.mode,
+                    world=self.comm_plan.world,
+                    axis_name=self._compress_axis,
+                    bucket_size=self.comm_plan.bucket_size,
+                    chunks=self.comm_plan.chunks,
+                )
+            else:
+                grad_transform = sign_compress(
+                    mode=self.comm_plan.mode,
+                    world=self.comm_plan.world,
+                    axis_name=self._compress_axis,
+                    bucket_size=self.comm_plan.bucket_size,
+                    chunks=self.comm_plan.chunks,
+                )
         return make_optimizer(
             name, learning_rate,
             clip_grad_norm=self.config.clip_grad_norm,
             grad_transform=grad_transform,
+            grad_transform_wrapper=grad_transform_wrapper,
             **kwargs,
         )
 
@@ -913,10 +950,13 @@ class Trainer:
             p = self.comm_plan
             self.telemetry.emit(
                 "comm_compress",
-                mode=p.mode, world=p.world, n_params=p.n_params,
+                mode=p.mode, layout=p.layout, world=p.world,
+                n_params=p.n_params,
                 bucket_size=p.bucket_size, buckets=p.world * p.nb,
                 chunks=p.chunks,
                 wire_bytes_per_step=p.wire_bytes_per_step,
+                wire_bytes_rs=p.wire_bytes_rs,
+                wire_bytes_ag=p.wire_bytes_ag,
                 fp32_bytes_per_step=p.fp32_bytes_per_step,
                 wire_ratio=p.wire_ratio,
             )
@@ -1064,13 +1104,20 @@ class Trainer:
         )
         if self.comm_plan is not None and self.comm_plan.world > 1:
             # Gradient-exchange bytes on the wire (analytic ring model
-            # over the real packed sizes — PERF.md "Gradient comms").
+            # over the real packed sizes — PERF.md "Gradient comms"),
+            # split by phase: rs = the reduce-scatter half (all_to_all
+            # of sign planes / fp32 grad RS), ag = the all-gather half
+            # (compressed broadcast of the combined segment or update
+            # delta / fp32 param AG).
             p = self.comm_plan
             reg = self.telemetry.registry
-            reg.counter(
+            comm = reg.counter(
                 "comm_bytes_total",
-                "gradient-exchange bytes on the wire per worker",
-            ).inc(p.wire_bytes_per_step * n, mode=p.mode)
+                "gradient-exchange bytes on the wire per worker "
+                "(labels: mode, phase=rs|ag)",
+            )
+            comm.inc(p.wire_bytes_rs * n, mode=p.mode, phase="rs")
+            comm.inc(p.wire_bytes_ag * n, mode=p.mode, phase="ag")
             if p.saved_bytes_per_step:
                 reg.counter(
                     "comm_saved_bytes_total",
@@ -1288,13 +1335,31 @@ class Trainer:
                 f"data_parallel={n}"
             )
         self.mesh = make_mesh(data=n)
-        if self.config.dp_mode == "fsdp":
-            self._set_fsdp_step(loss_fn)
-        elif self.config.grad_compress != "none":
+        if self.config.grad_compress != "none":
+            # Both layouts (gspmd DP and fsdp) run the explicit
+            # shard_map exchange; they differ in what lives inside tx
+            # and therefore in which opt_state rows the compressed
+            # placement shards (parallel/fsdp.compressed_state_specs).
             from ..parallel import place_compressed_state
 
-            self._set_compressed_dp_step(loss_fn)
+            if self.config.dp_mode == "fsdp":
+                self._set_compressed_fsdp_step(loss_fn)
+            else:
+                self._set_compressed_dp_step(loss_fn)
             self.state = place_compressed_state(self.state, self.mesh)
+        elif self.config.dp_mode == "fsdp":
+            self._set_fsdp_step(loss_fn)
+            # Byte accounting for the uncompressed FSDP exchange (the
+            # GSPMD reduce-scatter + all-gather pair — the baseline the
+            # compressed-FSDP wire numbers are judged against); phases
+            # land in comm_bytes_total{mode=fp32,phase=rs|ag}.
+            from ..ops.comm_compress import make_plan, tree_size
+
+            self.comm_plan = make_plan(
+                tree_size(self.state.params), world=n, mode="fp32",
+                bucket_size=self.config.compress_bucket_size,
+                layout="fsdp",
+            )
         else:
             self._set_dp_step(loss_fn)
             self.state = replicate(self.state, self.mesh)
@@ -1332,6 +1397,21 @@ class Trainer:
         from ..parallel import make_compressed_dp_train_step
 
         step = make_compressed_dp_train_step(
+            self.clamp_mask, self.mesh, self.state, loss_fn=loss_fn,
+            remat=self.config.remat, grad_accum=self.config.grad_accum,
+            augment=self.config.augment,
+        )
+        self.train_step = self._wrap_mesh_step(step)
+
+    def _set_compressed_fsdp_step(self, loss_fn) -> None:
+        """FSDP over the 1-bit exchange: the base optimizer runs inside
+        ``tx`` on the segment owner's ZeRO-sharded moment rows, the
+        compressed all-gather of the update delta replaces the fp32
+        param all-gather (train/optim.sign_compress_fsdp; PERF.md
+        "Gradient comms")."""
+        from ..parallel import make_compressed_fsdp_train_step
+
+        step = make_compressed_fsdp_train_step(
             self.clamp_mask, self.mesh, self.state, loss_fn=loss_fn,
             remat=self.config.remat, grad_accum=self.config.grad_accum,
             augment=self.config.augment,
@@ -1437,13 +1517,40 @@ class Trainer:
     def _get_train_scan(self) -> Callable:
         if self._train_scan is not None:
             return self._train_scan
-        state_shardings = self._scan_state_shardings()
-        scan = make_train_scan(
-            self.clamp_mask, loss_fn=self._loss_fn,
-            remat=self.config.remat, grad_accum=self.config.grad_accum,
-            augment=self.config.augment, mesh=self.mesh,
-            state_shardings=state_shardings,
-        )
+        if self.mesh is not None and self.config.grad_compress != "none":
+            # The compressed exchange is a shard_map collective, so the
+            # fused multi-step loop must scan INSIDE the shard_map (the
+            # generic make_train_scan jits the plain body and would
+            # fail to resolve the exchange's axis). Same (S, B, ...)
+            # chunk signature and batch_dim=1 sharding as the generic
+            # mesh scan; a world-1 compressed run (mesh None) falls
+            # through to the generic path, whose body runs the
+            # collective-free exchange.
+            from ..parallel import (
+                make_compressed_dp_train_step,
+                make_compressed_fsdp_train_step,
+            )
+
+            builder = (
+                make_compressed_fsdp_train_step
+                if self.config.dp_mode == "fsdp"
+                else make_compressed_dp_train_step
+            )
+            scan = builder(
+                self.clamp_mask, self.mesh, self.state,
+                loss_fn=self._loss_fn, remat=self.config.remat,
+                grad_accum=self.config.grad_accum,
+                augment=self.config.augment,
+                scan_steps=self._effective_scan_steps(),
+            )
+        else:
+            state_shardings = self._scan_state_shardings()
+            scan = make_train_scan(
+                self.clamp_mask, loss_fn=self._loss_fn,
+                remat=self.config.remat, grad_accum=self.config.grad_accum,
+                augment=self.config.augment, mesh=self.mesh,
+                state_shardings=state_shardings,
+            )
         if self.mesh is not None:
             from ..parallel import shard_batch
 
@@ -1687,15 +1794,20 @@ class Trainer:
                 # gather the stage-major block params off their stages.
                 self._set_pp_step(self._loss_fn)
             elif self.mesh is not None:
-                if self.config.dp_mode == "fsdp":
+                if self.config.grad_compress != "none":
+                    # The compressed step's shard_map specs embed the
+                    # opt_state structure (EF residual rows — and under
+                    # fsdp the base optimizer's segment rows — sharded
+                    # over 'data'); the fresh tx state needs a fresh
+                    # build.
+                    if self.config.dp_mode == "fsdp":
+                        self._set_compressed_fsdp_step(self._loss_fn)
+                    else:
+                        self._set_compressed_dp_step(self._loss_fn)
+                elif self.config.dp_mode == "fsdp":
                     self._set_fsdp_step(self._loss_fn)
                 elif self.config.tensor_parallel > 1:
                     self._set_tp_step(self._loss_fn)
-                elif self.config.grad_compress != "none":
-                    # The compressed step's shard_map specs embed the
-                    # opt_state structure (EF residual rows sharded over
-                    # 'data'); the fresh tx state needs a fresh build.
-                    self._set_compressed_dp_step(self._loss_fn)
                 else:
                     self._set_dp_step(self._loss_fn)
             else:
@@ -1710,11 +1822,18 @@ class Trainer:
         # (adjust_optimizer, utils.py:116-139), with no moment reset.
         self.regime.apply_hyperparams(self.state.opt_state, epoch)
         # learning_rate is written last: it combines the regime's base lr
-        # with the x0.1-every-N-epochs decay schedule.
+        # with the x0.1-every-N-epochs decay schedule. The write keeps
+        # the old leaf's sharding (_hp_like): a bare host asarray would
+        # flip a mesh-replicated hyperparam to an uncommitted array,
+        # and dispatches whose jit derives in_shardings from the args
+        # (the compressed shard_map family) would silently recompile on
+        # the flip — one extra post-warmup compile per run.
+        from .optim import _hp_like
+
         hp = getattr(self.state.opt_state, "hyperparams", None)
         if hp is not None and "learning_rate" in hp:
-            hp["learning_rate"] = jnp.asarray(
-                self._lr_for_epoch(epoch), jnp.float32
+            hp["learning_rate"] = _hp_like(
+                hp["learning_rate"], self._lr_for_epoch(epoch)
             )
 
     # -- loops --------------------------------------------------------------

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Perf regression gate (ROADMAP item 5 — the CPU-measurable slice).
+
+The repo has a rich perf record (BENCH_LOCAL_r*, PERF.md) but until
+round 9 nothing FAILED when a PR regressed it. This gate runs the
+deterministic, CPU-measurable comm sections of ``bench.py`` in a fresh
+subprocess (the simulated 8-device mesh must be forced before jax
+initializes) and compares per-metric results against the checked-in
+baselines in ``PERF_BASELINES.json``:
+
+* wire bytes/step for fp32-DP, sign_ef-DP, fp32-FSDP and sign_ef-FSDP
+  — analytic byte models pinned to real buffer sizes, so the band is
+  EXACT: any drift is a deliberate wire-model change and must be
+  re-banked with ``--update`` (and explained in PERF.md);
+* the compressed-FSDP wire ratio vs the fp32 reduce-scatter+all-gather
+  pair — bounded by the ISSUE-9 acceptance ceiling (<= 1/8);
+* post-warmup compile counts of the compressed-FSDP step and its fused
+  scan_steps=4 composition — the zero-compile contract (a shape or
+  sharding leak that retraces the hot path fails here even when it is
+  too cheap for the recompile fence to notice in a short smoke).
+
+Step-time metrics are deliberately NOT gated: shared CI runners are
+noisy in ways tolerance bands cannot honestly absorb; bytes and
+compile counts are the portable regression surface (PERF.md "Gradient
+comms" — on a single-host CPU mesh the byte columns are the result).
+
+Usage:
+    python scripts/perf_gate.py               # compare, exit 1 on fail
+    python scripts/perf_gate.py --update      # re-bank baselines
+    python scripts/perf_gate.py --bench-json R  # compare a saved record
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINES = os.path.join(REPO, "PERF_BASELINES.json")
+
+BENCH_ARGS = [
+    "--model", "bnn-mlp-small", "--batch-size", "256",
+    "--comm-bench", "--comm-batch-size", "256", "--comm-steps", "5",
+    "--steps", "5", "--warmup", "3", "--reps", "1", "--scan-steps", "8",
+    "--no-stretch", "--no-crossover",
+    "--probe-timeout", "30", "--probe-budget-s", "30",
+]
+
+
+def _get(record: dict, path: str):
+    """Dotted-path lookup ('comm.modes.none.wire_bytes_per_step');
+    None when any hop is missing or a section failed (a string)."""
+    cur = record
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+# metric name -> (dotted path into the bench record, comparison kind)
+#   exact: measured == baseline (tolerance ignored)
+#   max:   measured <= baseline * (1 + tolerance)
+METRIC_PATHS = {
+    "fp32_dp_wire_bytes_per_step": (
+        "comm.modes.none.wire_bytes_per_step", "exact"),
+    "sign_ef_dp_wire_bytes_per_step": (
+        "comm.modes.sign_ef.wire_bytes_per_step", "exact"),
+    "fp32_fsdp_wire_bytes_per_step": (
+        "comm_fsdp.variants.fp32.wire_bytes_per_step", "exact"),
+    "sign_ef_fsdp_wire_bytes_per_step": (
+        "comm_fsdp.variants.sign_ef.wire_bytes_per_step", "exact"),
+    "sign_ef_fsdp_wire_ratio_vs_fp32": (
+        "comm_fsdp.variants.sign_ef.wire_ratio_vs_fp32", "max"),
+    "sign_ef_fsdp_post_warmup_compiles": (
+        "comm_fsdp.variants.sign_ef.compiles_post_warmup", "max"),
+    "sign_ef_fsdp_scan4_post_warmup_compiles": (
+        "comm_fsdp.variants.sign_ef_scan4.compiles_post_warmup", "max"),
+}
+
+
+def run_bench() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), *BENCH_ARGS]
+    print("perf_gate: running", " ".join(cmd), file=sys.stderr, flush=True)
+    out = subprocess.run(
+        cmd, env=env, cwd=REPO, check=True, capture_output=True, text=True
+    )
+    # bench's contract: stdout is exactly one JSON line (stderr carries
+    # progress); take the last non-empty line defensively.
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    return json.loads(lines[-1])
+
+
+def compare(baselines: dict, record: dict) -> list:
+    """Returns a list of failure strings (empty = gate passes)."""
+    failures = []
+    for name, spec in baselines.get("metrics", {}).items():
+        path, kind = METRIC_PATHS.get(name, (None, None))
+        if path is None:
+            failures.append(f"{name}: unknown metric (stale baseline file?)")
+            continue
+        measured = _get(record, path)
+        if measured is None or isinstance(measured, str):
+            failures.append(
+                f"{name}: missing from the bench record at {path!r} "
+                f"(section failed or skipped: {measured!r})"
+            )
+            continue
+        base = spec["baseline"]
+        tol = float(spec.get("tolerance", 0.0))
+        if kind == "exact":
+            if measured != base:
+                failures.append(
+                    f"{name}: measured {measured} != banked {base} "
+                    "(analytic byte model drifted — if deliberate, "
+                    "re-bank with scripts/perf_gate.py --update)"
+                )
+        else:  # max
+            limit = base * (1.0 + tol)
+            if measured > limit:
+                failures.append(
+                    f"{name}: measured {measured} > allowed {limit} "
+                    f"(baseline {base}, tolerance {tol})"
+                )
+    return failures
+
+
+def bank(record: dict) -> dict:
+    metrics = {}
+    for name, (path, kind) in METRIC_PATHS.items():
+        measured = _get(record, path)
+        if measured is None or isinstance(measured, str):
+            raise SystemExit(
+                f"cannot bank {name}: missing from the record at {path!r} "
+                f"({measured!r})"
+            )
+        metrics[name] = {"baseline": measured, "kind": kind,
+                         "tolerance": 0.0}
+    return {
+        "note": (
+            "Perf-regression baselines for the CPU-measurable comm "
+            "slice (scripts/perf_gate.py; ROADMAP item 5). Byte counts "
+            "are analytic-over-real-buffer-sizes and gated EXACTLY; "
+            "compile counts and the wire ratio are ceilings. Re-bank "
+            "deliberate changes with scripts/perf_gate.py --update."
+        ),
+        "bench_args": BENCH_ARGS,
+        "metrics": metrics,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update", action="store_true",
+                    help="re-bank PERF_BASELINES.json from a fresh run")
+    ap.add_argument("--bench-json", default=None,
+                    help="compare a saved bench record instead of "
+                         "running bench.py")
+    args = ap.parse_args()
+
+    if args.bench_json:
+        with open(args.bench_json) as f:
+            record = json.load(f)
+    else:
+        record = run_bench()
+
+    if args.update:
+        with open(BASELINES, "w") as f:
+            json.dump(bank(record), f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"perf_gate: banked baselines to {BASELINES}")
+        return 0
+
+    with open(BASELINES) as f:
+        baselines = json.load(f)
+    failures = compare(baselines, record)
+    for name, spec in sorted(baselines.get("metrics", {}).items()):
+        path, _ = METRIC_PATHS.get(name, (None, None))
+        measured = _get(record, path) if path else None
+        print(f"perf_gate: {name}: measured={measured} "
+              f"baseline={spec['baseline']} ({spec['kind']})")
+    if failures:
+        print("\nPERF GATE FAILED:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  - {f_}", file=sys.stderr)
+        return 1
+    print("perf_gate: all metrics within bands")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,6 +185,51 @@ def test_jg003_sees_train_step_through_shard_map_wrapper():
     assert not active(run_source(ok, "lib.py"), "JG003")
 
 
+def test_jg003_sees_compressed_fsdp_scan_builder_shape():
+    """The compressed-FSDP builder family (ISSUE 9) jits a shard_map of
+    a SCANNED train step (``shmapped = shard_map(compressed_train_scan_
+    step, ...); jax.jit(shmapped, donate_argnums=(0,))``): the wrapper
+    look-through must resolve the scanned def and enforce
+    donate_argnums on it too — under scan_steps>1 the donated state is
+    a whole (params + ZeRO-sharded opt rows) carry, so forgetting
+    donation doubles state memory exactly where FSDP exists to shrink
+    it."""
+    src = (
+        "import jax\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import "
+        "shard_map\n"
+        "def make(mesh, specs):\n"
+        "    def compressed_train_scan_step(state, images, labels, rng):\n"
+        "        return state\n"
+        "    shmapped = shard_map(compressed_train_scan_step, mesh=mesh,\n"
+        "                         in_specs=specs, out_specs=specs)\n"
+        "    return jax.jit(shmapped)\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG003")) == 1
+    ok = src.replace(
+        "jax.jit(shmapped)", "jax.jit(shmapped, donate_argnums=(0,))"
+    )
+    assert not active(run_source(ok, "lib.py"), "JG003")
+
+
+def test_jg003_shard_map_wrapped_scan_eval_not_flagged():
+    """Eval exclusion preserved for the scanned-wrapper shape: a
+    scanned eval dispatch through shard_map must NOT demand
+    donation."""
+    src = (
+        "import jax\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import "
+        "shard_map\n"
+        "def make(mesh, specs):\n"
+        "    def eval_scan_step(state, images, labels, valid):\n"
+        "        return state\n"
+        "    shmapped = shard_map(eval_scan_step, mesh=mesh,\n"
+        "                         in_specs=specs, out_specs=specs)\n"
+        "    return jax.jit(shmapped)\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG003")
+
+
 def test_jg003_shard_map_wrapped_eval_step_not_flagged():
     """The eval exclusion must survive the wrapper look-through: a
     shard_map-wrapped eval step's state is reused across batches and

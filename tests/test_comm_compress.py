@@ -1,12 +1,20 @@
-"""1-bit gradient all-reduce (ops/comm_compress + train/optim.sign_compress
-+ parallel.make_compressed_dp_train_step — PERF.md "Gradient comms").
+"""1-bit gradient exchange (ops/comm_compress + train/optim.sign_compress
+/ sign_compress_fsdp + parallel.make_compressed_{dp,fsdp}_train_step —
+PERF.md "Gradient comms").
 
-Covers the ISSUE-5 acceptance surface: pack/scale/decode exactness, the
+Covers the ISSUE-5 acceptance surface (pack/scale/decode exactness, the
 error-feedback residual math against a NumPy oracle, the two-phase
 exchange on the 8-device CPU mesh against a NumPy simulation of both
 combine modes, the end-to-end accuracy parity smoke, checkpoint/resume
 bitwise equality with the EF buffers populated, chaos composition, the
-wire-byte accounting (≤ 1/16 of fp32) and its telemetry counters."""
+wire-byte accounting (≤ 1/16 of fp32) and its telemetry counters) plus
+the ISSUE-9 compressed-FSDP surface: the reduce-scatter primitive
+against a NumPy oracle, the FSDP transform's two-stage EF math with the
+base optimizer inside the exchange, the within-2%-of-fp32-FSDP
+acceptance smoke with ZeRO-sharded moments, bitwise preempt/resume of
+the sharded FsdpCompressState, the fused scan_steps composition
+(bitwise equal to step-at-a-time, budget-0 recompile fence green), and
+the loud rejection of the remaining TP/PP/device_data combos."""
 
 import os
 
@@ -20,20 +28,24 @@ from distributed_mnist_bnns_tpu.data import load_mnist
 from distributed_mnist_bnns_tpu.obs import load_events
 from distributed_mnist_bnns_tpu.ops.bitpack import pack_bits
 from distributed_mnist_bnns_tpu.ops.comm_compress import (
+    all_gather_compressed,
     compress_buckets,
     decompress_buckets,
     exchange,
     make_plan,
     pad_flat,
+    reduce_scatter_compressed,
     tree_size,
 )
 from distributed_mnist_bnns_tpu.parallel.compat import shard_map
 from distributed_mnist_bnns_tpu.resilience import Preempted
 from distributed_mnist_bnns_tpu.resilience.chaos import reset_fire_counts
 from distributed_mnist_bnns_tpu.train import (
+    FsdpCompressState,
     TrainConfig,
     Trainer,
     sign_compress,
+    sign_compress_fsdp,
 )
 
 
@@ -255,16 +267,30 @@ def test_sign_compress_world_gt_one_needs_axis():
 
 
 def test_grad_compress_incompatible_configs_raise():
+    """TP/PP/device_data still reject loudly (their dispatches jit the
+    plain body or own a different mesh and would silently train
+    uncompressed); fsdp and scan_steps — PR 5's other rejections — now
+    compose and are covered by the tests below."""
     for kw in (
-        dict(dp_mode="fsdp"),
-        dict(scan_steps=4),
         dict(device_data=True),
         dict(tensor_parallel=2),
+        dict(pipeline_parallel=2),
     ):
         with pytest.raises(ValueError, match="grad_compress"):
             Trainer(_cfg(grad_compress="sign_ef", **kw))
     with pytest.raises(ValueError, match="grad_compress"):
         Trainer(_cfg(grad_compress="bogus"))
+
+
+def test_fsdp_compress_rejects_layerwise_optimizers():
+    """lars/lamb trust ratios need per-leaf norms; the FSDP exchange
+    runs the optimizer on flattened ZeRO segments — reject up front
+    rather than silently computing norms over arbitrary slices."""
+    for opt in ("lars", "lamb"):
+        with pytest.raises(ValueError, match="flattened ZeRO segments"):
+            Trainer(_cfg(
+                grad_compress="sign_ef", dp_mode="fsdp", optimizer=opt,
+            ))
 
 
 def test_compressed_dp_trains_within_2pct_of_uncompressed(tmp_path):
@@ -297,10 +323,12 @@ def test_compressed_dp_trains_within_2pct_of_uncompressed(tmp_path):
     assert cc and cc[0]["mode"] == "sign_ef"
     assert cc[0]["wire_ratio"] <= 1.0 / 16.0
     steps = 2 * (2048 // 64)
-    got = t.telemetry.registry.counter("comm_bytes_total", "").value(
-        mode="sign_ef"
-    )
-    assert got == pytest.approx(t.comm_plan.wire_bytes_per_step * steps)
+    comm = t.telemetry.registry.counter("comm_bytes_total", "")
+    rs = comm.value(mode="sign_ef", phase="rs")
+    ag = comm.value(mode="sign_ef", phase="ag")
+    assert rs == pytest.approx(t.comm_plan.wire_bytes_rs * steps)
+    assert ag == pytest.approx(t.comm_plan.wire_bytes_ag * steps)
+    assert rs + ag == pytest.approx(t.comm_plan.wire_bytes_per_step * steps)
     saved = t.telemetry.registry.counter("comm_saved_bytes_total", "")
     assert saved.total() == pytest.approx(
         t.comm_plan.saved_bytes_per_step * steps
@@ -421,3 +449,312 @@ def test_pad_flat_roundtrip():
     assert padded.shape == (plan.padded,)
     np.testing.assert_array_equal(np.asarray(padded[:100]), np.asarray(x))
     assert float(jnp.abs(padded[100:]).sum()) == 0.0
+
+
+# -- compressed FSDP (ISSUE 9): reduce-scatter oracle -----------------------
+
+
+def test_reduce_scatter_matches_numpy_oracle():
+    """The RS primitive alone on the 8-device mesh: worker j's output is
+    the mean of all workers' decoded contributions for segment j, and
+    `sent` is this worker's own compression decode — the quantities the
+    FSDP transform hands to the ZeRO optimizer and the worker EF."""
+    N = jax.device_count()
+    plan = make_plan(4000, world=N, mode="sign_ef", bucket_size=32,
+                     chunks=3)
+    X = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(7), (N, plan.padded)),
+        np.float32,
+    )
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def body(x):
+        own, sent = reduce_scatter_compressed(
+            x[0], plan, axis_name="data"
+        )
+        return own[None], sent[None]
+
+    f = shard_map(
+        body, mesh=mesh, in_specs=(P("data"),),
+        out_specs=(P("data"), P("data")), check_vma=False,
+    )
+    own, sent = jax.jit(f)(jnp.asarray(X))
+    own, sent = np.asarray(own), np.asarray(sent)
+
+    B = plan.bucket_size
+    Xn = X.reshape(N, N, plan.nb, B)          # worker, segment, bucket, elem
+    scale = np.abs(Xn).mean(-1)
+    dec = scale[..., None] * _np_signs(Xn)
+    np.testing.assert_allclose(sent, dec.reshape(N, -1), rtol=1e-6)
+    # atol: the 8-way mean cancels to near zero where rtol is vacuous
+    expect_own = dec.transpose(1, 0, 2, 3).mean(1)   # (segment, nb, B)
+    np.testing.assert_allclose(
+        own, expect_own.reshape(N, plan.seg), atol=1e-6
+    )
+
+
+def test_all_gather_compressed_roundtrip_on_mesh():
+    """AG primitive: every worker decodes the identical concatenation of
+    the owners' recompressed segments, and own_dec matches the owner's
+    local decode (the owner-EF reference)."""
+    N = jax.device_count()
+    plan = make_plan(2000, world=N, mode="sign_ef", bucket_size=32,
+                     chunks=2)
+    Y = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(8), (N, plan.seg)),
+        np.float32,
+    )
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def body(y):
+        full, own_dec = all_gather_compressed(
+            y[0], plan, axis_name="data"
+        )
+        return full[None], own_dec[None]
+
+    f = shard_map(
+        body, mesh=mesh, in_specs=(P("data"),),
+        out_specs=(P("data"), P("data")), check_vma=False,
+    )
+    full, own_dec = jax.jit(f)(jnp.asarray(Y))
+    full, own_dec = np.asarray(full), np.asarray(own_dec)
+    B = plan.bucket_size
+    Yb = Y.reshape(N, plan.nb, B)
+    dec = np.abs(Yb).mean(-1, keepdims=True) * _np_signs(Yb)
+    assert (full == full[0:1]).all()
+    np.testing.assert_allclose(full[0], dec.reshape(-1), rtol=1e-6)
+    np.testing.assert_allclose(own_dec, dec.reshape(N, plan.seg), rtol=1e-6)
+
+
+def test_fsdp_transform_matches_numpy_ef_oracle():
+    """world-1 sign_compress_fsdp over plain SGD: both residual stages
+    and the update evolve exactly as the NumPy reference — quantize the
+    corrected gradient, apply -lr inside, add the owner residual,
+    quantize the delta, decode."""
+    B, lr = 32, 0.1
+    import optax
+
+    tx = sign_compress_fsdp(
+        optax.sgd(lr), mode="sign_ef", world=1, bucket_size=B, chunks=2
+    )
+    params = {"w": jnp.zeros((9, 11)), "b": jnp.zeros((13,))}
+    state = tx.init(params)
+    flat0, _ = jax.flatten_util.ravel_pytree(params)
+    D = flat0.size
+    plan = make_plan(D, world=1, mode="sign_ef", bucket_size=B,
+                     layout="fsdp")
+    e1 = np.zeros(plan.padded, np.float32)
+    e2 = np.zeros(plan.seg, np.float32)
+    key = jax.random.PRNGKey(3)
+    for _ in range(4):
+        key, k = jax.random.split(key)
+        grads = jax.tree.map(
+            lambda p: jax.random.normal(k, p.shape), params
+        )
+        updates, state = tx.update(grads, state, params)
+        g = np.zeros(plan.padded, np.float32)
+        g[:D] = np.asarray(jax.flatten_util.ravel_pytree(grads)[0])
+        c = g + e1
+        cb = c.reshape(-1, B)
+        dec1 = (np.abs(cb).mean(-1, keepdims=True) * _np_signs(cb)
+                ).reshape(-1)
+        d = -lr * dec1 + e2                 # inner SGD on the owner seg
+        db = d.reshape(-1, B)
+        dec2 = (np.abs(db).mean(-1, keepdims=True) * _np_signs(db)
+                ).reshape(-1)
+        up = np.asarray(jax.flatten_util.ravel_pytree(updates)[0])
+        np.testing.assert_allclose(up, dec2[:D], atol=1e-6)
+        e1 = c - dec1
+        e1[D:] = 0.0
+        e2 = d - dec2
+        e2[D:] = 0.0
+        np.testing.assert_allclose(
+            np.asarray(state.ef_residual[0]), e1, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(state.ef_residual2[0]), e2, atol=1e-6
+        )
+
+
+def test_fsdp_transform_sign_mode_keeps_inner_state_only():
+    import optax
+
+    tx = sign_compress_fsdp(
+        optax.sgd(0.1, momentum=0.9), mode="sign", world=1, bucket_size=32
+    )
+    params = {"w": jnp.ones((40,))}
+    state = tx.init(params)
+    assert state.ef_residual.shape == (1, 0)       # stateless EF
+    assert state.ef_residual2.shape == (1, 0)
+    updates, state2 = tx.update(
+        {"w": jnp.linspace(-1.0, 1.0, 40)}, state, params
+    )
+    assert updates["w"].shape == (40,)
+    # the momentum trace lives in the (world, seg) segment rows
+    trace = [l for l in jax.tree.leaves(state2.inner)
+             if getattr(l, "ndim", 0) == 2]
+    assert trace and trace[0].shape[0] == 1
+
+
+# -- compressed FSDP: trainer integration -----------------------------------
+
+
+def test_compressed_fsdp_trains_within_2pct_of_fp32_fsdp(tmp_path):
+    """ISSUE-9 acceptance smoke: sign_ef under dp_mode='fsdp' on the
+    8-device mesh trains within 2 accuracy points of the fp32 GSPMD
+    FSDP baseline, with wire bytes <= 1/8 of the fp32 RS+AG pair
+    (actually ~1/31), adam moments ZeRO-sharded over 'data', and the
+    per-phase byte counters banked."""
+    data = _data()
+    base = Trainer(_cfg(dp_mode="fsdp"))
+    assert base.comm_plan is not None and base.comm_plan.mode == "fp32"
+    assert base.comm_plan.layout == "fsdp"
+    base_acc = base.fit(data)[-1]["test_acc"]
+
+    tel = str(tmp_path / "tel")
+    t = Trainer(_cfg(
+        dp_mode="fsdp", grad_compress="sign_ef", telemetry_dir=tel,
+    ))
+    assert t.mesh is not None and int(t.mesh.devices.size) == 8
+    p = t.comm_plan
+    assert p.mode == "sign_ef" and p.layout == "fsdp" and p.world == 8
+    assert p.wire_bytes_per_step <= base.comm_plan.wire_bytes_per_step / 8
+    assert p.wire_bytes_rs + p.wire_bytes_ag == p.wire_bytes_per_step
+    acc = t.fit(data)[-1]["test_acc"]
+    assert acc >= base_acc - 2.0
+
+    # the FSDP compression state: EF rows + base-optimizer moment rows,
+    # all (world, ...) with the leading axis sharded over 'data'
+    fs = [
+        n for n in jax.tree.leaves(
+            t.state.opt_state,
+            is_leaf=lambda x: isinstance(x, FsdpCompressState),
+        ) if isinstance(n, FsdpCompressState)
+    ]
+    assert fs, "FsdpCompressState missing from opt_state"
+    st = fs[0]
+    assert st.ef_residual.shape[0] == 8
+    assert float(jnp.abs(st.ef_residual).sum()) > 0
+    moments = [l for l in jax.tree.leaves(st.inner)
+               if getattr(l, "ndim", 0) == 2]
+    assert moments, "base-optimizer moment rows missing"
+    for m in moments:
+        assert m.shape == (8, p.seg)
+        assert m.sharding.spec == P("data")
+    assert any(float(jnp.abs(m).sum()) > 0 for m in moments)
+
+    # telemetry: plan event carries the fsdp layout + per-phase bytes;
+    # the counters accumulate the same numbers per phase
+    events = load_events(os.path.join(tel, "events.jsonl"))
+    cc = [e for e in events if e["kind"] == "comm_compress"]
+    assert cc and cc[0]["mode"] == "sign_ef" and cc[0]["layout"] == "fsdp"
+    assert cc[0]["wire_bytes_rs"] + cc[0]["wire_bytes_ag"] == (
+        cc[0]["wire_bytes_per_step"]
+    )
+    steps = 2 * (2048 // 64)
+    comm = t.telemetry.registry.counter("comm_bytes_total", "")
+    assert comm.value(mode="sign_ef", phase="rs") == pytest.approx(
+        p.wire_bytes_rs * steps
+    )
+    assert comm.value(mode="sign_ef", phase="ag") == pytest.approx(
+        p.wire_bytes_ag * steps
+    )
+    # the final metrics event snapshots the counters into the event log
+    snaps = [e for e in events if e["kind"] == "metrics"]
+    assert snaps, "metrics snapshot missing from the closed event log"
+    series = snaps[-1]["registry"]["comm_bytes_total"]["series"]
+    assert any(
+        s["labels"] == {"mode": "sign_ef", "phase": "rs"}
+        and s["value"] > 0
+        for s in series
+    )
+
+
+def test_fp32_fsdp_records_comm_baseline():
+    t = Trainer(_cfg(dp_mode="fsdp"))
+    p = t.comm_plan
+    assert p is not None and p.mode == "fp32" and p.layout == "fsdp"
+    assert p.wire_bytes_per_step == p.fp32_bytes_per_step
+    assert p.wire_bytes_rs + p.wire_bytes_ag == p.wire_bytes_per_step
+
+
+def test_fsdp_preempt_resume_bitwise_with_zero_sharded_ef(tmp_path):
+    """Resilience invariant under the FSDP layout: a compressed-FSDP run
+    preempted mid-epoch resumes to EXACTLY the uninterrupted run's
+    state — the ZeRO-sharded EF residuals AND the segment-row base
+    optimizer moments ride in the checkpointed opt_state."""
+    data = _data(512, 128)
+    kw = dict(grad_compress="sign_ef", dp_mode="fsdp", seed=1)
+    base = Trainer(_cfg(**kw))
+    base.fit(data)
+
+    ckpt = str(tmp_path / "ckpts")
+    t1 = Trainer(_cfg(**kw, checkpoint_dir=ckpt, chaos="preempt@step=5"))
+    with pytest.raises(Preempted):
+        t1.fit(data)
+    reset_fire_counts()
+    t2 = Trainer(_cfg(**kw, checkpoint_dir=ckpt, resume=True))
+    t2.fit(data)
+    assert int(t2.state.step) == int(base.state.step)
+    for a, b in zip(
+        jax.tree.leaves(base.state.params), jax.tree.leaves(t2.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ef_sum = 0.0
+    for a, b in zip(
+        jax.tree.leaves(base.state.opt_state),
+        jax.tree.leaves(t2.state.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        if getattr(a, "ndim", 0) == 2 and a.shape[0] == 8:
+            ef_sum += float(np.abs(np.asarray(a)).sum())
+    assert ef_sum > 0.0  # the sharded buffers the equality covered were live
+
+
+@pytest.mark.parametrize("dp_mode", ["fsdp", "gspmd"])
+def test_scan_composition_bitwise_and_zero_extra_compiles(dp_mode):
+    """ISSUE-9 scan acceptance: scan_steps=4 through the compressed
+    exchange equals the step-at-a-time run BITWISE (params and the
+    whole opt_state incl. both EF stages), and the fused dispatch
+    compiles exactly once — a budget-0 recompile fence stays green
+    across 2 epochs (the scanned program is the only post-init compile;
+    the fence would trip on any sharding/shape leak, e.g. the
+    hyperparam-write placement flip this round fixed)."""
+    data = _data(512, 128)
+
+    def run(scan_steps, **kw):
+        t = Trainer(_cfg(
+            grad_compress="sign_ef", dp_mode=dp_mode, seed=0,
+            scan_steps=scan_steps, **kw,
+        ))
+        t.fit(data, eval_every=0)
+        return t
+
+    a = run(1)
+    b = run(4, sanitize="recompile", recompile_budget=0)
+    assert int(a.state.step) == int(b.state.step) == 16
+    for x, y in zip(
+        jax.tree.leaves(a.state.params), jax.tree.leaves(b.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(
+        jax.tree.leaves(a.state.opt_state),
+        jax.tree.leaves(b.state.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_world1_compression_composes_with_scan():
+    """Without a DP mesh the exchange is collective-free, so the
+    generic make_train_scan path hosts it unchanged — scan_steps>1 +
+    grad_compress on one device must train, not raise (it was on PR 5's
+    rejection list)."""
+    data = _data(256, 128)
+    t = Trainer(TrainConfig(
+        model="bnn-mlp-small", epochs=1, batch_size=64, backend="xla",
+        grad_compress="sign_ef", scan_steps=2, seed=0,
+    ))
+    assert t.mesh is None and t.comm_plan.world == 1
+    first = t.evaluate(data)
+    acc = t.fit(data)[-1]["test_acc"]
+    assert acc > first["test_acc"]

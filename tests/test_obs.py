@@ -219,10 +219,14 @@ def test_telemetry_record_step_derives_metrics(tmp_path):
     tel.close()
     events = load_events(str(tmp_path / "events.jsonl"))
     kinds = [e["kind"] for e in events]
-    assert kinds == ["step", "epoch", "run_end"]
+    # close() seals the log with the final registry snapshot, then
+    # run_end — counters are post-mortem-readable from the file alone.
+    assert kinds == ["step", "epoch", "metrics", "run_end"]
     assert events[1]["latency"]["p50"] is not None
     assert isinstance(events[1]["recompiles_total"], int)
-    assert "recompiles_total" in events[2]
+    assert "recompiles_total" in events[3]
+    snap = events[2]["registry"]
+    assert snap["train_examples_total"]["series"][0]["value"] == 64
     assert reg.counter("train_examples_total").total() == 64
 
 
