@@ -89,8 +89,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "'step_fault@step=5;ckpt_corrupt@epoch=1;"
                              "preempt@step=12'. Kinds: step_fault, "
                              "data_io, preempt, slow_host, ckpt_corrupt, "
-                             "ckpt_truncate, infer_slow, infer_error. "
+                             "ckpt_truncate, infer_slow, infer_error, "
+                             "worker_lost, worker_restore (the last two "
+                             "need --elastic). "
                              "Default: the JG_CHAOS env var")
+        sp.add_argument("--elastic", action="store_true",
+                        help="elastic data-parallel membership "
+                             "(RESILIENCE.md 'Elastic membership'): a "
+                             "chaos worker_lost/worker_restore shrinks/"
+                             "regrows the mesh in-process, re-placing "
+                             "state from the newest digest-verified "
+                             "checkpoint generation instead of "
+                             "restarting the job. Needs "
+                             "--checkpoint-dir; DP only (TP/PP/"
+                             "device-data/orbax rejected)")
         sp.add_argument("--checkpoint-keep", type=int, default=3,
                         help="checkpoint generations kept for corruption "
                              "rollback (digest-verified on resume)")
@@ -489,7 +501,13 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
+def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10,
+                  overrides=None):
+    """``overrides``: TrainConfig field replacements — the elastic
+    supervisor's rebuild path uses it to re-target ``data_parallel`` at
+    the post-change world with ``resume`` forced on."""
+    import dataclasses
+
     from .train import TrainConfig, Trainer
 
     model_kwargs = {}
@@ -543,6 +561,7 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         recompile_budget=args.recompile_budget,
         nan_check_every=args.nan_check_every,
         chaos=args.chaos,
+        elastic=getattr(args, "elastic", False),
         checkpoint_keep=args.checkpoint_keep,
         handle_preemption=not args.no_preemption,
         remat=args.remat,
@@ -552,6 +571,8 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10):
         aot=getattr(args, "aot", False),
         aot_dir=getattr(args, "aot_dir", None),
     )
+    if overrides:
+        config = dataclasses.replace(config, **overrides)
     return Trainer(config, input_shape=input_shape)
 
 
@@ -669,6 +690,46 @@ def _cmd_aot(args) -> int:
     # build is idempotent.
     print(json.dumps({"store": store.root, "built": built}))
     return 0
+
+
+def _fit_elastic(args, data, first_trainer):
+    """Run a fit under the in-process elastic supervisor (RESILIENCE.md
+    "Elastic membership"): chaos ``worker_lost``/``worker_restore``
+    shrinks/regrows the DP mesh with state re-placed from the newest
+    digest-verified checkpoint generation — no job restart, no exit 75,
+    except for a REAL scheduler signal, which still vacates with the
+    resumable exit code."""
+    from .obs import Telemetry
+    from .resilience import RetryPolicy
+    from .resilience.elastic import run_elastic
+
+    first = [first_trainer]
+
+    def make_tr(world):
+        if world is None and first:
+            return first.pop()
+        return _make_trainer(
+            args, input_shape=data.input_shape,
+            num_classes=getattr(data, "n_classes", 10),
+            overrides={"data_parallel": world, "resume": True},
+        )
+
+    # The supervisor's remesh/restart events append to the same
+    # events.jsonl the trainers write (each seals its own log before
+    # the supervisor emits) — the chaos_smoke policy-telemetry pattern.
+    sup_tel = (
+        Telemetry(args.telemetry_dir, heartbeat=False)
+        if args.telemetry_dir else None
+    )
+    try:
+        return _fit_resumable(lambda: run_elastic(
+            make_tr, lambda t: t.fit(data),
+            policy=RetryPolicy(seed=args.seed),
+            telemetry=sup_tel,
+        ))
+    finally:
+        if sup_tel is not None:
+            sup_tel.close()
 
 
 def _fit_resumable(fit_fn):
@@ -1110,7 +1171,10 @@ def main(argv=None) -> int:
     )
 
     if args.cmd == "train":
-        rc, history = _fit_resumable(lambda: trainer.fit(data))
+        if getattr(args, "elastic", False):
+            rc, history = _fit_elastic(args, data, trainer)
+        else:
+            rc, history = _fit_resumable(lambda: trainer.fit(data))
         if rc:
             return rc
         final = history[-1] if history else {}

@@ -23,7 +23,14 @@ Event kinds (schema v1):
                  (epoch/step/data position, digest_verified flag)
   rollback       restore skipped corrupt generation(s) (resilience)
   restart        the retry loop rebuilt the trainer (cause, attempt,
-                 backoff — resilience/policy)
+                 backoff, world_size/mesh_shape — resilience/policy)
+  membership_change  the elastic supervisor noted a data-parallel
+                 membership change (event=lost|restored,
+                 world_from/world_to, step — resilience/elastic)
+  remesh         the elastic loop rebuilt the mesh at a new world and
+                 re-placed state from the newest verified checkpoint
+                 generation (direction=shrink|grow, world_from/
+                 world_to, event, step — resilience/elastic)
   comm_compress  the run's 1-bit gradient-exchange plan (mode, layout=
                  dp|fsdp, buckets, per-phase rs/ag wire bytes/step vs
                  fp32 — PERF.md)

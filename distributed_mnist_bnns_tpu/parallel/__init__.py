@@ -9,6 +9,12 @@ from .fsdp import (
     shard_state_fsdp,
 )
 from .mesh import make_hybrid_mesh, make_mesh
+from .remesh import (
+    fold_worker_rows,
+    mesh_topology,
+    refold_segment_rows,
+    remesh_compress_state,
+)
 from .distributed import initialize_multihost
 from .data_parallel import (
     make_compressed_dp_train_step,
@@ -59,6 +65,10 @@ __all__ = [
     "shard_map",
     "make_mesh",
     "make_hybrid_mesh",
+    "fold_worker_rows",
+    "mesh_topology",
+    "refold_segment_rows",
+    "remesh_compress_state",
     "compressed_state_shardings",
     "compressed_state_specs",
     "fsdp_shardings",

@@ -1,0 +1,216 @@
+"""Elastic re-placement of training state across data-parallel worlds.
+
+A preempted worker used to cost the whole job: the mesh is sized at
+launch, and every ``(world, ...)``-shaped buffer the 1-bit gradient
+exchange keeps in optimizer state (ops/comm_compress, PERF.md "Gradient
+comms") is laid out for exactly that world. This module is the state
+half of elastic membership (resilience/elastic, RESILIENCE.md "Elastic
+membership"): given a checkpoint written at world ``W_old`` and a run
+rebuilt at world ``W_new``, it re-places every compression-state row
+onto the new topology so training continues instead of restarting.
+
+Two distinct row semantics, two distinct re-placements:
+
+* **per-worker rows** (``ef_residual`` — one private error-feedback
+  residual per worker over the padded flat gradient): the exchange
+  combines worker contributions by MEAN, and a shrink re-shards the
+  batch so new worker *j*'s gradient stream is the mean of the old
+  workers it absorbed — the contribution-preserving re-placement is the
+  groupwise MEAN of adjacent rows (``mean_j e'_j == mean_i e_i``: no
+  error mass enters or leaves through the combine). A regrow re-splits
+  by copying each row to its successors, preserving the mean the same
+  way. (:func:`fold_worker_rows`)
+* **per-segment-owner rows** (``ef_residual2``, and the base
+  optimizer's moments inside ``FsdpCompressState.inner`` — row *j*
+  covers parameter positions ``[j*seg, (j+1)*seg)``): flattened, these
+  rows are ONE vector indexed by padded parameter position, so the
+  re-placement is position-preserving — flatten, copy, reshape to the
+  new ``(world, seg)`` layout. Every parameter keeps exactly its own
+  adam moments / owner residual; a world-8 → world-4 shrink folds
+  adjacent segment-row PAIRS into one row, a regrow re-splits them.
+  (:func:`refold_segment_rows`)
+
+Width changes (the plans' ``padded``/``seg`` differ across worlds) copy
+the overlapping prefix; positions at/after ``n_params`` are zero by the
+transforms' invariant (they zero the pad tails every step), so nothing
+real is truncated. All functions are host-side NumPy on the restored
+host arrays — the jitted step's pinned ``in_shardings`` place the
+re-folded state onto the new mesh on the first dispatch.
+
+Proven by tests/test_elastic.py: NumPy oracles for both fold rules, and
+bitwise equality of the post-shrink trajectory against a fresh world-N
+run resumed from the same checkpoint generation.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..ops.comm_compress import CommPlan, make_plan
+
+log = logging.getLogger(__name__)
+
+
+def mesh_topology(mesh) -> Tuple[int, dict]:
+    """``(data-parallel world size, {axis: size})`` for a mesh
+    (``None`` → ``(1, {})``) — the fields checkpoint meta and the
+    resume/restart/remesh events record so post-incident forensics can
+    see whether a restore changed topology."""
+    if mesh is None:
+        return 1, {}
+    shape = {str(k): int(v) for k, v in mesh.shape.items()}
+    return int(shape.get("data", 1)), shape
+
+
+def fold_worker_rows(
+    rows: np.ndarray, new_world: int, new_width: int
+) -> np.ndarray:
+    """Re-place per-WORKER residual rows ``(old_world, old_width)`` →
+    ``(new_world, new_width)``.
+
+    Shrink (``old_world % new_world == 0``): groupwise mean of adjacent
+    rows — new worker *j* absorbs old workers ``[g*j, g*(j+1))``, the
+    same contiguous re-sharding the batch axis undergoes. Grow
+    (``new_world % old_world == 0``): each row is copied to its ``g``
+    successors. Anything else has no contiguous worker mapping and
+    raises. See the module docstring for why MEAN/copy is the
+    contribution-preserving choice under the exchange's mean combine.
+    """
+    rows = np.asarray(rows)
+    if rows.ndim != 2:
+        raise ValueError(f"worker rows must be 2-D, got {rows.shape}")
+    old_world, old_width = rows.shape
+    if old_world == new_world:
+        folded = rows
+    elif old_world % new_world == 0:
+        g = old_world // new_world
+        folded = rows.reshape(new_world, g, old_width).mean(axis=1)
+    elif new_world % old_world == 0:
+        g = new_world // old_world
+        folded = np.repeat(rows, g, axis=0)
+    else:
+        raise ValueError(
+            f"cannot re-place worker rows from world {old_world} to "
+            f"{new_world}: one world size must divide the other"
+        )
+    out = np.zeros((new_world, new_width), rows.dtype)
+    m = min(old_width, new_width)
+    out[:, :m] = folded[:, :m]
+    return out
+
+
+def refold_segment_rows(
+    rows: np.ndarray, new_world: int, new_seg: int
+) -> np.ndarray:
+    """Re-place per-SEGMENT-OWNER rows ``(old_world, old_seg)`` →
+    ``(new_world, new_seg)`` position-preservingly: row *j* covers
+    parameter positions ``[j*seg, (j+1)*seg)`` of the flattened params,
+    so the rows concatenate to one position-indexed vector that is
+    simply re-cut at the new segment boundaries (world-8 → world-4
+    folds adjacent row pairs; regrow re-splits them). The tail at/after
+    ``n_params`` is zero by the transforms' invariant."""
+    rows = np.asarray(rows)
+    if rows.ndim != 2:
+        raise ValueError(f"segment rows must be 2-D, got {rows.shape}")
+    flat = rows.reshape(-1)
+    out = np.zeros(new_world * new_seg, rows.dtype)
+    m = min(flat.size, out.size)
+    out[:m] = flat[:m]
+    return out.reshape(new_world, new_seg)
+
+
+def _old_plan(plan: CommPlan, old_world: int) -> CommPlan:
+    """The checkpoint-side plan: same gradient, same knobs, old world."""
+    return make_plan(
+        plan.n_params, world=old_world, mode=plan.mode,
+        bucket_size=plan.bucket_size, chunks=plan.chunks,
+        layout=plan.layout,
+    )
+
+
+def _check_ef_widths(name: str, node, old: CommPlan) -> None:
+    """The restored node must BE a world-``old.world`` layout of this
+    plan — fold math on foreign shapes would quietly produce garbage."""
+    ef = np.asarray(node.ef_residual)
+    ef2 = np.asarray(node.ef_residual2)
+    ok = (
+        ef.ndim == 2 and ef2.ndim == 2
+        and ef.shape[0] == old.world and ef2.shape[0] == old.world
+        and ef.shape[1] in (0, old.padded)
+        and ef2.shape[1] in (0, old.seg)
+    )
+    if not ok:
+        raise ValueError(
+            f"{name} rows {ef.shape}/{ef2.shape} do not match the "
+            f"world-{old.world} plan (padded={old.padded}, "
+            f"seg={old.seg}) — checkpoint from a different model/"
+            "bucket configuration, not just a different world"
+        )
+
+
+def remesh_compress_state(
+    opt_state: Any, plan: CommPlan
+) -> Tuple[Any, int]:
+    """Re-place every 1-bit-exchange compression node in ``opt_state``
+    (restored from a checkpoint at a different world size, as host
+    arrays) onto ``plan``'s world. Returns ``(new_opt_state,
+    nodes_replaced)``; nodes already at ``plan.world`` pass through
+    untouched, so the call is idempotent. Zero-width EF rows (the
+    stateless ``sign`` mode) stay zero-width."""
+    from ..train.optim import (  # local import (parallel <-> train cycle)
+        FsdpCompressState,
+        SignCompressState,
+    )
+
+    replaced = 0
+
+    def fold(node):
+        nonlocal replaced
+        if not isinstance(node, (SignCompressState, FsdpCompressState)):
+            return node
+        old_world = int(np.asarray(node.ef_residual).shape[0])
+        if old_world == plan.world:
+            return node
+        old = _old_plan(plan, old_world)
+        name = type(node).__name__
+        _check_ef_widths(name, node, old)
+        ef_w = plan.padded if np.asarray(node.ef_residual).shape[1] else 0
+        ef2_w = plan.seg if np.asarray(node.ef_residual2).shape[1] else 0
+        ef = fold_worker_rows(node.ef_residual, plan.world, ef_w)
+        ef2 = refold_segment_rows(node.ef_residual2, plan.world, ef2_w)
+        replaced += 1
+        log.info(
+            "remesh: re-placed %s world %d -> %d (seg %d -> %d)",
+            name, old_world, plan.world, old.seg, plan.seg,
+        )
+        if isinstance(node, SignCompressState):
+            return SignCompressState(ef_residual=ef, ef_residual2=ef2)
+
+        def fold_inner(leaf):
+            arr = np.asarray(leaf)
+            if arr.shape == (old_world, old.seg):
+                return refold_segment_rows(arr, plan.world, plan.seg)
+            if arr.ndim == 0 or arr.shape == (plan.world, plan.seg):
+                return leaf
+            raise ValueError(
+                f"unexpected base-optimizer state leaf {arr.shape} in "
+                f"{name}.inner (want ({old_world}, {old.seg}) segment "
+                "rows or a scalar) — cannot re-place"
+            )
+
+        return FsdpCompressState(
+            ef_residual=ef, ef_residual2=ef2,
+            inner=jax.tree.map(fold_inner, node.inner),
+        )
+
+    new_state = jax.tree.map(
+        fold, opt_state,
+        is_leaf=lambda n: isinstance(
+            n, (SignCompressState, FsdpCompressState)
+        ),
+    )
+    return new_state, replaced

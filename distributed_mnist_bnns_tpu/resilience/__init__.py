@@ -13,6 +13,11 @@ common case; this package supplies both halves of surviving them:
            classification and a per-run restart budget, plus the
            closed/open/half-open ``CircuitBreaker`` the packed-serving
            engine (serve/) wraps around its predictor calls
+  elastic  in-process elastic data-parallel membership: chaos
+           ``worker_lost``/``worker_restore`` drive a mesh shrink/grow
+           with state re-placement from the newest digest-verified
+           checkpoint generation (parallel/remesh) instead of a
+           full-job restart
 
 The trainer wires chaos + preempt through ``TrainConfig.chaos`` /
 ``--chaos`` / ``JG_CHAOS`` and ``handle_preemption``; the retry loop is
@@ -23,6 +28,7 @@ RESILIENCE.md for the fault catalog, spec grammar and event schema.
 """
 
 from .chaos import (
+    MEMBERSHIP_KINDS,
     ChaosController,
     ChaosFault,
     ChaosInferError,
@@ -32,6 +38,7 @@ from .chaos import (
     parse_chaos_spec,
     reset_fire_counts,
 )
+from .elastic import MembershipView, run_elastic
 from .policy import (
     DEFAULT_FATAL_TYPES,
     CircuitBreaker,
@@ -39,10 +46,12 @@ from .policy import (
     TrainingFailure,
     classify_failure,
     run_with_policy,
+    trainer_topology,
 )
 from .preempt import PREEMPT_EXIT_CODE, Preempted, StopRequest
 
 __all__ = [
+    "MEMBERSHIP_KINDS",
     "ChaosController",
     "ChaosFault",
     "ChaosInferError",
@@ -51,6 +60,7 @@ __all__ = [
     "CircuitBreaker",
     "DEFAULT_FATAL_TYPES",
     "FaultRule",
+    "MembershipView",
     "PREEMPT_EXIT_CODE",
     "Preempted",
     "RetryPolicy",
@@ -59,5 +69,7 @@ __all__ = [
     "classify_failure",
     "parse_chaos_spec",
     "reset_fire_counts",
+    "run_elastic",
     "run_with_policy",
+    "trainer_topology",
 ]

@@ -14,7 +14,8 @@ Spec grammar (``TrainConfig.chaos`` / ``--chaos`` / ``JG_CHAOS`` env)::
     kind     := step_fault | data_io | preempt | slow_host
               | ckpt_corrupt | ckpt_truncate
               | infer_slow | infer_error
-    key      := step | epoch | p | times | delay_s
+              | worker_lost | worker_restore
+    key      := step | epoch | p | times | delay_s | world
 
 ``step``/``epoch`` trigger a rule the first time the run reaches that
 global optimizer step / epoch (``>=`` semantics, so scan-chunked
@@ -42,6 +43,17 @@ Fault points:
                  past its stall budget as a breaker failure)
   infer_error    raises :class:`ChaosInferError` at the predictor call
                  (transient backend error)
+  worker_lost    simulated loss of data-parallel workers: reports a
+                 membership change to the elastic supervisor
+                 (``world=N`` — the post-loss world size — is
+                 mandatory), which shrinks the mesh and re-places state
+                 from the newest verified checkpoint generation
+                 (resilience/elastic, RESILIENCE.md "Elastic
+                 membership"). Requires the elastic loop; a trainer
+                 without ``elastic=True`` rejects the spec at init.
+  worker_restore the lost workers came back: membership change back to
+                 ``world=N`` (default: the launch world) — the
+                 supervisor regrows the mesh and re-splits state
 
 Serving rules trigger on ``step`` = the serving engine's micro-batch
 sequence number (or ``p``), so one spec composes training and serving
@@ -76,6 +88,7 @@ FAULT_KINDS = frozenset({
     "step_fault", "data_io", "preempt", "slow_host",
     "ckpt_corrupt", "ckpt_truncate",
     "infer_slow", "infer_error",
+    "worker_lost", "worker_restore",
 })
 
 # Which kinds each fault point dispatches — a rule only evaluates its
@@ -85,6 +98,10 @@ FAULT_KINDS = frozenset({
 _STEP_KINDS = frozenset({"step_fault", "data_io", "preempt", "slow_host"})
 _CKPT_KINDS = frozenset({"ckpt_corrupt", "ckpt_truncate"})
 _INFER_KINDS = frozenset({"infer_slow", "infer_error"})
+# Membership kinds fire at the trainer step boundary like _STEP_KINDS
+# but are dispatched to the elastic supervisor's hook, not the trainer —
+# exported so the Trainer can reject them loudly without --elastic.
+MEMBERSHIP_KINDS = frozenset({"worker_lost", "worker_restore"})
 
 FAULTS_TOTAL = "faults_injected_total"
 
@@ -125,6 +142,7 @@ class FaultRule:
     p: float = 0.0
     times: int = 1
     delay_s: float = 1.0
+    world: Optional[int] = None  # membership kinds: post-change world
     key: str = ""
 
 
@@ -145,7 +163,7 @@ def parse_chaos_spec(spec: str) -> List[FaultRule]:
             )
         rule = FaultRule(kind=kind, key=f"{raw}#{i}")
         casts = {"step": int, "epoch": int, "p": float, "times": int,
-                 "delay_s": float}
+                 "delay_s": float, "world": int}
         for arg in (a.strip() for a in argstr.split(",")):
             if not arg:
                 continue
@@ -155,7 +173,7 @@ def parse_chaos_spec(spec: str) -> List[FaultRule]:
             if k not in casts:
                 raise ValueError(
                     f"unknown chaos key {k!r} in {raw!r} "
-                    "(have: step, epoch, p, times, delay_s)"
+                    "(have: step, epoch, p, times, delay_s, world)"
                 )
             try:
                 setattr(rule, k, casts[k](v))
@@ -166,6 +184,21 @@ def parse_chaos_spec(spec: str) -> List[FaultRule]:
         if rule.step is None and rule.epoch is None and rule.p <= 0:
             raise ValueError(
                 f"chaos entry {raw!r} needs a trigger: step=, epoch= or p="
+            )
+        if rule.world is not None and kind not in MEMBERSHIP_KINDS:
+            raise ValueError(
+                f"chaos key 'world' in {raw!r} only applies to "
+                "worker_lost/worker_restore"
+            )
+        if kind == "worker_lost" and (rule.world is None or rule.world < 1):
+            raise ValueError(
+                f"chaos entry {raw!r} needs world=N >= 1 (the post-loss "
+                "data-parallel world size)"
+            )
+        if rule.world is not None and rule.world < 1:
+            raise ValueError(
+                f"chaos entry {raw!r}: world must be >= 1, "
+                f"got {rule.world}"
             )
         rules.append(rule)
     return rules
@@ -199,6 +232,12 @@ class ChaosController:
         # Wired by the trainer to StopRequest.request; the fallback
         # exercises the real signal path.
         self.on_preempt: Optional[Callable[[str], None]] = None
+        # Wired by the elastic supervisor (resilience/elastic): called
+        # as on_membership(event, world=, step=, epoch=) with event
+        # "lost"|"restored" when a membership kind fires. Without a
+        # supervisor a fired membership rule raises — silently dropping
+        # a scripted worker loss would make the chaos test vacuous.
+        self.on_membership: Optional[Callable[..., None]] = None
         self._rngs = {
             r.key: random.Random(f"{seed}:{r.key}") for r in rules
         }
@@ -271,7 +310,9 @@ class ChaosController:
         never progress past K. Called by the trainer after a successful
         restore. Step rules at ``<= step`` are exhausted up to their
         ``times`` cap. Epoch rules depend on the fault point: step-
-        boundary kinds (step_fault/data_io/preempt/slow_host) fire at
+        boundary kinds (step_fault/data_io/preempt/slow_host, and the
+        membership kinds worker_lost/worker_restore, which fire at the
+        same point) fire at
         the START of their epoch, so being resumed AT epoch E means an
         epoch-``<= E`` rule fired (``preempt@epoch=E`` produced this
         very resume — it must not refire and relaunch-loop); checkpoint-
@@ -311,11 +352,31 @@ class ChaosController:
     ) -> None:
         """Pre-dispatch fault point (raises for data_io/step_fault)."""
         for rule in self.rules:
-            if rule.kind not in _STEP_KINDS:
+            if (
+                rule.kind not in _STEP_KINDS
+                and rule.kind not in MEMBERSHIP_KINDS
+            ):
                 continue
             if not self._should_fire(rule, step, epoch):
                 continue
-            if rule.kind == "slow_host":
+            if rule.kind in MEMBERSHIP_KINDS:
+                if self.on_membership is None:
+                    raise ValueError(
+                        f"chaos {rule.kind} fired with no elastic "
+                        "supervisor attached — membership faults need "
+                        "the elastic training loop (cli train --elastic "
+                        "/ resilience.elastic.run_elastic)"
+                    )
+                self._record(
+                    rule, "step", step, epoch,
+                    f"world={rule.world}" if rule.world is not None
+                    else "world=launch",
+                )
+                self.on_membership(
+                    "lost" if rule.kind == "worker_lost" else "restored",
+                    world=rule.world, step=step, epoch=epoch,
+                )
+            elif rule.kind == "slow_host":
                 self._record(
                     rule, "step", step, epoch, f"stall {rule.delay_s}s"
                 )

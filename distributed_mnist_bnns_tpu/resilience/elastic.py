@@ -1,0 +1,252 @@
+"""Elastic data-parallel membership: chaos-driven mesh shrink/grow.
+
+On a preemptible TPU fleet, losing a worker mid-run is the COMMON case
+— and until this module, it cost the whole job: the mesh is sized at
+launch, so the only recovery was a full restart from a hand-carried
+checkpoint. :func:`run_elastic` is an in-process supervisor that turns
+a membership change into a *remesh* instead:
+
+  1. **detect** — the seed-deterministic chaos kinds ``worker_lost`` /
+     ``worker_restore`` (resilience/chaos) report membership changes to
+     the supervisor's hook at a step boundary; the hook records the
+     target world in a :class:`MembershipView` and requests a graceful
+     stop exactly like a SIGTERM would (a ``membership_change`` event
+     lands in the run log first);
+  2. **checkpoint-or-roll-back** — the trainer's graceful-stop path
+     writes a step-granular checkpoint at the detection boundary; the
+     rebuild then restores the newest *digest-verified* generation
+     (utils/checkpoint.load_checkpoint_resilient), rolling back past
+     any corrupt one — so a save damaged in the same incident costs at
+     most one generation, never the job;
+  3. **remesh** — the trainer is rebuilt IN-PROCESS at the new world
+     (``make_trainer(new_world)``: a smaller — or re-grown — mesh via
+     ``parallel.mesh.make_mesh``), and the restore re-places every
+     ``(world, ...)``-shaped row of the 1-bit compression state onto
+     the new topology (parallel/remesh: worker EF rows fold by
+     groupwise mean, segment-owner rows — including the ZeRO-sharded
+     base-optimizer moments — re-cut position-preservingly). A
+     ``remesh`` event + ``remesh_total{direction}`` counter and the
+     ``world_size`` gauge record the transition; NO ``restart`` event
+     is emitted — membership churn is routine, not failure, and does
+     not consume the retry budget (the same reasoning that exempts
+     preemption in :mod:`.policy`).
+
+Non-membership failures keep :func:`.policy.run_with_policy` semantics
+(same classification, backoff and budget — this loop is that one plus a
+membership branch): transient errors rebuild at the CURRENT world after
+a jittered backoff, fatal errors re-raise at once, plain preemptions
+resume without burning the failure budget. One deliberate difference: a
+graceful stop caused by a REAL process signal (``Preempted.reason``
+starting with ``"signal "``) re-raises instead of resuming — a
+scheduler's SIGTERM means this process must vacate the machine, and an
+in-process supervisor that "resumed" it would fight its scheduler (the
+CLI maps the re-raise to exit 75 so the external relaunch-with-resume
+contract still holds).
+
+Single-controller by design: this codebase's meshes live in one
+process (the simulated 8-device CPU mesh, a single-host TPU slice), so
+membership is a host-local decision. A multi-host deployment would put
+this loop on the coordinator and broadcast the view — the state
+re-placement half (parallel/remesh) is already topology-agnostic.
+
+See RESILIENCE.md "Elastic membership"; proven end-to-end by
+tests/test_elastic.py and the CI ``elastic-smoke`` job
+(scripts/elastic_smoke.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, TypeVar
+
+from .policy import (
+    RetryPolicy,
+    TrainingFailure,
+    handle_failure,
+    handle_preemption,
+    trainer_topology,
+)
+from .preempt import Preempted
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+REMESH_TOTAL = "remesh_total"
+WORLD_SIZE_GAUGE = "world_size"
+
+
+@dataclass
+class MembershipView:
+    """The supervisor's view of data-parallel membership.
+
+    ``full_world`` is the launch world — ``worker_restore`` without an
+    explicit ``world=`` returns to it. ``world`` is the world the
+    current trainer runs at; ``pending`` is a requested-but-not-yet-
+    applied change (set by the chaos hook at the step boundary that
+    detected it, consumed by the supervisor when the graceful stop
+    surfaces as :class:`Preempted`)."""
+
+    full_world: int
+    world: int
+    pending: Optional[Dict[str, Any]] = None
+
+
+def _registry(telemetry: Any):
+    if telemetry is not None:
+        return telemetry.registry
+    from ..obs import default_registry  # lazy: keep import-time light
+
+    return default_registry()
+
+
+def _wire_membership(trainer: Any, view: MembershipView) -> None:
+    """Attach the membership hook to this trainer's chaos controller:
+    record the target world on ``view``, bank a ``membership_change``
+    event, and request a graceful stop at the same step boundary — the
+    identical stop/checkpoint path a preemption takes, so the remesh
+    resumes step-granularly from the detection point."""
+
+    def on_membership(event, world=None, step=None, epoch=None):
+        target = int(world) if world else view.full_world
+        if target == view.world:
+            log.info(
+                "membership %s at step %s: already at world %d; "
+                "no remesh needed", event, step, target,
+            )
+            return
+        view.pending = {"event": event, "world": target, "step": step}
+        trainer.telemetry.emit(
+            "membership_change", event=event, world_from=view.world,
+            world_to=target, step=step, epoch=epoch,
+        )
+        trainer.stop.request(
+            f"membership change: worker {event} -> world {target}"
+        )
+
+    trainer.chaos.on_membership = on_membership
+
+
+def run_elastic(
+    make_trainer: Callable[[Optional[int]], Any],
+    run: Callable[[Any], T],
+    *,
+    policy: Optional[RetryPolicy] = None,
+    telemetry: Any = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Execute ``run(make_trainer(None))`` under elastic membership.
+
+    ``make_trainer(world)`` builds a trainer: ``None`` means the
+    configured launch world (honoring the caller's ``resume`` setting);
+    an int means "rebuild at exactly that data-parallel world, resuming
+    from the checkpoint directory" (the factory must force
+    ``resume=True`` and set ``data_parallel=world``; the CLI's
+    ``--elastic`` path and tests/test_elastic.py are the reference
+    implementations). The restore re-places any world-shaped
+    compression state automatically (``TrainConfig.elastic`` must be
+    set — the trainer's resume path keys its remesh tolerance on it).
+
+    ``telemetry``: an optional obs Telemetry sharing the run's event
+    dir; ``remesh``/``restart`` events and the ``remesh_total`` /
+    ``world_size`` instruments land there (falling back to the current
+    trainer's telemetry / the process default registry).
+    """
+    policy = policy if policy is not None else RetryPolicy()
+    failures = 0
+    preemptions = 0
+    view: Optional[MembershipView] = None
+    world: Optional[int] = None
+    remesh_event: Optional[Dict[str, Any]] = None
+    while True:
+        trainer = make_trainer(world)
+        w, _ = trainer_topology(trainer)
+        if view is None:
+            view = MembershipView(full_world=w, world=w)
+        elif w != view.world:
+            raise TrainingFailure(
+                f"make_trainer({view.world}) built a world-{w} trainer "
+                "— the elastic factory must honor the requested world"
+            )
+        _wire_membership(trainer, view)
+        _registry(telemetry).gauge(
+            WORLD_SIZE_GAUGE,
+            "current data-parallel world size (elastic membership)",
+        ).set(view.world)
+        if remesh_event is not None:
+            # Emitted AFTER the rebuild: the previous trainer sealed
+            # its telemetry before Preempted propagated (emit-after-
+            # close is a silent no-op), so without a supervisor
+            # telemetry the event must ride the new trainer's log.
+            tel = (
+                telemetry if telemetry is not None
+                else getattr(trainer, "telemetry", None)
+            )
+            if tel is not None:
+                tel.emit("remesh", **remesh_event)
+            remesh_event = None
+        def consume_pending():
+            """Apply the observed membership change to the NEXT
+            rebuild: remesh bookkeeping (counter + stashed event) and
+            the new target world."""
+            nonlocal remesh_event, world
+            pend, view.pending = view.pending, None
+            old_world, new_world = view.world, int(pend["world"])
+            direction = "shrink" if new_world < old_world else "grow"
+            _registry(telemetry).counter(
+                REMESH_TOTAL,
+                "elastic mesh rebuilds (label: direction=shrink|grow)",
+            ).inc(direction=direction)
+            remesh_event = dict(
+                direction=direction, world_from=old_world,
+                world_to=new_world, event=pend["event"],
+                step=pend.get("step"),
+            )
+            log.warning(
+                "remesh (%s): world %d -> %d — rebuilding the mesh "
+                "and re-placing state from the newest verified "
+                "checkpoint generation (no job restart)",
+                direction, old_world, new_world,
+            )
+            view.world = new_world
+            world = new_world
+
+        try:
+            return run(trainer)
+        except Preempted as e:
+            if (e.reason or "").startswith("signal "):
+                # A REAL scheduler signal: the whole process must
+                # vacate; resuming in-process — even with a membership
+                # change pending — would fight the scheduler. Checked
+                # BEFORE the pending branch so a SIGTERM that raced a
+                # worker_lost to the stop flag still wins. Hand the
+                # resumable exit up (cli -> 75).
+                raise
+            if view.pending is not None:
+                consume_pending()
+                continue  # membership churn never burns the budget
+            preemptions = handle_preemption(
+                e, policy=policy, preemptions=preemptions,
+                telemetry=telemetry, trainer=trainer,
+            )
+            world = view.world
+        except BaseException as e:
+            failures = handle_failure(
+                e, policy=policy, failures=failures,
+                telemetry=telemetry, trainer=trainer, sleep=sleep,
+                context=f" at world {view.world}",
+            )
+            if view.pending is not None:
+                # A transient fault raced the membership graceful stop
+                # to the step boundary (e.g. worker_lost and step_fault
+                # scripted at the same step): the fired membership rule
+                # is exhausted in the chaos ledger and will never
+                # re-request the stop, so the observed change must be
+                # applied HERE or it is silently dropped (and a later
+                # unrelated Preempted would be misread as a remesh).
+                # The failure above still consumed its retry budget.
+                consume_pending()
+            else:
+                world = view.world

@@ -60,6 +60,18 @@ class TrainingFailure(RuntimeError):
     """Raised when training keeps failing past the retry budget."""
 
 
+def trainer_topology(trainer: Any) -> Tuple[int, dict]:
+    """``(data-parallel world size, {mesh axis: size})`` of a trainer's
+    mesh (no mesh → ``(1, {})``). Recorded on restart/resume/remesh
+    events and in checkpoint meta so post-incident forensics can see
+    whether a restore changed topology (OBSERVABILITY.md) — before
+    this, a restore that silently came back on a different mesh was
+    indistinguishable from a plain resume in the event log."""
+    from ..parallel.remesh import mesh_topology  # lazy: import cycle
+
+    return mesh_topology(getattr(trainer, "mesh", None))
+
+
 def classify_failure(
     exc: BaseException,
     *,
@@ -127,7 +139,7 @@ class RetryPolicy:
 
 def _note_restart(
     telemetry: Any, *, cause: str, attempt: int,
-    error: BaseException, backoff_s: float,
+    error: BaseException, backoff_s: float, trainer: Any = None,
 ) -> None:
     from ..obs import default_registry  # lazy: keep import-time light
 
@@ -138,11 +150,76 @@ def _note_restart(
         RESTARTS_TOTAL, "resilient-loop trainer restarts"
     ).inc(cause=cause)
     if telemetry is not None:
+        # Mesh topology of the attempt that failed: restore forensics
+        # must be able to tell whether a later restore changed it.
+        world_size, mesh_shape = trainer_topology(trainer)
         telemetry.emit(
             "restart", cause=cause, attempt=attempt,
             error_type=type(error).__name__, error=str(error)[:500],
             backoff_s=round(backoff_s, 3),
+            world_size=world_size, mesh_shape=mesh_shape,
         )
+
+
+def handle_preemption(
+    e: "Preempted", *, policy: RetryPolicy, preemptions: int,
+    telemetry: Any, trainer: Any,
+) -> int:
+    """Shared graceful-resume bookkeeping for the retry supervisors
+    (``run_with_policy`` and ``elastic.run_elastic``): budget check,
+    ``restart`` event, log line. Returns the new preemption count;
+    raises :class:`TrainingFailure` past the budget."""
+    preemptions += 1
+    if preemptions > policy.max_preemptions:
+        raise TrainingFailure(
+            f"preempted {preemptions} times; giving up"
+        ) from e
+    _note_restart(
+        telemetry, cause="preemption", attempt=preemptions,
+        error=e, backoff_s=0.0, trainer=trainer,
+    )
+    log.warning(
+        "resuming after preemption %d/%d (%s)",
+        preemptions, policy.max_preemptions, e,
+    )
+    return preemptions
+
+
+def handle_failure(
+    e: BaseException, *, policy: RetryPolicy, failures: int,
+    telemetry: Any, trainer: Any,
+    sleep: Callable[[float], None] = time.sleep, context: str = "",
+) -> int:
+    """Shared transient/fatal handling for the retry supervisors:
+    classify, budget, jittered backoff, ``restart`` event. Returns the
+    new failure count; re-raises fatal errors immediately and raises
+    :class:`TrainingFailure` past the budget. Must be called from the
+    ``except`` block handling ``e`` (the fatal path re-raises the
+    active exception)."""
+    kind = policy.classify(e)
+    if kind == "fatal":
+        log.error(
+            "fatal failure (%s: %s); not retrying", type(e).__name__, e,
+        )
+        raise e
+    failures += 1
+    if failures > policy.max_restarts:
+        raise TrainingFailure(
+            f"training failed {failures} times; giving up"
+        ) from e
+    delay = policy.backoff(failures)
+    _note_restart(
+        telemetry, cause="transient", attempt=failures,
+        error=e, backoff_s=delay, trainer=trainer,
+    )
+    log.warning(
+        "training attempt %d/%d failed (%s: %s); restarting from "
+        "latest checkpoint%s in %.2fs",
+        failures, policy.max_restarts, type(e).__name__, e, context,
+        delay,
+    )
+    sleep(delay)
+    return failures
 
 
 def run_with_policy(
@@ -176,43 +253,15 @@ def run_with_policy(
         try:
             return run(trainer)
         except Preempted as e:
-            preemptions += 1
-            if preemptions > policy.max_preemptions:
-                raise TrainingFailure(
-                    f"preempted {preemptions} times; giving up"
-                ) from e
-            _note_restart(
-                telemetry, cause="preemption", attempt=preemptions,
-                error=e, backoff_s=0.0,
-            )
-            log.warning(
-                "resuming after preemption %d/%d (%s)",
-                preemptions, policy.max_preemptions, e,
+            preemptions = handle_preemption(
+                e, policy=policy, preemptions=preemptions,
+                telemetry=telemetry, trainer=trainer,
             )
         except BaseException as e:
-            kind = policy.classify(e)
-            if kind == "fatal":
-                log.error(
-                    "fatal failure (%s: %s); not retrying",
-                    type(e).__name__, e,
-                )
-                raise
-            failures += 1
-            if failures > policy.max_restarts:
-                raise TrainingFailure(
-                    f"training failed {failures} times; giving up"
-                ) from e
-            delay = policy.backoff(failures)
-            _note_restart(
-                telemetry, cause="transient", attempt=failures,
-                error=e, backoff_s=delay,
+            failures = handle_failure(
+                e, policy=policy, failures=failures,
+                telemetry=telemetry, trainer=trainer, sleep=sleep,
             )
-            log.warning(
-                "training attempt %d/%d failed (%s: %s); restarting from "
-                "latest checkpoint in %.2fs",
-                failures, policy.max_restarts, type(e).__name__, e, delay,
-            )
-            sleep(delay)
 
 
 class CircuitBreaker:

@@ -44,15 +44,23 @@ from flax import struct
 from ..data import batch_iterator, native_batch_iterator, prefetch_to_device
 from ..models import get_model, latent_clamp_mask
 from ..ops.losses import cross_entropy_loss
-from ..resilience import ChaosController, Preempted, StopRequest
+from ..resilience import (
+    MEMBERSHIP_KINDS,
+    ChaosController,
+    Preempted,
+    StopRequest,
+    trainer_topology,
+)
 from ..utils.checkpoint import (
     AsyncCheckpointer,
     CheckpointCorruptionError,
+    CheckpointWorldMismatch,
     latest_exists,
     load_checkpoint,
     load_checkpoint_resilient,
     read_meta,
     save_checkpoint,
+    shape_mismatches,
 )
 from ..utils.meters import AverageMeter
 from ..utils.results import ResultsLog
@@ -590,6 +598,20 @@ class TrainConfig:
                                    # seed-deterministic faults for
                                    # chaos tests/CI. None = consult the
                                    # JG_CHAOS env var; ""/unset = off.
+    elastic: bool = False          # elastic data-parallel membership
+                                   # (resilience/elastic, RESILIENCE.md
+                                   # "Elastic membership"): run under
+                                   # run_elastic / cli train --elastic;
+                                   # a chaos worker_lost/worker_restore
+                                   # triggers an in-process mesh
+                                   # shrink/grow with state re-placed
+                                   # from the newest digest-verified
+                                   # checkpoint generation. Also lets
+                                   # try_resume re-fold (world, ...)
+                                   # compression state from a
+                                   # different-world checkpoint instead
+                                   # of failing fast. DP only:
+                                   # TP/PP/device_data/orbax rejected.
     checkpoint_keep: int = 3       # checkpoint generations retained for
                                    # corruption rollback (resilience)
     handle_preemption: bool = True  # SIGTERM/SIGINT -> graceful stop at
@@ -651,6 +673,36 @@ class Trainer:
 
     def __init__(self, config: TrainConfig, input_shape=(28, 28, 1)):
         self.config = config
+        if config.elastic:
+            # Elastic membership re-places DATA-parallel state from
+            # msgpack checkpoint generations; TP/PP shard params over
+            # non-data axes (their layouts have no world fold), the
+            # device-resident epoch dispatch has no step boundaries to
+            # stop at, and orbax restores onto fixed shardings rather
+            # than host arrays the remesh can re-fold.
+            incompatible = [
+                (config.tensor_parallel > 1, "tensor_parallel=1"),
+                (config.pipeline_parallel > 1, "pipeline_parallel=1"),
+                (config.device_data, "device_data=False"),
+                (config.checkpoint_backend == "orbax",
+                 "checkpoint_backend='msgpack'"),
+            ]
+            bad = [need for cond, need in incompatible if cond]
+            if bad:
+                raise ValueError(
+                    "elastic=True requires " + ", ".join(bad)
+                    + " (RESILIENCE.md 'Elastic membership')"
+                )
+            if not config.checkpoint_dir:
+                # Without a checkpoint dir the membership stop has
+                # nothing to save and the rebuilt trainer nothing to
+                # restore — the "remesh" would silently restart from
+                # scratch at the new world, exit 0, all progress lost.
+                raise ValueError(
+                    "elastic=True requires checkpoint_dir: the remesh "
+                    "re-places state from checkpoint generations "
+                    "(RESILIENCE.md 'Elastic membership')"
+                )
         mk = dict(config.model_kwargs)
         if config.backend is not None:
             mk.setdefault("backend", config.backend)
@@ -725,6 +777,19 @@ class Trainer:
             config.chaos, seed=config.seed, telemetry=self.telemetry
         )
         self.chaos.on_preempt = self.stop.request
+        if not config.elastic:
+            member = [
+                r.kind for r in self.chaos.rules
+                if r.kind in MEMBERSHIP_KINDS
+            ]
+            if member:
+                raise ValueError(
+                    f"chaos {member[0]!r} requires elastic=True "
+                    "(--elastic): membership faults drive the elastic "
+                    "supervisor's mesh shrink/grow — without it the "
+                    "fault would fire into nothing (RESILIENCE.md "
+                    "'Elastic membership')"
+                )
         self._profiled = False  # trace the first epoch this trainer runs
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
@@ -2139,6 +2204,34 @@ class Trainer:
             state = place_pipelined_state(state, self._pp_mesh)
         return state
 
+    def _place_restored_on_mesh(self, state: TrainState) -> TrainState:
+        """Place a restored host-array state onto the run's DP-family
+        mesh layout NOW, exactly as ``__init__`` placed the fresh state.
+        Functionally a no-op — the jitted dispatch's pinned in_shardings
+        would place the arrays anyway — but the host-array signature
+        would compile a SECOND executable for the very first post-resume
+        dispatch (jit keys on argument placement), which a budget-0
+        recompile fence counts as a hot-path leak: one stray compile on
+        every resume, paid again after every elastic remesh. TP/PP keep
+        their own placement paths."""
+        if (
+            self.mesh is None
+            or self.config.tensor_parallel > 1
+            or self.config.pipeline_parallel > 1
+        ):
+            return state
+        if self.config.grad_compress != "none":
+            from ..parallel import place_compressed_state
+
+            return place_compressed_state(state, self.mesh)
+        if self.config.dp_mode == "fsdp":
+            from ..parallel.fsdp import shard_state_fsdp
+
+            return shard_state_fsdp(state, self.mesh)
+        from ..parallel import replicate
+
+        return replicate(state, self.mesh)
+
     def _saver(self) -> Callable:
         return (
             self._checkpointer.save if self._checkpointer is not None
@@ -2188,10 +2281,16 @@ class Trainer:
         # per-epoch checkpoint this stop resumes from.
         saved = not write_checkpoint and bool(cfg.checkpoint_dir)
         if write_checkpoint and cfg.checkpoint_dir:
+            world_size, mesh_shape = trainer_topology(self)
             extra = {
                 "best_acc": getattr(self, "best_acc", 0.0),
                 "preempted": True,
                 "rng_key": _rng_key_ints(self.rng),
+                # Mesh topology at save time: restore forensics (did a
+                # restore change topology?) and the elastic remesh's
+                # world detection both read it (OBSERVABILITY.md).
+                "world_size": world_size,
+                "mesh_shape": mesh_shape,
             }
             if batches_done is not None:
                 extra["epoch_in_progress"] = int(epoch)
@@ -2262,8 +2361,17 @@ class Trainer:
             ):
                 return 0, 0
             load = load_checkpoint_resilient
+        load_kwargs = {}
+        if load is load_checkpoint_resilient:
+            # Elastic runs tolerate a world-size mismatch (the remesh
+            # below re-folds the compression rows); everyone else fails
+            # fast with the clear CheckpointWorldMismatch instead of an
+            # opaque shape error deep inside jax placement.
+            load_kwargs["on_shape_mismatch"] = (
+                "return" if self.config.elastic else "raise"
+            )
         try:
-            state, info = load(self.state, ckpt)
+            state, info = load(self.state, ckpt, **load_kwargs)
         except CheckpointCorruptionError as e:
             log.error(
                 "every checkpoint generation under %s is corrupt "
@@ -2277,8 +2385,45 @@ class Trainer:
                 outcome="fresh_start", error=str(e)[:500],
             )
             return 0, 0
-        self.state = self._place_restored_msgpack(state)
         meta = info.get("meta") or {}
+        remeshed = False
+        if info.get("shape_mismatches"):
+            # Elastic restore across a world change: the checkpoint's
+            # (world, ...) compression rows came back in the OLD
+            # world's layout (from_bytes restores stored shapes) — re-
+            # place them onto this run's world (parallel/remesh), then
+            # re-verify: anything still mismatched is a genuine model/
+            # config drift the fold cannot (and must not) paper over.
+            if self.config.grad_compress == "none":
+                raise CheckpointWorldMismatch(
+                    f"restored state under {ckpt} does not match this "
+                    "run's shapes and no compression state is active "
+                    "to re-place: "
+                    + "; ".join(info["shape_mismatches"][:3])
+                    + " — model/config mismatch, not a world change"
+                )
+            from ..parallel.remesh import remesh_compress_state
+
+            new_opt, n_replaced = remesh_compress_state(
+                state.opt_state, self.comm_plan
+            )
+            state = state.replace(opt_state=new_opt)
+            leftover = shape_mismatches(self.state, state)
+            if leftover:
+                raise CheckpointWorldMismatch(
+                    "shapes still mismatch after re-placing the "
+                    "compression state (model/config change, not a "
+                    "world change): " + "; ".join(leftover[:3])
+                )
+            remeshed = True
+            log.warning(
+                "elastic restore: re-placed %d compression-state "
+                "node(s) from checkpoint world %s onto world %d",
+                n_replaced, meta.get("world_size"), self.comm_plan.world,
+            )
+        self.state = self._place_restored_on_mesh(
+            self._place_restored_msgpack(state)
+        )
         if info.get("rolled_back"):
             self.telemetry.registry.counter(
                 "rollbacks_total", "checkpoint generation rollbacks"
@@ -2317,11 +2462,18 @@ class Trainer:
         self.telemetry.registry.counter(
             "resumes_total", "checkpoint restores before training"
         ).inc()
+        world_size, mesh_shape = trainer_topology(self)
         self.telemetry.emit(
             "resume", epoch=start, batch_in_epoch=start_batch or None,
             step=meta.get("step"), path=ckpt, file=info.get("file"),
             digest_verified=info.get("digest_verified"),
             rolled_back=bool(info.get("rolled_back")),
+            # This run's topology next to the checkpoint's: a restore
+            # that changed topology (elastic remesh) is visible in the
+            # event log, not just in the state shapes.
+            world_size=world_size, mesh_shape=mesh_shape,
+            checkpoint_world_size=meta.get("world_size"),
+            remeshed=remeshed,
         )
         log.info(
             "resumed from %s at epoch %d%s (step %d)", ckpt, start,
@@ -2398,16 +2550,22 @@ class Trainer:
                         acc = row.get("test_acc", 0.0)
                         is_best = acc > self.best_acc
                         self.best_acc = max(self.best_acc, acc)
+                        world_size, mesh_shape = trainer_topology(self)
                         self._saver()(
                             self.state,
                             self.config.checkpoint_dir,
                             is_best=is_best,
                             epoch=epoch,
                             save_all=self.config.save_all_epochs,
-                            extra_meta={"best_acc": self.best_acc, **{
-                                k: v for k, v in row.items()
-                                if isinstance(v, float)
-                            }},
+                            extra_meta={
+                                "best_acc": self.best_acc,
+                                "world_size": world_size,
+                                "mesh_shape": mesh_shape,
+                                **{
+                                    k: v for k, v in row.items()
+                                    if isinstance(v, float)
+                                },
+                            },
                             keep_generations=self.config.checkpoint_keep,
                             chaos=self.chaos,
                         )

@@ -64,6 +64,20 @@ class CheckpointTemplateMismatch(ValueError):
     a healthy run's checkpoints."""
 
 
+class CheckpointWorldMismatch(ValueError):
+    """A digest-verified artifact deserialized cleanly but its array
+    shapes differ from the trainer's state template. flax's
+    ``from_bytes`` validates pytree STRUCTURE, not leaf shapes — it
+    hands back the stored arrays — so before this check, the classic
+    cause (a data-parallel world-size change re-shaping the
+    ``(world, ...)`` compression/ZeRO rows in opt state) surfaced only
+    later as an opaque shape error deep inside jax placement. A
+    ValueError so the retry policy classifies it fatal; an elastic run
+    (``TrainConfig.elastic`` / resilience.elastic) restores with
+    ``on_shape_mismatch="return"`` and re-places the rows instead
+    (parallel/remesh)."""
+
+
 def _barrier(name: str) -> None:
     """Cross-host barrier (no-op single-process) — the dist.barrier() in
     the reference's demo_checkpoint (mnist-distributed-BNNS2.py:171)."""
@@ -326,8 +340,27 @@ def verify_checkpoint(
     return file_digest(fpath) == digest
 
 
+def shape_mismatches(template: Any, restored: Any) -> list:
+    """``["path: checkpoint (8, 128) vs run (4, 256)", ...]`` for every
+    leaf whose shape differs between two same-structured pytrees.
+    ``from_bytes`` restores STORED shapes regardless of the template's,
+    so this is the only place a world-size (or model) drift can be
+    caught before it detonates inside jax placement/dispatch."""
+    out = []
+    t_flat = jax.tree_util.tree_flatten_with_path(template)[0]
+    r_flat = jax.tree.leaves(restored)
+    for (keypath, t), r in zip(t_flat, r_flat):
+        ts, rs = np.shape(t), np.shape(r)
+        if ts != rs:
+            out.append(
+                f"{jax.tree_util.keystr(keypath)}: checkpoint {rs} "
+                f"vs run {ts}"
+            )
+    return out
+
+
 def load_checkpoint_resilient(
-    state_template: Any, path: str
+    state_template: Any, path: str, *, on_shape_mismatch: str = "raise"
 ) -> Tuple[Any, dict]:
     """Restore the newest checkpoint generation that verifies and
     deserializes, rolling back past truncated/corrupt artifacts.
@@ -342,11 +375,28 @@ def load_checkpoint_resilient(
 
     Returns ``(state, info)`` where ``info`` carries ``file``,
     ``digest_verified`` (None = no digest recorded), ``rolled_back``,
-    ``errors`` (what was skipped, for the rollback event) and ``meta``
-    (the record of the generation actually restored — its epoch/step,
-    not the corrupt latest's). Raises
+    ``errors`` (what was skipped, for the rollback event),
+    ``shape_mismatches`` (leaf shapes that differ from the template —
+    see below) and ``meta`` (the record of the generation actually
+    restored — its epoch/step, not the corrupt latest's). Raises
     :class:`CheckpointCorruptionError` when nothing under ``path``
-    loads."""
+    loads.
+
+    ``on_shape_mismatch``: a restored artifact whose leaf SHAPES differ
+    from the template deserialized fine (flax restores stored shapes)
+    but cannot run — the classic cause is a data-parallel world-size
+    change re-shaping the ``(world, ...)`` compression/ZeRO opt-state
+    rows. ``"raise"`` (default) fails fast with
+    :class:`CheckpointWorldMismatch` instead of letting the mismatch
+    detonate later as an opaque jax placement error; ``"return"`` hands
+    the mismatched state back with ``info["shape_mismatches"]`` set —
+    the elastic restore path (TrainConfig.elastic) re-places the rows
+    via parallel/remesh."""
+    if on_shape_mismatch not in ("raise", "return"):
+        raise ValueError(
+            f"on_shape_mismatch must be 'raise' or 'return', "
+            f"got {on_shape_mismatch!r}"
+        )
     meta = read_meta(path)
     candidates = []
     if os.path.exists(os.path.join(path, LATEST)):
@@ -408,6 +458,24 @@ def load_checkpoint_resilient(
             errors.append(f"{fname}: {type(e).__name__}: {e}")
             tried.append(fpath)
             continue
+        mismatches = shape_mismatches(template, restored)
+        if mismatches and on_shape_mismatch == "raise":
+            ckpt_world = record.get("world_size")
+            hint = (
+                f"checkpoint meta records world_size={ckpt_world}"
+                if ckpt_world is not None
+                else "no world_size recorded in the checkpoint meta"
+            )
+            raise CheckpointWorldMismatch(
+                f"{fname} under {path} is intact but {len(mismatches)} "
+                "leaf(s) have different shapes than the trainer's state "
+                f"template (e.g. {'; '.join(mismatches[:3])}); {hint}. "
+                "world-size mismatch: ran remesh? An elastic run "
+                "re-places the (world, ...) compression/ZeRO rows — "
+                "resume with --elastic (TrainConfig.elastic) or rebuild "
+                "the trainer at the checkpoint's world. A genuine "
+                "model/config change needs a fresh checkpoint dir."
+            )
         if errors:
             log.warning(
                 "checkpoint rollback: restored %s after skipping %s",
@@ -419,6 +487,7 @@ def load_checkpoint_resilient(
             "digest_verified": verified,
             "rolled_back": i > 0,
             "errors": errors,
+            "shape_mismatches": mismatches,
             "meta": dict(record),
         }
     raise CheckpointCorruptionError(

@@ -1,0 +1,155 @@
+"""Elastic-membership smoke (CI): a real ``cli train --elastic`` run
+must survive a scripted worker loss WITHOUT a full-job restart.
+
+Spawns the actual CLI as a subprocess on the simulated 8-device CPU
+mesh with the 1-bit sign_ef gradient exchange and a scripted membership
+sequence — ``worker_lost@step=6,world=4`` (mesh shrinks 8→4, state
+re-placed from the newest digest-verified checkpoint generation) then
+``worker_restore@step=12`` (regrow to 8) — and asserts from the exit
+code, results CSV and obs event log that:
+
+  * the process finished exit 0 (one invocation, no exit-75 relaunch);
+  * it LEARNED (final test accuracy beats the bar — a remesh that
+    silently scrambled the re-placed EF/moment rows would still exit 0);
+  * exactly ONE shrink and ONE regrow ``remesh`` event, world 8→4→8;
+  * both post-remesh ``resume`` events restored a digest-verified
+    generation and re-placed state (``remeshed`` flag);
+  * ZERO ``restart`` events — membership churn is routine, not failure
+    (RESILIENCE.md "Elastic membership").
+
+Usage: python scripts/elastic_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHAOS_SPEC = "worker_lost@step=6,world=4;worker_restore@step=12"
+MIN_ACC = 50.0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None,
+                        help="work dir (default: a fresh temp dir)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the work dir for inspection")
+    args = parser.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="elastic_smoke_")
+    ckpt_dir = os.path.join(work, "ckpts")
+    tel_dir = os.path.join(work, "telemetry")
+    results = os.path.join(work, "results.csv")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    cmd = [
+        sys.executable, "-m", "distributed_mnist_bnns_tpu.cli", "train",
+        "--model", "bnn-mlp-small", "--epochs", "2", "--batch-size", "64",
+        "--dp", "auto", "--grad-compress", "sign_ef", "--elastic",
+        "--synthetic-sizes", "1024", "128", "--seed", "0",
+        "--chaos", CHAOS_SPEC,
+        "--checkpoint-dir", ckpt_dir, "--telemetry-dir", tel_dir,
+        "--results", results,
+        "--log-file", os.path.join(work, "train.log"),
+    ]
+    print("elastic_smoke: running", " ".join(cmd), file=sys.stderr,
+          flush=True)
+    proc = subprocess.run(cmd, env=env, cwd=REPO)
+
+    failures = []
+    if proc.returncode != 0:
+        failures.append(
+            f"cli train --elastic exited {proc.returncode} (want 0: one "
+            "invocation, no relaunch)"
+        )
+
+    acc = None
+    try:
+        with open(results) as f:
+            rows = list(csv.DictReader(f))
+        acc = float(rows[-1]["test_acc"])
+        if acc <= MIN_ACC:
+            failures.append(
+                f"run did not learn across the remeshes: test_acc={acc} "
+                f"(want > {MIN_ACC})"
+            )
+    except (OSError, IndexError, KeyError, ValueError) as e:
+        failures.append(f"could not read final accuracy from {results}: {e}")
+
+    events = []
+    events_path = os.path.join(tel_dir, "events.jsonl")
+    try:
+        with open(events_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+    except OSError as e:
+        failures.append(f"no event log at {events_path}: {e}")
+
+    kinds = [e["kind"] for e in events]
+    remesh = [e for e in events if e["kind"] == "remesh"]
+    transitions = [
+        (e["direction"], e["world_from"], e["world_to"]) for e in remesh
+    ]
+    if transitions != [("shrink", 8, 4), ("grow", 4, 8)]:
+        failures.append(
+            "want exactly one 8->4 shrink then one 4->8 regrow, got "
+            f"{transitions}"
+        )
+    member = [e["event"] for e in events
+              if e["kind"] == "membership_change"]
+    if member != ["lost", "restored"]:
+        failures.append(f"membership_change sequence off: {member}")
+    restarts = kinds.count("restart")
+    if restarts:
+        failures.append(
+            f"{restarts} restart event(s) — the elastic loop must "
+            "remesh, never full-job-restart, on membership churn"
+        )
+    resumes = [e for e in events if e["kind"] == "resume"]
+    if [bool(e.get("remeshed")) for e in resumes] != [True, True]:
+        failures.append(
+            "want two remeshed resumes (one per remesh), got "
+            f"{[(e.get('remeshed'), e.get('world_size')) for e in resumes]}"
+        )
+    if not all(e.get("digest_verified") for e in resumes):
+        failures.append(
+            "a resume restored an unverified generation: "
+            f"{[e.get('digest_verified') for e in resumes]}"
+        )
+
+    summary = {
+        "exit_code": proc.returncode,
+        "test_acc": acc,
+        "remesh": transitions,
+        "events": {k: kinds.count(k) for k in (
+            "membership_change", "remesh", "resume", "restart",
+            "fault_injected",
+        )},
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=2))
+    for f_ in failures:
+        print(f"FAIL: {f_}", file=sys.stderr)
+    if not args.keep and args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

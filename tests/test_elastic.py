@@ -1,0 +1,493 @@
+"""Elastic data-parallel membership (resilience/elastic +
+parallel/remesh + the chaos worker_lost/worker_restore kinds —
+RESILIENCE.md "Elastic membership").
+
+Covers the ISSUE-10 acceptance surface: the chaos membership grammar
+(fire-ledger compatible, rejected without --elastic), NumPy oracles for
+both state re-placement rules (worker-row mean fold, position-
+preserving segment refold — applied to the EF residuals AND the ZeRO-
+sharded base-optimizer moments), the fail-fast CheckpointWorldMismatch
+on a non-elastic world drift, loud rejection of TP/PP/device_data/orbax
+under elastic, bitwise equality of the post-shrink trajectory against a
+fresh world-4 run resumed from the same checkpoint generation (plain
+DP, sign_ef DP, sign_ef FSDP), and the end-to-end acceptance smoke:
+worker_lost shrinks 8→4 without a job restart, the restore rolls back
+past a chaos-corrupted generation, worker_restore regrows to 8, and a
+budget-0 recompile fence stays green across both remesh windows."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_mnist_bnns_tpu.data import load_mnist
+from distributed_mnist_bnns_tpu.obs import Telemetry, load_events
+from distributed_mnist_bnns_tpu.ops.comm_compress import make_plan
+from distributed_mnist_bnns_tpu.parallel.remesh import (
+    fold_worker_rows,
+    refold_segment_rows,
+    remesh_compress_state,
+)
+from distributed_mnist_bnns_tpu.resilience import (
+    Preempted,
+    RetryPolicy,
+    classify_failure,
+    parse_chaos_spec,
+    run_elastic,
+    run_with_policy,
+)
+from distributed_mnist_bnns_tpu.resilience.chaos import reset_fire_counts
+from distributed_mnist_bnns_tpu.train import (
+    FsdpCompressState,
+    TrainConfig,
+    Trainer,
+    sign_compress,
+    sign_compress_fsdp,
+)
+from distributed_mnist_bnns_tpu.utils.checkpoint import (
+    CheckpointWorldMismatch,
+)
+
+
+def _data(train=256, test=64):
+    return load_mnist(synthetic_sizes=(train, test))
+
+
+def _cfg(**kw):
+    kw.setdefault("model", "bnn-mlp-small")
+    kw.setdefault("epochs", 2)
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("backend", "xla")
+    kw.setdefault("data_parallel", "auto")
+    kw.setdefault("seed", 1)
+    return TrainConfig(**kw)
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- chaos grammar -----------------------------------------------------------
+
+
+def test_membership_chaos_grammar():
+    rules = parse_chaos_spec(
+        "worker_lost@step=3,world=4;worker_restore@step=9"
+    )
+    assert [r.kind for r in rules] == ["worker_lost", "worker_restore"]
+    assert rules[0].world == 4 and rules[0].step == 3
+    assert rules[1].world is None  # default: back to the launch world
+
+    with pytest.raises(ValueError, match="world=N"):
+        parse_chaos_spec("worker_lost@step=3")  # world is mandatory
+    with pytest.raises(ValueError, match="world"):
+        parse_chaos_spec("worker_lost@step=3,world=0")
+    with pytest.raises(ValueError, match="only applies"):
+        parse_chaos_spec("step_fault@step=3,world=4")
+    with pytest.raises(ValueError, match="bad chaos value"):
+        parse_chaos_spec("worker_lost@step=3,world=four")
+
+
+def test_membership_chaos_requires_elastic():
+    """A membership fault without the elastic loop would fire into
+    nothing — reject the config at init, not at fire time."""
+    with pytest.raises(ValueError, match="elastic"):
+        Trainer(_cfg(chaos="worker_lost@step=1,world=4"))
+
+
+def test_membership_fault_without_supervisor_raises(tmp_path):
+    """elastic=True but fit() called without run_elastic: the fault
+    must raise loudly (fatal), not be silently swallowed."""
+    reset_fire_counts()
+    t = Trainer(_cfg(elastic=True, checkpoint_dir=str(tmp_path / "ck"),
+                     chaos="worker_lost@step=1,world=4"))
+    with pytest.raises(ValueError, match="elastic supervisor"):
+        t.fit(_data(128, 64), eval_every=0)
+
+
+def test_elastic_requires_checkpoint_dir():
+    """No checkpoint dir = nothing to re-place from: the 'remesh' would
+    silently restart from scratch — reject at init."""
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Trainer(_cfg(elastic=True))
+
+
+def test_elastic_rejects_non_dp_dispatches():
+    for kw in (
+        dict(tensor_parallel=2),
+        dict(pipeline_parallel=2),
+        dict(device_data=True),
+        dict(checkpoint_backend="orbax"),
+    ):
+        with pytest.raises(ValueError, match="elastic"):
+            Trainer(_cfg(elastic=True, **kw))
+
+
+# -- re-placement NumPy oracles ---------------------------------------------
+
+
+def test_fold_worker_rows_oracle():
+    rows = np.arange(40, dtype=np.float32).reshape(8, 5)
+    # shrink 8 -> 4: mean of adjacent pairs (the batch re-sharding's
+    # contiguous worker mapping), so the combine's mean is preserved
+    out = fold_worker_rows(rows, 4, 5)
+    np.testing.assert_array_equal(
+        out, rows.reshape(4, 2, 5).mean(1)
+    )
+    assert abs(out.mean() - rows.mean()) < 1e-6  # no error mass lost
+    # grow 4 -> 8: copy each row to its successors — mean preserved
+    back = fold_worker_rows(out, 8, 5)
+    np.testing.assert_array_equal(back, np.repeat(out, 2, axis=0))
+    # width change copies the overlapping prefix, zero-pads the rest
+    wide = fold_worker_rows(rows, 4, 8)
+    np.testing.assert_array_equal(wide[:, :5], rows.reshape(4, 2, 5).mean(1))
+    assert (wide[:, 5:] == 0).all()
+    with pytest.raises(ValueError, match="divide"):
+        fold_worker_rows(rows, 3, 5)
+
+
+def test_refold_segment_rows_position_preserving():
+    """Segment-owner rows are ONE position-indexed vector re-cut at the
+    new boundaries: world-8 -> world-4 folds adjacent row PAIRS, every
+    position keeps its value, and the roundtrip is exact."""
+    rows = np.arange(24, dtype=np.float32).reshape(8, 3)
+    out = refold_segment_rows(rows, 4, 6)
+    np.testing.assert_array_equal(out.reshape(-1), rows.reshape(-1))
+    # pairwise fold, literally: new row j = [old 2j, old 2j+1]
+    np.testing.assert_array_equal(out, rows.reshape(4, 6))
+    np.testing.assert_array_equal(refold_segment_rows(out, 8, 3), rows)
+
+
+def test_remesh_sign_compress_state_oracle():
+    """The DP transform's state across 8 -> 4: worker EF rows mean-fold,
+    the owner residual refolds by position — checked against plain
+    NumPy on the real (plan-shaped) state."""
+    params = {"w": jnp.zeros((70, 11)), "b": jnp.zeros((13,))}
+    tx8 = sign_compress(mode="sign_ef", world=8, axis_name="data",
+                        bucket_size=32)
+    st8 = tx8.init(params)
+    n = 70 * 11 + 13
+    p8 = make_plan(n, world=8, mode="sign_ef", bucket_size=32)
+    rng = np.random.default_rng(0)
+    ef = rng.normal(size=(8, p8.padded)).astype(np.float32)
+    ef2 = rng.normal(size=(8, p8.seg)).astype(np.float32)
+    # zero the pad tails — the transforms' invariant the fold relies on
+    ef[:, n:] = 0.0
+    flat2 = ef2.reshape(-1)
+    flat2[n:] = 0.0
+    ef2 = flat2.reshape(8, p8.seg)
+    st8 = type(st8)(ef_residual=jnp.asarray(ef), ef_residual2=jnp.asarray(ef2))
+
+    p4 = make_plan(n, world=4, mode="sign_ef", bucket_size=32)
+    st4, replaced = remesh_compress_state(st8, p4)
+    assert replaced == 1
+    assert st4.ef_residual.shape == (4, p4.padded)
+    assert st4.ef_residual2.shape == (4, p4.seg)
+    expect_ef = ef.reshape(4, 2, p8.padded).mean(1)[:, :p4.padded]
+    np.testing.assert_allclose(np.asarray(st4.ef_residual), expect_ef,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(st4.ef_residual2).reshape(-1)[:n],
+        ef2.reshape(-1)[:n],
+    )
+    # idempotent at the target world
+    again, replaced2 = remesh_compress_state(st4, p4)
+    assert replaced2 == 0 and again is not None
+
+
+def test_remesh_fsdp_state_moments_follow_their_parameters():
+    """The hard case: FsdpCompressState.inner holds the base
+    optimizer's (world, seg) adam moment rows. After an 8 -> 4 fold,
+    every parameter position must keep exactly its own mu/nu (position-
+    preserving), the scalar count must survive, and a 4 -> 8 regrow
+    must re-split back to the original rows."""
+    params = {"w": jnp.zeros((70, 11)), "b": jnp.zeros((13,))}
+    n = 70 * 11 + 13
+    tx8 = sign_compress_fsdp(optax.adam(1e-3), mode="sign_ef", world=8,
+                             axis_name="data", bucket_size=32)
+    st8 = tx8.init(params)
+    p8 = make_plan(n, world=8, mode="sign_ef", bucket_size=32,
+                   layout="fsdp")
+    rng = np.random.default_rng(1)
+
+    def seg_rows():
+        r = rng.normal(size=(8, p8.seg)).astype(np.float32)
+        flat = r.reshape(-1)
+        flat[n:] = 0.0
+        return flat.reshape(8, p8.seg)
+
+    mu, nu = seg_rows(), seg_rows()
+
+    # walk inner by hand: adam state is (count, mu, nu)-shaped pytree
+    inner_leaves, treedef = jax.tree_util.tree_flatten(st8.inner)
+    new_leaves, seg_seen = [], []
+    for leaf in inner_leaves:
+        if np.shape(leaf) == (8, p8.seg):
+            new_leaves.append(jnp.asarray([mu, nu][len(seg_seen)]))
+            seg_seen.append(leaf)
+        else:
+            new_leaves.append(leaf)
+    assert len(seg_seen) == 2, "expected adam mu and nu segment rows"
+    st8 = st8._replace(inner=jax.tree_util.tree_unflatten(
+        treedef, new_leaves
+    ))
+
+    p4 = make_plan(n, world=4, mode="sign_ef", bucket_size=32,
+                   layout="fsdp")
+    st4, replaced = remesh_compress_state(st8, p4)
+    assert replaced == 1
+    rows4 = [l for l in jax.tree.leaves(st4.inner)
+             if np.shape(l) == (4, p4.seg)]
+    assert len(rows4) == 2
+    for folded, orig in zip(rows4, (mu, nu)):
+        np.testing.assert_array_equal(
+            np.asarray(folded).reshape(-1)[:n], orig.reshape(-1)[:n]
+        )
+    scalars = [l for l in jax.tree.leaves(st4.inner) if np.ndim(l) == 0]
+    assert scalars, "adam count scalar must survive the fold"
+    # regrow 4 -> 8 restores the original segment rows exactly
+    st8b, replaced_b = remesh_compress_state(st4, p8)
+    assert replaced_b == 1
+    rows8 = [l for l in jax.tree.leaves(st8b.inner)
+             if np.shape(l) == (8, p8.seg)]
+    for back, orig in zip(rows8, (mu, nu)):
+        np.testing.assert_array_equal(np.asarray(back), orig)
+
+
+# -- fail-fast world mismatch (the non-elastic path) ------------------------
+
+
+def test_world_mismatch_fails_fast_without_elastic(tmp_path):
+    """A world-8 compressed checkpoint restored by a world-4 trainer
+    used to detonate deep inside jax placement with an opaque shape
+    error; now load_checkpoint_resilient fails fast with a clear
+    'ran remesh?' message pointing at --elastic — and classifies fatal
+    (retrying cannot fix a topology mismatch)."""
+    reset_fire_counts()
+    data = _data()
+    ckpt = str(tmp_path / "ck")
+    t8 = Trainer(_cfg(grad_compress="sign_ef", epochs=1,
+                      checkpoint_dir=ckpt))
+    t8.fit(data, eval_every=0)
+
+    t4 = Trainer(_cfg(grad_compress="sign_ef", epochs=2,
+                      data_parallel=4, checkpoint_dir=ckpt, resume=True))
+    with pytest.raises(CheckpointWorldMismatch, match="ran remesh") as ei:
+        t4.fit(data, eval_every=0)
+    assert "elastic" in str(ei.value)
+    assert "world_size=8" in str(ei.value)  # the meta-recorded world
+    assert classify_failure(ei.value) == "fatal"  # retrying can't fix it
+
+
+# -- the elastic supervisor: bitwise shrink equivalence ---------------------
+
+
+def _elastic_factory(base_kw, trainers):
+    def make_tr(world):
+        over = {} if world is None else dict(
+            data_parallel=world, resume=True
+        )
+        t = Trainer(_cfg(**{**base_kw, **over}))
+        trainers.append(t)
+        return t
+
+    return make_tr
+
+
+@pytest.mark.parametrize("variant", ["plain_dp", "sign_ef_dp",
+                                     "sign_ef_fsdp"])
+def test_shrink_trajectory_bitwise_vs_fresh_resume(variant, tmp_path):
+    """ISSUE-10 core equivalence: after a chaos worker_lost shrinks
+    8 -> 4 mid-run, the elastic run's post-shrink trajectory is
+    BITWISE-equal (params AND full opt_state, EF residuals and ZeRO
+    moment rows included) to a fresh world-4 run resumed from the same
+    checkpoint generation — the re-placement changes nothing a
+    from-scratch world-4 restore wouldn't produce."""
+    compress = dict(
+        plain_dp={},
+        sign_ef_dp=dict(grad_compress="sign_ef"),
+        sign_ef_fsdp=dict(grad_compress="sign_ef", dp_mode="fsdp"),
+    )[variant]
+    data = _data()
+    reset_fire_counts()
+
+    kwA = dict(compress, elastic=True,
+               checkpoint_dir=str(tmp_path / "A"),
+               chaos="worker_lost@step=6,world=4")
+    trainers = []
+    run_elastic(
+        _elastic_factory(kwA, trainers),
+        lambda t: t.fit(data, eval_every=0),
+        policy=RetryPolicy(seed=0),
+    )
+    A = trainers[-1]
+    assert len(trainers) == 2  # exactly one remesh, zero retries
+    assert dict(A.mesh.shape)["data"] == 4
+
+    # the reference: an identical world-8 run preempted at the same
+    # step writes the identical generation; a FRESH world-4 trainer
+    # then resumes from it (through the same remesh-aware restore)
+    reset_fire_counts()
+    ckB = str(tmp_path / "B")
+    t1 = Trainer(_cfg(**compress, elastic=True, checkpoint_dir=ckB,
+                      chaos="preempt@step=6"))
+    with pytest.raises(Preempted):
+        t1.fit(data, eval_every=0)
+    reset_fire_counts()
+    B = Trainer(_cfg(**compress, elastic=True, checkpoint_dir=ckB,
+                     data_parallel=4, resume=True))
+    B.fit(data, eval_every=0)
+
+    assert int(A.state.step) == int(B.state.step) == 8
+    _assert_trees_equal(A.state.params, B.state.params)
+    _assert_trees_equal(A.state.opt_state, B.state.opt_state)
+
+
+def test_transient_fault_racing_membership_still_remeshes(tmp_path):
+    """A transient fault scripted at the SAME step as worker_lost wins
+    the race to the step boundary (chaos rules fire in spec order, the
+    raise preempts the graceful stop). The fired membership rule is
+    exhausted in the ledger and never re-requests the stop — the
+    supervisor must apply the observed change on the transient rebuild
+    instead of silently dropping it."""
+    reset_fire_counts()
+    data = _data()
+    trainers = []
+    hist = run_elastic(
+        _elastic_factory(
+            dict(elastic=True, checkpoint_dir=str(tmp_path / "ck"),
+                 chaos="worker_lost@step=6,world=4;step_fault@step=6"),
+            trainers,
+        ),
+        lambda t: t.fit(data, eval_every=0),
+        policy=RetryPolicy(seed=0, base_backoff_s=0.01),
+    )
+    assert hist
+    assert len(trainers) == 2  # one rebuild: transient + remesh combined
+    assert dict(trainers[-1].mesh.shape)["data"] == 4
+    assert int(trainers[-1].state.step) == 8
+
+
+def test_worker_restore_at_full_world_is_noop(tmp_path):
+    """worker_restore with nothing lost: no remesh, the run just
+    finishes (the hook's already-at-world branch)."""
+    reset_fire_counts()
+    data = _data(128, 64)
+    trainers = []
+    hist = run_elastic(
+        _elastic_factory(
+            dict(elastic=True, epochs=1,
+                 checkpoint_dir=str(tmp_path / "ck"),
+                 chaos="worker_restore@step=1"),
+            trainers,
+        ),
+        lambda t: t.fit(data, eval_every=0),
+        policy=RetryPolicy(seed=0),
+    )
+    assert len(trainers) == 1 and hist
+
+
+# -- the acceptance smoke ---------------------------------------------------
+
+
+@pytest.mark.parametrize("dp_mode", ["gspmd", "fsdp"])
+def test_elastic_acceptance_shrink_rollback_regrow(dp_mode, tmp_path):
+    """ISSUE-10 acceptance: worker_lost mid-run shrinks 8 -> 4 without
+    a full-job restart, the restore rolls back past a chaos-corrupted
+    generation to the newest digest-verified one, training continues,
+    worker_restore regrows to 8, the run completes — with a BUDGET-0
+    recompile fence green through both remesh windows (each rebuild's
+    one compile is its legitimate warmup; nothing may retrace after),
+    exactly one shrink + one grow remeshes, and zero restart events."""
+    reset_fire_counts()
+    data = _data()
+    ck, tel = str(tmp_path / "ck"), str(tmp_path / "tel")
+    spec = ("worker_lost@step=6,world=4;ckpt_corrupt@step=6;"
+            "worker_restore@step=10")
+    base_kw = dict(
+        elastic=True, epochs=3, dp_mode=dp_mode,
+        grad_compress="sign_ef", checkpoint_dir=ck, telemetry_dir=tel,
+        chaos=spec, sanitize="recompile", recompile_budget=0,
+    )
+    trainers = []
+    with Telemetry(tel, heartbeat=False) as sup:
+        hist = run_elastic(
+            _elastic_factory(base_kw, trainers),
+            lambda t: t.fit(data, eval_every=0),
+            policy=RetryPolicy(seed=0),
+            telemetry=sup,
+        )
+        assert sup.registry.gauge("world_size", "").value() == 8
+        remesh_ctr = sup.registry.counter("remesh_total", "")
+        assert remesh_ctr.value(direction="shrink") == 1
+        assert remesh_ctr.value(direction="grow") == 1
+
+    assert hist and hist[-1]["epoch"] == 2
+    assert len(trainers) == 3  # launch + shrink + regrow, no retries
+    assert int(trainers[-1].state.step) == 12
+    assert dict(trainers[-1].mesh.shape)["data"] == 8
+
+    events = load_events(os.path.join(tel, "events.jsonl"))
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("restart") == 0  # no full-job restarts
+    remesh = [e for e in events if e["kind"] == "remesh"]
+    assert [(e["direction"], e["world_from"], e["world_to"])
+            for e in remesh] == [("shrink", 8, 4), ("grow", 4, 8)]
+    member = [e for e in events if e["kind"] == "membership_change"]
+    assert [e["event"] for e in member] == ["lost", "restored"]
+    assert kinds.count("rollback") == 1  # the corrupt generation
+    resumes = [e for e in events if e["kind"] == "resume"]
+    assert [bool(e.get("remeshed")) for e in resumes] == [True, True]
+    assert [bool(e.get("rolled_back")) for e in resumes] == [True, False]
+    assert [(e.get("checkpoint_world_size"), e.get("world_size"))
+            for e in resumes] == [(8, 4), (4, 8)]
+    # faults actually fired (seed-deterministic chaos, not a no-op run)
+    faults = [e["fault"] for e in events if e["kind"] == "fault_injected"]
+    assert faults.count("worker_lost") == 1
+    assert faults.count("worker_restore") == 1
+    assert faults.count("ckpt_corrupt") == 1
+
+
+# -- event topology fields (resume / restart forensics) ---------------------
+
+
+def test_resume_and_restart_events_record_topology(tmp_path):
+    """resume and restart events carry world_size/mesh_shape so
+    post-incident forensics can see whether a restore changed
+    topology."""
+    reset_fire_counts()
+    data = _data(128, 64)
+    ck, tel = str(tmp_path / "ck"), str(tmp_path / "tel")
+
+    def make_trainer():
+        return Trainer(_cfg(
+            epochs=2, checkpoint_dir=ck, telemetry_dir=tel, resume=True,
+            chaos="step_fault@step=2;preempt@step=3",
+        ))
+
+    with Telemetry(tel, heartbeat=False) as policy_tel:
+        run_with_policy(
+            make_trainer, lambda t: t.fit(data, eval_every=0),
+            policy=RetryPolicy(max_restarts=2, base_backoff_s=0.01,
+                               seed=0),
+            telemetry=policy_tel,
+        )
+    events = load_events(os.path.join(tel, "events.jsonl"))
+    restarts = [e for e in events if e["kind"] == "restart"]
+    resumes = [e for e in events if e["kind"] == "resume"]
+    assert restarts and resumes
+    for e in restarts + resumes:
+        assert e["world_size"] == 8
+        assert e["mesh_shape"].get("data") == 8
+    # the save-side half: checkpoint meta records the topology too
+    import json
+
+    meta = json.load(open(os.path.join(ck, "checkpoint_meta.json")))
+    assert meta["world_size"] == 8
+    assert meta["mesh_shape"].get("data") == 8
