@@ -288,7 +288,7 @@ def _utc_now(epoch_s: float | None = None) -> str:
 SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
     "device_resident_epoch", "train_step_per_backend", "comm",
-    "comm_fsdp", "lm_serve", "cold_start",
+    "comm_fsdp", "lm_serve", "serving_p99", "cold_start",
 )
 
 
@@ -1372,6 +1372,11 @@ def main() -> None:
                         "inter-token latency at 1/4/8 concurrent "
                         "streams, packed-bitplane vs dense decode "
                         "weights")
+    p.add_argument("--serve-p99-bench", action="store_true",
+                   help="also bench classifier request p99 under "
+                        "saturation through the real serving engine "
+                        "(serve/harness.py): the gateable Tail-at-Scale "
+                        "number the perf gate bands (ROADMAP item 5)")
     p.add_argument("--cold-start-bench", action="store_true",
                    help="measure cold-store vs warm-store boot: "
                         "time-to-first-token for cli serve and cli "
@@ -1774,6 +1779,23 @@ def main() -> None:
             result["lm_serve"] = _bench_lm_serve(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["lm_serve"] = f"failed: {e!r:.300}"
+
+    if args.serve_p99_bench and time.monotonic() < deadline - 60:
+        # Classifier p99-under-saturation through the REAL engine
+        # (admission queue + micro-batcher). Lives in the importable
+        # serve/harness so the perf gate bands the same measurement
+        # this record reports (ROADMAP item 5).
+        try:
+            _progress("serving_p99: engine saturation-latency section")
+            from distributed_mnist_bnns_tpu.serve.harness import (
+                serving_p99_section,
+            )
+
+            result["serving_p99"] = serving_p99_section(
+                interpret=jax.default_backend() != "tpu",
+            )
+        except Exception as e:  # never let the extra kill the bench line
+            result["serving_p99"] = f"failed: {e!r:.300}"
 
     if args.comm_bench and time.monotonic() < deadline - 60:
         try:

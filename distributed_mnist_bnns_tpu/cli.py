@@ -68,6 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "per-process heartbeats, recompile counts "
                              "(OBSERVABILITY.md); read back with the "
                              "`telemetry` subcommand")
+        sp.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                        default=None,
+                        help="span-tree tracing into the telemetry event "
+                             "log (OBSERVABILITY.md 'Tracing'): step/"
+                             "checkpoint/restore/remesh windows become "
+                             "`cli trace`-readable spans. Default: the "
+                             "JG_TRACE env var; needs --telemetry-dir")
         sp.add_argument("--sanitize", default=None, metavar="FENCES",
                         help="arm runtime fences (ANALYSIS.md): comma "
                              "list of 'recompile' (hard-error when "
@@ -320,6 +327,15 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--telemetry-dir", default=None,
                     help="JSONL request/shed/breaker/drain events here "
                          "(OBSERVABILITY.md)")
+    sv.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="per-request span trees into the event log "
+                         "(OBSERVABILITY.md 'Tracing'): admit/queue/"
+                         "dispatch/respond (and the LM engine's "
+                         "prefill/decode-iteration) phases, joined "
+                         "across processes by the x-jg-trace header; "
+                         "read back with `cli trace`. Default: the "
+                         "JG_TRACE env var; needs --telemetry-dir")
     sv.add_argument("--chaos", default=None, metavar="SPEC",
                     help="serving fault injection (RESILIENCE.md): "
                          "e.g. 'infer_error@step=4,times=3;"
@@ -407,6 +423,25 @@ def build_parser() -> argparse.ArgumentParser:
     tm.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
                          "of a table")
+    tc = sub.add_parser(
+        "trace",
+        help="read a traced run's span trees (OBSERVABILITY.md "
+             "'Tracing'): render the p99 tail-attribution report "
+             "(where did the slow requests' time go — queue vs prefill "
+             "vs decode vs stall), and/or export Chrome-trace-event "
+             "JSON loadable in Perfetto / chrome://tracing",
+    )
+    tc.add_argument("log",
+                    help="path to an events.jsonl, or the telemetry "
+                         "directory containing one")
+    tc.add_argument("--export", default=None, metavar="OUT",
+                    help="write the Chrome-trace-event JSON here "
+                         "('-' = stdout); open in https://ui.perfetto.dev")
+    tc.add_argument("--tail-pct", type=float, default=99.0,
+                    help="percentile cutoff for the tail-attribution "
+                         "report (default: 99)")
+    tc.add_argument("--json", action="store_true",
+                    help="emit the attribution report as JSON")
     ln = sub.add_parser(
         "lint",
         help="run the repo linter (JAX footguns JG001-JG006 + "
@@ -557,6 +592,7 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10,
         tensor_parallel=args.tp,
         profile_dir=args.profile_dir,
         telemetry_dir=args.telemetry_dir,
+        trace=getattr(args, "trace", None),
         sanitize=args.sanitize,
         recompile_budget=args.recompile_budget,
         nan_check_every=args.nan_check_every,
@@ -843,6 +879,70 @@ def main(argv=None) -> int:
         print(json.dumps(summary) if args.json else render_table(summary))
         return 0
 
+    if args.cmd == "trace":
+        # Pure host-side log reading, like `telemetry`: no jax backend.
+        import json
+        import os
+
+        from .obs.telemetry import EVENTS_FILE
+        from .obs.trace import (
+            load_spans,
+            render_attribution,
+            tail_attribution,
+            to_chrome_trace,
+        )
+
+        path = args.log
+        if os.path.isdir(path):
+            path = os.path.join(path, EVENTS_FILE)
+        try:
+            spans = load_spans(path)
+        except FileNotFoundError:
+            print(f"no event log at {path}", file=sys.stderr)
+            return 2
+        if not spans:
+            print(
+                f"no span events in {path} — was the run traced? "
+                "(--trace / JG_TRACE=1, OBSERVABILITY.md 'Tracing')",
+                file=sys.stderr,
+            )
+            return 2
+        if args.export:
+            chrome = to_chrome_trace(
+                spans, process_name=os.path.basename(
+                    os.path.dirname(os.path.abspath(path))
+                ),
+            )
+            if args.export == "-":
+                print(json.dumps(chrome))
+                return 0          # stdout is the export, no report
+            with open(args.export, "w") as f:
+                json.dump(chrome, f)
+            print(
+                f"wrote {len(chrome['traceEvents'])} trace events "
+                f"to {args.export} (open in https://ui.perfetto.dev)",
+                file=sys.stderr,
+            )
+        report = tail_attribution(spans, pct=args.tail_pct)
+        if report["n_requests"] == 0:
+            # No request roots (e.g. a traced TRAINING run): report
+            # per-kind totals instead of an empty tail table.
+            from .obs.trace import span_kind_totals
+
+            totals = span_kind_totals(spans)
+            if args.json:
+                print(json.dumps({**report, "kind_totals": totals}))
+                return 0
+            print(f"no request spans in {path}; per-kind totals over "
+                  f"{len(spans)} span(s):")
+            for kind, row in totals.items():
+                print(f"  {kind:<16} x{row['count']:<6} "
+                      f"{row['total_ms']:>12.3f} ms")
+            return 0
+        print(json.dumps(report) if args.json
+              else render_attribution(report))
+        return 0
+
     if args.cmd == "lm":
         from .utils import setup_logging
 
@@ -959,6 +1059,7 @@ def main(argv=None) -> int:
                 interpret=args.interpret,
                 aot=args.aot,
                 aot_dir=args.aot_dir,
+                trace=args.trace,
             ))
             return lm_server.run()
 
@@ -986,6 +1087,7 @@ def main(argv=None) -> int:
             interpret=args.interpret,
             aot=args.aot,
             aot_dir=args.aot_dir,
+            trace=args.trace,
         ))
         return server.run()
 

@@ -12,6 +12,9 @@ schema and how to read a run.
   heartbeat  per-process liveness records
   telemetry  the facade the training/serving layers talk to
   summary    fold a run log into a report (the `telemetry` CLI)
+  trace      per-request span trees + x-jg-trace propagation, run-scoped
+             request ids, Perfetto export and p99 tail attribution
+             (the `trace` CLI)
 """
 
 from .events import (
@@ -42,9 +45,22 @@ from .registry import (
     Histogram,
     MetricsRegistry,
     default_registry,
+    render_prometheus,
 )
 from .summary import render_table, summarize
 from .telemetry import Telemetry, peak_for_default_device
+from .trace import (
+    TRACE_HEADER,
+    TraceContext,
+    Tracer,
+    format_header,
+    load_spans,
+    mint_context,
+    next_request_id,
+    parse_header,
+    tail_attribution,
+    to_chrome_trace,
+)
 
 __all__ = [
     "Counter",
@@ -57,23 +73,34 @@ __all__ = [
     "MetricsRegistry",
     "RecompileTracker",
     "SCHEMA_VERSION",
+    "TRACE_HEADER",
     "Telemetry",
+    "TraceContext",
+    "Tracer",
     "chip_peak",
     "chip_peak_bf16",
     "default_registry",
     "dense_macs_per_example",
     "device_memory_stats",
     "device_peak_flops",
+    "format_header",
     "get_tracker",
     "git_rev",
     "jaxpr_macs_per_example",
     "load_events",
+    "load_spans",
     "mfu",
+    "mint_context",
+    "next_request_id",
+    "parse_header",
     "peak_for_default_device",
     "read_events",
     "read_heartbeats",
+    "render_prometheus",
     "render_table",
     "summarize",
+    "tail_attribution",
+    "to_chrome_trace",
     "train_step_flops",
     "utc_now",
 ]

@@ -57,6 +57,11 @@ Event kinds (schema v1):
   aot_bank       an executable was serialized into the AOT store
   aot_fallback   a corrupt/incompatible AOT entry was quarantined and
                  the boot fell back to online compile (reason field)
+  span           one completed tracing span (obs/trace): trace/span/
+                 parent ids, name, span_kind, monotonic t0_ms/dur_ms,
+                 status, tid, attrs — the per-request span trees
+                 `cli trace` folds into Perfetto exports and tail
+                 attribution (OBSERVABILITY.md "Tracing")
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's
@@ -145,8 +150,10 @@ class EventLog:
     call sites need no rank guards. Flush policy: the high-rate kinds —
     ``step`` (one per hot-loop dispatch), ``request`` (one per served
     request, written from the serving engine's single worker thread)
-    and ``lm_admit``/``lm_evict`` (one per generation stream, written
-    from the LM scheduler thread between decode iterations) — are
+    ``lm_admit``/``lm_evict`` (one per generation stream, written
+    from the LM scheduler thread between decode iterations) and
+    ``span`` (several per traced request, batch-flushed by the tracer's
+    own staging buffer first) — are
     buffered (a flushed syscall per record would serialize file I/O
     against the hot path) and flushed every ``flush_every`` records;
     every other kind — manifest, epoch, error, shed, breaker
@@ -154,7 +161,7 @@ class EventLog:
     loses at most the last few high-rate lines, never the milestone
     records."""
 
-    BUFFERED_KINDS = ("step", "request", "lm_admit", "lm_evict")
+    BUFFERED_KINDS = ("step", "request", "lm_admit", "lm_evict", "span")
 
     def __init__(
         self, path: str, *, primary_only: bool = True,

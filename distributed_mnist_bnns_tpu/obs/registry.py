@@ -248,6 +248,86 @@ class MetricsRegistry:
                 sorted(instruments.items())}
 
 
+def _prom_escape(value: str) -> str:
+    """Label-VALUE escaping (backslash, quote, newline)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _prom_escape_help(value: str) -> str:
+    """HELP-line escaping — the exposition format escapes only
+    backslash and newline here (quotes are label-value-only)."""
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(snapshot: Dict[str, Dict]) -> str:
+    """Render a registry ``snapshot()`` in the Prometheus text
+    exposition format (version 0.0.4) — the ``/metrics`` content both
+    serving front ends return under ``Accept: text/plain``, so a stock
+    Prometheus scraper can watch a replica fleet without a JSON
+    adapter. Counters/gauges map directly; histograms emit cumulative
+    ``_bucket{le=...}`` series (the snapshot's per-bucket counts summed
+    left to right), ``_sum`` and ``_count``."""
+    lines: List[str] = []
+    for name, inst in sorted(snapshot.items()):
+        kind = inst.get("type")
+        if kind not in ("counter", "gauge", "histogram"):
+            continue
+        if inst.get("help"):
+            lines.append(
+                f"# HELP {name} {_prom_escape_help(inst['help'])}"
+            )
+        lines.append(f"# TYPE {name} {kind}")
+        if kind in ("counter", "gauge"):
+            for s in inst.get("series", []):
+                lines.append(
+                    f"{name}{_prom_labels(s.get('labels', {}))} "
+                    f"{_prom_num(s['value'])}"
+                )
+            continue
+        buckets = inst.get("buckets", [])
+        for s in inst.get("series", []):
+            labels = s.get("labels", {})
+            cum = 0
+            counts = s.get("bucket_counts", [])
+            for le, c in zip(buckets, counts):
+                cum += int(c)
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_prom_labels({**labels, 'le': repr(float(le))})} "
+                    f"{cum}"
+                )
+            lines.append(
+                f"{name}_bucket{_prom_labels({**labels, 'le': '+Inf'})} "
+                f"{int(s.get('count', 0))}"
+            )
+            lines.append(
+                f"{name}_sum{_prom_labels(labels)} "
+                f"{_prom_num(s.get('sum', 0.0))}"
+            )
+            lines.append(
+                f"{name}_count{_prom_labels(labels)} "
+                f"{int(s.get('count', 0))}"
+            )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 _default_registry = MetricsRegistry()
 
 

@@ -14,18 +14,7 @@ from typing import Any, Dict, List, Optional
 
 from .events import MANIFEST_KIND, read_events
 from .heartbeat import read_heartbeats
-
-
-def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
-    if not sorted_vals:
-        return None
-    if len(sorted_vals) == 1:
-        return sorted_vals[0]
-    pos = q / 100.0 * (len(sorted_vals) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(sorted_vals) - 1)
-    frac = pos - lo
-    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+from .trace import percentile as _percentile
 
 
 def summarize(path: str) -> Dict[str, Any]:

@@ -23,8 +23,10 @@ from .flops import device_memory_stats, device_peak_flops, mfu
 from .heartbeat import Heartbeat
 from .recompile import RecompileTracker, get_tracker
 from .registry import MetricsRegistry
+from .trace import Tracer
 
 EVENTS_FILE = "events.jsonl"
+ENV_TRACE = "JG_TRACE"
 
 STEP_SECONDS = "train_step_seconds"
 EXAMPLES_TOTAL = "train_examples_total"
@@ -51,6 +53,7 @@ class Telemetry:
         tracker: Optional[RecompileTracker] = None,
         heartbeat_interval_s: float = 30.0,
         heartbeat: bool = True,
+        trace: Optional[bool] = None,
     ):
         self.run_dir = run_dir
         self.registry = registry if registry is not None \
@@ -78,6 +81,18 @@ class Telemetry:
                     interval_s=heartbeat_interval_s,
                     payload_fn=lambda: dict(self._last_step_payload),
                 ).start()
+        # Tracing (obs/trace, OBSERVABILITY.md "Tracing"): explicit
+        # ``trace=`` wins; None defers to the JG_TRACE env var (how CI
+        # arms tracing without touching call sites). A run without an
+        # event sink has nowhere durable to put spans, so the tracer
+        # stays disabled — near-zero cost at every instrumented site.
+        if trace is None:
+            trace = os.environ.get(ENV_TRACE, "") not in ("", "0")
+        self.tracer = Tracer(
+            sink=self.events,
+            enabled=bool(trace) and self.events is not None,
+            registry=self.registry,
+        )
 
     @property
     def enabled(self) -> bool:
@@ -107,6 +122,9 @@ class Telemetry:
         if self.heartbeat is not None:
             self.heartbeat.stop()
             self.heartbeat = None
+        # Staged spans land before the log seals (and before the final
+        # metrics snapshot, which includes the trace drop counter).
+        self.tracer.flush()
         if self.events is not None:
             # Final registry snapshot as ONE event: counters the run
             # accumulated (comm_bytes_total phases, shed/fault counts,

@@ -68,6 +68,15 @@ Every fire increments ``faults_injected_total`` (label ``kind``) and,
 with a telemetry sink attached, emits a ``fault_injected`` event before
 the fault takes effect — the post-mortem trail proves which failures
 were scripted.
+
+With tracing armed (obs/trace, OBSERVABILITY.md "Tracing") every fire
+is ALSO a span: an instant ``chaos.<kind>`` marker at the fault point,
+parented to whatever span is current on the firing thread (the serving
+batch / LM decode iteration / nothing for the trainer's step boundary)
+— and the stall kinds (``slow_host``/``infer_slow``) additionally wrap
+their sleep in a duration ``chaos.stall`` span, so fault→latency
+causality is a tree link in the trace, not a timestamp correlation
+exercise over two log greps.
 """
 
 from __future__ import annotations
@@ -258,6 +267,29 @@ class ChaosController:
     def active(self) -> bool:
         return bool(self.rules)
 
+    def _span_tracer(self):
+        """The telemetry sink's tracer when tracing is armed, else
+        None — chaos must stay importable/usable with a bare telemetry
+        stub (tests pass all kinds of fakes)."""
+        tr = getattr(self.telemetry, "tracer", None)
+        return tr if tr is not None and getattr(tr, "enabled", False) \
+            else None
+
+    def _stall(self, rule: FaultRule, point: str,
+               step: Optional[int]) -> None:
+        """The scripted sleep, wrapped in a duration ``stall`` span so
+        the stalled window itself is visible in the trace (and in tail
+        attribution) — not just the instant fire marker."""
+        tr = self._span_tracer()
+        if tr is None:
+            time.sleep(rule.delay_s)
+            return
+        with tr.start(
+            "chaos.stall", kind="stall", fault=rule.kind, point=point,
+            step=step, delay_s=rule.delay_s,
+        ):
+            time.sleep(rule.delay_s)
+
     # -- trigger evaluation --------------------------------------------------
 
     def _should_fire(
@@ -291,6 +323,17 @@ class ChaosController:
             self.telemetry.emit(
                 "fault_injected", fault=rule.kind, point=point,
                 step=step, epoch=epoch, detail=detail, rule=rule.key,
+            )
+        tr = self._span_tracer()
+        if tr is not None:
+            # Instant marker span at the fault point; parenting to the
+            # firing thread's current span (serve batch / LM decode
+            # iteration) makes fault->latency causality first-class.
+            now = time.monotonic()
+            tr.record(
+                f"chaos.{rule.kind}", kind="chaos", t0=now, t1=now,
+                fault=rule.kind, point=point, step=step, epoch=epoch,
+                **({"detail": detail} if detail else {}),
             )
         log.warning(
             "chaos: injected %s at step=%s epoch=%s%s",
@@ -380,7 +423,7 @@ class ChaosController:
                 self._record(
                     rule, "step", step, epoch, f"stall {rule.delay_s}s"
                 )
-                time.sleep(rule.delay_s)
+                self._stall(rule, "step", step)
             elif rule.kind == "data_io":
                 self._record(rule, "step", step, epoch)
                 raise ChaosIOError(
@@ -413,7 +456,7 @@ class ChaosController:
                 self._record(
                     rule, "infer", step, None, f"stall {rule.delay_s}s"
                 )
-                time.sleep(rule.delay_s)
+                self._stall(rule, "infer", step)
             else:
                 self._record(rule, "infer", step, None)
                 raise ChaosInferError(

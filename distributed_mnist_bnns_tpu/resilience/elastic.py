@@ -160,6 +160,7 @@ def run_elastic(
     view: Optional[MembershipView] = None
     world: Optional[int] = None
     remesh_event: Optional[Dict[str, Any]] = None
+    remesh_t0: Optional[float] = None   # remesh-window span start
     while True:
         trainer = make_trainer(world)
         w, _ = trainer_topology(trainer)
@@ -186,12 +187,25 @@ def run_elastic(
             )
             if tel is not None:
                 tel.emit("remesh", **remesh_event)
+                tr = getattr(tel, "tracer", None)
+                if tr is not None and tr.enabled \
+                        and remesh_t0 is not None:
+                    # The remesh WINDOW — membership stop observed ->
+                    # rebuilt trainer ready — as one span, so elastic
+                    # churn shows up in `cli trace` next to the step
+                    # spans it displaced.
+                    tr.record(
+                        "train.remesh", kind="remesh", t0=remesh_t0,
+                        t1=time.monotonic(), **remesh_event,
+                    )
             remesh_event = None
+            remesh_t0 = None
         def consume_pending():
             """Apply the observed membership change to the NEXT
             rebuild: remesh bookkeeping (counter + stashed event) and
             the new target world."""
-            nonlocal remesh_event, world
+            nonlocal remesh_event, remesh_t0, world
+            remesh_t0 = time.monotonic()
             pend, view.pending = view.pending, None
             old_world, new_world = view.world, int(pend["world"])
             direction = "shrink" if new_world < old_world else "grow"

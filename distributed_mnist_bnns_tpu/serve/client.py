@@ -14,13 +14,19 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional, Tuple
 
+from ..obs.trace import TRACE_HEADER, format_header, mint_context
+
 
 def _request(
-    url: str, *, data: Optional[bytes] = None, timeout: float = 30.0
+    url: str, *, data: Optional[bytes] = None, timeout: float = 30.0,
+    headers: Optional[Dict[str, str]] = None,
 ) -> Tuple[int, bytes]:
     req = urllib.request.Request(
         url, data=data,
-        headers={"Content-Type": "application/json"} if data else {},
+        headers={
+            **({"Content-Type": "application/json"} if data else {}),
+            **(headers or {}),
+        },
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -34,13 +40,24 @@ def _request(
 def predict(
     base_url: str, images: Any, *,
     deadline_ms: Optional[float] = None, timeout: float = 30.0,
+    trace: Any = None,
 ) -> Tuple[int, bytes]:
+    """POST /predict. ``trace``: the x-jg-trace contract's client half —
+    ``True`` mints a fresh context, or pass a ``TraceContext`` /
+    preformatted header string; the server adopts it and roots the
+    request's span tree under it."""
     body: Dict[str, Any] = {"images": images}
     if deadline_ms is not None:
         body["deadline_ms"] = deadline_ms
+    headers = None
+    if trace is not None:
+        if trace is True:
+            trace = mint_context()
+        value = trace if isinstance(trace, str) else format_header(trace)
+        headers = {TRACE_HEADER: value}
     return _request(
         base_url + "/predict", data=json.dumps(body).encode(),
-        timeout=timeout,
+        timeout=timeout, headers=headers,
     )
 
 

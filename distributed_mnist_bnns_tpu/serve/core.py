@@ -26,7 +26,6 @@ the exercised path).
 
 from __future__ import annotations
 
-import itertools
 import logging
 import threading
 import time
@@ -36,6 +35,12 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..analysis.guards import RecompileFenceError
+from ..obs.trace import (
+    NULL_SPAN,
+    NULL_TRACER,
+    TraceContext,
+    next_request_id,
+)
 
 log = logging.getLogger(__name__)
 
@@ -45,8 +50,6 @@ BATCHES_TOTAL = "serve_batches_total"
 BATCH_SECONDS = "serve_batch_seconds"
 QUEUE_DEPTH = "serve_queue_depth"
 BREAKER_TRANSITIONS_TOTAL = "serve_breaker_transitions_total"
-
-_req_ids = itertools.count()
 
 
 class Request:
@@ -60,11 +63,15 @@ class Request:
 
     __slots__ = (
         "id", "images", "n", "deadline", "enqueued_at", "event",
-        "status", "log_probs", "error", "_lock", "_done",
+        "status", "log_probs", "error", "span", "_lock", "_done",
     )
 
     def __init__(self, images: np.ndarray, deadline: float):
-        self.id = next(_req_ids)
+        # Run-scoped id (obs/trace): nonce-prefixed so ids never collide
+        # across replicas nor repeat across restarts — the join key
+        # between `request` events and span trees must be globally
+        # unique for a fleet-wide log merge.
+        self.id = next_request_id()
         self.images = images
         self.n = int(images.shape[0])
         self.deadline = deadline
@@ -73,6 +80,7 @@ class Request:
         self.status: Optional[str] = None
         self.log_probs: Optional[np.ndarray] = None
         self.error = ""
+        self.span = NULL_SPAN      # root trace span, set at admission
         self._lock = threading.Lock()
         self._done = False
 
@@ -206,6 +214,10 @@ class ServeEngine:
         # as silent per-batch compile stalls. None = unfenced (today's
         # behavior for cold boots).
         self.sanitizer = sanitizer
+        # Spans ride the telemetry sink's tracer (obs/trace); without
+        # telemetry the shared NULL_TRACER keeps every instrumentation
+        # site a single attribute check.
+        self.tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
         self.fence_error: Optional[str] = None
         self.batch_seq = 0
         self.draining = False
@@ -235,27 +247,49 @@ class ServeEngine:
 
     # -- admission (handler threads) ----------------------------------------
 
-    def submit(self, images: np.ndarray, deadline: float):
+    def submit(
+        self, images: np.ndarray, deadline: float,
+        ctx: Optional[TraceContext] = None,
+    ):
         """Admit or shed. Returns a :class:`Request`, or a shed-reason
-        string (``draining`` | ``breaker_open`` | ``queue_full``)."""
+        string (``draining`` | ``breaker_open`` | ``queue_full``).
+        ``ctx`` is an adopted ``x-jg-trace`` context (obs/trace): the
+        request's root span joins the client's trace; None mints a
+        fresh trace per request."""
         if self.draining or self._stop.is_set():
-            return self._shed("draining")
+            return self._shed("draining", ctx=ctx)
         if self.fence_error is not None:
             # The fence killed the worker: queueing would strand the
             # request until its deadline. Shed immediately and visibly
             # (health() reports failed) — same contract as the LM
             # engine's engine_failed.
-            return self._shed("engine_failed")
+            return self._shed("engine_failed", ctx=ctx)
         if not self.breaker.admits():
-            return self._shed("breaker_open")
+            return self._shed("breaker_open", ctx=ctx)
         req = Request(images, deadline)
+        req.span = self.tracer.start(
+            "serve.request", kind="request", ctx=ctx, fresh=True,
+            id=req.id, n=req.n,
+        )
         if not self.queue.try_put(req):
-            return self._shed("queue_full")
+            req.span.end("shed", reason="queue_full")
+            return self._shed("queue_full", spanned=True)
         return req
 
-    def _shed(self, reason: str) -> str:
+    def _shed(
+        self, reason: str, *, ctx: Optional[TraceContext] = None,
+        spanned: bool = False,
+    ) -> str:
         self.shed_ctr.inc(reason=reason)
         self.requests_ctr.inc(status="shed")
+        if not spanned and self.tracer.enabled:
+            # Sheds are spans too (zero-length): the slow tail's
+            # sibling outcomes stay joinable to the client's trace.
+            now = time.monotonic()
+            self.tracer.record(
+                "serve.request", kind="request", t0=now, t1=now,
+                ctx=ctx, fresh=True, status="shed", reason=reason,
+            )
         if self.telemetry is not None:
             self.telemetry.emit(
                 "shed", reason=reason, queue_depth=len(self.queue)
@@ -334,6 +368,13 @@ class ServeEngine:
                 )
             return
         t0 = time.perf_counter()
+        # Trace marks (monotonic, the span timebase): pop -> assembled
+        # -> stall (chaos) -> dispatch done. Children are banked
+        # retrospectively AFTER delivery, so tracing adds no I/O to the
+        # dispatch itself.
+        m_pop = now
+        m_asm = now
+        stall_s = 0.0
         try:
             # Assembly stays inside the try: admission validates shapes
             # against the served input shape, but a defect there must
@@ -345,17 +386,30 @@ class ServeEngine:
                 x = np.concatenate(
                     [x, np.zeros((pad, *x.shape[1:]), x.dtype)], axis=0
                 )
-            if self.chaos is not None and self.chaos.active:
-                self.chaos.on_infer(step=self.batch_seq)
-            out = np.asarray(self.predict_fn(x))
+            m_asm = time.monotonic()
+            # The batch span is the worker thread's *current* span
+            # while chaos + the predictor run, so a chaos fault fired
+            # here parents its own span under this batch — fault ->
+            # latency causality is a tree link, not a log-grep.
+            with self.tracer.start(
+                "serve.batch", kind="batch",
+                batch_seq=self.batch_seq, n=sum(r.n for r in live),
+            ):
+                if self.chaos is not None and self.chaos.active:
+                    c0 = time.monotonic()
+                    self.chaos.on_infer(step=self.batch_seq)
+                    stall_s = time.monotonic() - c0
+                out = np.asarray(self.predict_fn(x))
         except Exception as e:  # any backend error must trip, not crash
             dt = time.perf_counter() - t0
+            m_end = time.monotonic()
             self.breaker.record_failure(f"{type(e).__name__}: {e}")
             log.warning(
                 "serve batch %d failed after %.3fs (%s: %s)",
                 self.batch_seq, dt, type(e).__name__, e,
             )
             for r in live:
+                self._trace_phases(r, m_pop, m_asm, stall_s, m_end)
                 self._finish(
                     r, "error",
                     error=f"backend failure: {type(e).__name__}: {e}",
@@ -363,6 +417,7 @@ class ServeEngine:
                 )
             return
         dt = time.perf_counter() - t0
+        m_end = time.monotonic()
         self.batches_ctr.inc()
         self.batch_hist.observe(dt)
         if dt > self.stall_timeout_s:
@@ -377,12 +432,36 @@ class ServeEngine:
         for r in live:
             rows = out[offset:offset + r.n]
             offset += r.n
+            self._trace_phases(r, m_pop, m_asm, stall_s, m_end)
             self._finish(r, "ok", log_probs=rows, infer_s=dt,
                          queue_s=waits[r.id])
         if self.sanitizer is not None:
             # After delivery, so a trip never strands this batch's
             # clients waiting on their deadlines.
             self.sanitizer.after_step(step=self.batch_seq)
+
+    def _trace_phases(
+        self, req: Request, pop_m: float, asm_m: float,
+        stall_s: float, end_m: float,
+    ) -> None:
+        """Bank this request's dispatch-phase child spans (assemble /
+        stall / infer, explicit monotonic intervals) under its root.
+        The queue child and the root's end live in ``_finish`` — the
+        one place every outcome funnels through."""
+        if not self.tracer.enabled or req.span is NULL_SPAN:
+            return
+        rec = self.tracer.record
+        if asm_m > pop_m:
+            rec("serve.assemble", kind="assemble", parent=req.span,
+                t0=pop_m, t1=asm_m)
+        if stall_s > 0:
+            # The chaos/backend stall, split out of infer time so tail
+            # attribution can say "p99 is stall-dominated" directly.
+            rec("serve.stall", kind="stall", parent=req.span,
+                t0=asm_m, t1=asm_m + stall_s, batch_seq=self.batch_seq)
+        if end_m > asm_m + stall_s:
+            rec("serve.infer", kind="infer", parent=req.span,
+                t0=asm_m + stall_s, t1=end_m, batch_seq=self.batch_seq)
 
     def _finish(self, req: Request, status: str, *,
                 log_probs: Optional[np.ndarray] = None, error: str = "",
@@ -394,9 +473,17 @@ class ServeEngine:
         if not req.finish(status, log_probs=log_probs, error=error):
             status = "deadline"
         self.requests_ctr.inc(status=status)
+        if queue_s is None:
+            queue_s = time.monotonic() - req.enqueued_at
+        if self.tracer.enabled and req.span is not NULL_SPAN:
+            self.tracer.record(
+                "serve.queue", kind="queue", parent=req.span,
+                t0=req.enqueued_at, t1=req.enqueued_at + queue_s,
+            )
+            # Claim-once like Request.finish: a deadline waiter that
+            # already ended the root wins — this late end is a no-op.
+            req.span.end(status, batch_seq=self.batch_seq)
         if self.telemetry is not None:
-            if queue_s is None:
-                queue_s = time.monotonic() - req.enqueued_at
             fields: Dict[str, Any] = {
                 "id": req.id,
                 "status": status,

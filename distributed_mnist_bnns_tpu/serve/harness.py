@@ -1,0 +1,183 @@
+"""Importable classifier-serving saturation harness (ROADMAP item 5).
+
+bench.py's ``--serving-bench`` section measures the frozen predictor's
+raw throughput; the perf gate needs something different — the ENGINE's
+latency behavior under load: request p99 through the real admission
+queue, micro-batcher and deadline machinery, at saturation, in-process
+(no HTTP, no subprocess), deterministic enough to band in
+``PERF_BASELINES.json``. This module is that measurement, lifted out of
+bench.py so ``bench.py --serve-p99-bench``, ``scripts/perf_gate.py``
+and any future router/autoscaler test all run the SAME code path —
+the banked ceiling and the number a PR is judged by can never drift
+apart.
+
+The band discipline mirrors the PR 10 step-time ceilings: CPU latency
+under thread scheduling jitter swings run to run, so the gate's
+tolerance is WIDE (a catastrophe detector for e.g. a lock held across
+the predictor dispatch or a per-request host-work leak — which
+multiplies p99, not jitters it), while shed accounting and the
+zero-failure invariant stay exact.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..obs.trace import percentile as _percentile
+from ..resilience.policy import CircuitBreaker
+from .core import AdmissionQueue, ServeEngine
+
+
+def make_tiny_packed_predictor(
+    batch_size: int = 8, *, interpret: bool = True, seed: int = 0,
+):
+    """A small packed bnn-mlp predictor built in-process (no disk
+    artifact) — the cheapest real thing the serving engine can
+    dispatch. Returns ``(predict_fn, input_shape)``; the warmup call at
+    the compiled batch shape has already been paid."""
+    import jax
+
+    from ..infer import freeze_bnn_mlp
+    from ..models import bnn_mlp_small
+
+    model = bnn_mlp_small(backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(seed), (2, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        x, train=True,
+    )
+    fn, _info = freeze_bnn_mlp(model, variables, interpret=interpret)
+    warm = np.zeros((batch_size, 28, 28, 1), np.float32)
+    np.asarray(fn(warm))
+    return fn, (28, 28, 1)
+
+
+def saturation_probe(
+    predict_fn,
+    *,
+    batch_size: int = 8,
+    input_shape=(28, 28, 1),
+    n_threads: int = 8,
+    duration_s: float = 2.0,
+    deadline_ms: float = 2000.0,
+    queue_depth: int = 16,
+    linger_ms: float = 1.0,
+    chaos: Any = None,
+    telemetry: Any = None,
+) -> Dict[str, Any]:
+    """Drive a :class:`~.core.ServeEngine` at saturation and measure
+    request-level latency percentiles.
+
+    ``n_threads`` submitter threads each keep one single-example
+    request in flight back to back for ``duration_s`` — with
+    ``n_threads >= batch_size`` the queue never runs dry, so the
+    reported p99 covers queue wait + batch assembly + dispatch, i.e.
+    the number a client actually experiences under load (the
+    Tail-at-Scale quantity, not the predictor's solo latency)."""
+    breaker = CircuitBreaker(
+        failure_threshold=1 << 30,  # measurement, not resilience
+        reset_timeout_s=3600.0,
+    )
+    queue = AdmissionQueue(queue_depth)
+    engine = ServeEngine(
+        predict_fn,
+        batch_size=batch_size,
+        queue=queue,
+        breaker=breaker,
+        chaos=chaos,
+        telemetry=telemetry,
+        stall_timeout_s=3600.0,
+        linger_s=linger_ms / 1e3,
+    ).start()
+    latencies: List[float] = []
+    outcomes: Dict[str, int] = {}
+    lock = threading.Lock()
+    stop_at = time.monotonic() + duration_s
+
+    def hammer(tid: int) -> None:
+        rng = np.random.RandomState(tid)
+        images = rng.randn(1, *input_shape).astype(np.float32)
+        while time.monotonic() < stop_at:
+            t0 = time.monotonic()
+            req = engine.submit(
+                images, time.monotonic() + deadline_ms / 1e3
+            )
+            if isinstance(req, str):   # shed
+                with lock:
+                    outcomes[req] = outcomes.get(req, 0) + 1
+                time.sleep(0.001)      # back off a hair, stay saturated
+                continue
+            req.event.wait(deadline_ms / 1e3 + 1.0)
+            dt = time.monotonic() - t0
+            with lock:
+                outcomes[req.status or "lost"] = (
+                    outcomes.get(req.status or "lost", 0) + 1
+                )
+                if req.status == "ok":
+                    latencies.append(dt)
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,), daemon=True)
+        for i in range(n_threads)
+    ]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + deadline_ms / 1e3 + 30.0)
+    wall = time.monotonic() - t_start
+    engine.begin_drain()
+    engine.drain(timeout=10.0)
+    engine.stop()
+    latencies.sort()
+    ok = len(latencies)
+    return {
+        "n_threads": n_threads,
+        "batch_size": batch_size,
+        "queue_depth": queue_depth,
+        "duration_s": round(wall, 3),
+        "requests_ok": ok,
+        "outcomes": outcomes,
+        "throughput_rps": round(ok / wall, 1) if wall > 0 else None,
+        "p50_ms": (
+            round(_percentile(latencies, 50.0) * 1e3, 3) if ok else None
+        ),
+        "p90_ms": (
+            round(_percentile(latencies, 90.0) * 1e3, 3) if ok else None
+        ),
+        "p99_ms": (
+            round(_percentile(latencies, 99.0) * 1e3, 3) if ok else None
+        ),
+        "batches": engine.batch_seq,
+    }
+
+
+def serving_p99_section(
+    *,
+    batch_size: int = 8,
+    n_threads: int = 8,
+    duration_s: float = 2.0,
+    interpret: bool = True,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """The bench-record-shaped section (``serving_p99``): tiny packed
+    model, saturated engine, exact percentiles — what
+    ``scripts/perf_gate.py`` bands as ``classifier_p99_under_
+    saturation_ms`` (wide tolerance, catastrophe detector)."""
+    fn, input_shape = make_tiny_packed_predictor(
+        batch_size, interpret=interpret, seed=seed
+    )
+    out = saturation_probe(
+        fn,
+        batch_size=batch_size,
+        input_shape=input_shape,
+        n_threads=n_threads,
+        duration_s=duration_s,
+    )
+    out["interpret_mode"] = interpret
+    return out

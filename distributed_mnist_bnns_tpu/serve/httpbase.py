@@ -6,10 +6,12 @@ request/response mechanics that must not drift between them live here:
 keep-alive HTTP/1.1 with a connection-socket timeout (a client that
 declares a Content-Length and never sends the body must not pin a
 handler thread forever), stderr chatter routed into logging, one
-``_reply`` shape, and a body-size cap enforced BEFORE the body is read
+``_reply`` shape, a body-size cap enforced BEFORE the body is read
 (overload protection must not be bypassable by size; replying without
 reading desyncs a keep-alive connection, so an oversize request closes
-it).
+it), and the shared ``/metrics`` content negotiation: JSON by default,
+Prometheus text exposition under ``Accept: text/plain`` — one scrape
+format for the whole replica fleet (OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -20,6 +22,8 @@ from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, Optional
 
 _log = logging.getLogger(__name__)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class JsonHandler(BaseHTTPRequestHandler):
@@ -41,10 +45,33 @@ class JsonHandler(BaseHTTPRequestHandler):
         return (f"body of {n} bytes exceeds the "
                 f"{self._max_body_bytes()}-byte limit")
 
-    def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+    def _reply(
+        self, code: int, payload: Dict[str, Any],
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_metrics(self, registry: Any) -> None:
+        """``GET /metrics`` for both serving engines: the registry
+        snapshot as JSON (the default, what the repo's own tooling
+        reads), or Prometheus text exposition when the client asks for
+        ``text/plain`` — fleet scrapers negotiate, nothing breaks."""
+        accept = self.headers.get("Accept", "")
+        if "text/plain" not in accept:
+            self._reply(200, registry.snapshot())
+            return
+        from ..obs import render_prometheus
+
+        body = render_prometheus(registry.snapshot()).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

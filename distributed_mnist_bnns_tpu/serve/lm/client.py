@@ -15,11 +15,14 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from ...obs.trace import TRACE_HEADER, format_header, mint_context
 
-def _post(url: str, body: Dict[str, Any], timeout: float):
+
+def _post(url: str, body: Dict[str, Any], timeout: float,
+          headers: Optional[Dict[str, str]] = None):
     req = urllib.request.Request(
         url, data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     return urllib.request.urlopen(req, timeout=timeout)
 
@@ -31,10 +34,13 @@ def open_stream(
     temperature: Optional[float] = None,
     seed: Optional[int] = None,
     timeout: float = 60.0,
+    trace: Any = None,
 ) -> Tuple[int, Any]:
     """Start a generation. Returns ``(200, response)`` — read the live
     stream with :func:`iter_lines` — or ``(code, parsed_error_body)``
-    for sheds/4xx/5xx."""
+    for sheds/4xx/5xx. ``trace``: the x-jg-trace contract's client
+    half — ``True`` mints a context, or pass a ``TraceContext`` /
+    preformatted header string for the server to adopt."""
     body: Dict[str, Any] = (
         {"text": prompt} if isinstance(prompt, str)
         else {"prompt": list(prompt)}
@@ -47,8 +53,14 @@ def open_stream(
         body["temperature"] = temperature
     if seed is not None:
         body["seed"] = seed
+    headers = None
+    if trace is not None:
+        if trace is True:
+            trace = mint_context()
+        value = trace if isinstance(trace, str) else format_header(trace)
+        headers = {TRACE_HEADER: value}
     try:
-        resp = _post(base_url + "/generate", body, timeout)
+        resp = _post(base_url + "/generate", body, timeout, headers)
         return resp.status, resp
     except urllib.error.HTTPError as e:
         raw = e.read()

@@ -34,7 +34,6 @@ shipping as silent per-token compile stalls.
 
 from __future__ import annotations
 
-import itertools
 import logging
 import queue
 import threading
@@ -48,6 +47,12 @@ from ...analysis.guards import (
     RecompileFenceError,
     Sanitizer,
     SanitizerConfig,
+)
+from ...obs.trace import (
+    NULL_SPAN,
+    NULL_TRACER,
+    TraceContext,
+    next_request_id,
 )
 from ...ops.paged_kv import PageAllocator, pages_needed
 
@@ -77,9 +82,6 @@ class _PrefillDispatchError(RuntimeError):
     so it must never run for a mere telemetry error."""
 
 
-_req_ids = itertools.count()
-
-
 class LMRequest:
     """One admitted generation request and its token stream.
 
@@ -93,14 +95,16 @@ class LMRequest:
     __slots__ = (
         "id", "prompt", "max_new_tokens", "deadline", "temperature",
         "seed", "rng", "enqueued_at", "events", "cancelled", "status",
-        "tokens", "slot", "n_emitted",
+        "tokens", "slot", "n_emitted", "span",
     )
 
     def __init__(
         self, prompt: np.ndarray, max_new_tokens: int, deadline: float,
         temperature: float = 0.0, seed: int = 0,
     ):
-        self.id = next(_req_ids)
+        # Run-scoped id (obs/trace): nonce-prefixed, collision-free
+        # across replicas and restarts — the event/span join key.
+        self.id = next_request_id()
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.deadline = float(deadline)
@@ -121,6 +125,7 @@ class LMRequest:
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
         self.n_emitted = 0
+        self.span = NULL_SPAN      # root trace span, set at admission
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (time.monotonic() if now is None else now) >= self.deadline
@@ -131,7 +136,7 @@ class _Slot:
     pools + the engine's position/table arrays)."""
 
     __slots__ = ("req", "pages", "total_len", "rng", "admitted_iter",
-                 "admitted_at")
+                 "admitted_at", "decode_span")
 
     def __init__(self, req: LMRequest, pages: List[int], total_len: int,
                  admitted_iter: int, admitted_at: float):
@@ -141,6 +146,7 @@ class _Slot:
         self.rng = req.rng
         self.admitted_iter = admitted_iter
         self.admitted_at = admitted_at      # queue pop, BEFORE prefill
+        self.decode_span = NULL_SPAN        # the stream's decode window
 
 
 class LMEngine:
@@ -198,6 +204,10 @@ class LMEngine:
         from ...obs import default_registry, get_tracker
 
         self._tracker = get_tracker()
+        # Spans ride the telemetry sink's tracer (obs/trace); the shared
+        # NULL_TRACER keeps instrumentation a single attribute check
+        # when telemetry is off.
+        self.tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
         reg = telemetry.registry if telemetry is not None else None
         if reg is None:
             reg = default_registry()
@@ -326,22 +336,30 @@ class LMEngine:
     def submit(
         self, prompt, max_new_tokens: int, deadline: float, *,
         temperature: float = 0.0, seed: int = 0,
+        ctx: Optional[TraceContext] = None,
     ):
         """Admit or shed. Returns an :class:`LMRequest` or a shed-reason
         string. Validation beyond shape limits (prompt length vs
-        ``max_len``) is the transport's job — it owns the 4xx replies."""
+        ``max_len``) is the transport's job — it owns the 4xx replies.
+        ``ctx`` is an adopted ``x-jg-trace`` context (obs/trace): the
+        stream's span tree joins the client's trace."""
         if self.draining or self._stop.is_set():
-            return self._shed("draining")
+            return self._shed("draining", ctx=ctx)
         if self.fence_error is not None or (
             self._thread is not None and not self._thread.is_alive()
         ):
             # The scheduler is dead (recompile fence or a fatal crash):
             # queueing would strand the request until its deadline.
             # Shed immediately — and visibly (health() reports failed).
-            return self._shed("engine_failed")
+            return self._shed("engine_failed", ctx=ctx)
         req = LMRequest(
             prompt, max_new_tokens, deadline,
             temperature=temperature, seed=seed,
+        )
+        req.span = self.tracer.start(
+            "lm.request", kind="request", ctx=ctx, fresh=True,
+            id=req.id, prompt_tokens=int(req.prompt.shape[0]),
+            max_new_tokens=req.max_new_tokens,
         )
         with self._cond:
             if self._closed:
@@ -356,11 +374,23 @@ class LMEngine:
                 self._queue.append(req)
                 self._cond.notify()
                 return req
-        return self._shed(reason)
+        req.span.end("shed", reason=reason)
+        return self._shed(reason, spanned=True)
 
-    def _shed(self, reason: str) -> str:
+    def _shed(
+        self, reason: str, *, ctx: Optional[TraceContext] = None,
+        spanned: bool = False,
+    ) -> str:
         self.shed_ctr.inc(reason=reason)
         self.requests_ctr.inc(status="shed")
+        if not spanned and self.tracer.enabled:
+            # Sheds are (zero-length) spans too, joinable to the
+            # client's trace — same contract as serve/core.
+            now = time.monotonic()
+            self.tracer.record(
+                "lm.request", kind="request", t0=now, t1=now,
+                ctx=ctx, fresh=True, status="shed", reason=reason,
+            )
         if self.telemetry is not None:
             self.telemetry.emit(
                 "shed", reason=reason, queue_depth=self.queue_len,
@@ -477,6 +507,7 @@ class LMEngine:
                     f"{self.allocator.capacity}",
                 )
                 continue
+            alloc_t0 = time.monotonic()
             pages = self.allocator.alloc(need)
             if pages is None:
                 # Not enough KV memory: requeue at the head and let
@@ -484,10 +515,25 @@ class LMEngine:
                 with self._cond:
                     self._queue.appendleft(req)
                 return
+            if self.tracer.enabled:
+                # Queue wait ends when the scheduler starts working on
+                # the request (= alloc start); page_alloc follows it.
+                # Sequential, non-overlapping children — the critical-
+                # path attribution sums child self-times, so sibling
+                # intervals must not overlap.
+                self.tracer.record(
+                    "lm.queue", kind="queue", parent=req.span,
+                    t0=req.enqueued_at, t1=alloc_t0,
+                )
+                self.tracer.record(
+                    "lm.page_alloc", kind="page_alloc", parent=req.span,
+                    t0=alloc_t0, t1=time.monotonic(),
+                    pages=len(pages), need=need,
+                )
             try:
                 self._prefill_into_slot(req, slot, pages, total)
             except Exception as e:
-                log.exception("lm-engine prefill for request %d failed",
+                log.exception("lm-engine prefill for request %s failed",
                               req.id)
                 hazard = isinstance(e, _PrefillDispatchError)
                 cause = e.__cause__ if hazard and e.__cause__ else e
@@ -561,6 +607,22 @@ class LMEngine:
         self.prefill_hist.observe(prefill_ms)
         self.tokens_ctr.inc(plen, phase="prefill")
         st = _Slot(req, pages, total, self.batch_seq, admitted_at)
+        if self.tracer.enabled:
+            # The queue + page_alloc children were banked at admission
+            # (_admit_ready); prefill picks up from the same marks the
+            # prefill_ms event field is derived from, so spans and
+            # events can never disagree.
+            self.tracer.record(
+                "lm.prefill", kind="prefill", parent=req.span,
+                t0=admitted_at, t1=admitted_at + prefill_ms / 1e3,
+                prompt_tokens=plen, chunks=padded // chunk, slot=slot,
+            )
+            # The decode window: first token out of prefill -> evict.
+            # A live span (ended by _evict) so a request that dies
+            # mid-stream still closes its tree.
+            st.decode_span = self.tracer.start(
+                "lm.decode", kind="decode", parent=req.span, slot=slot,
+            )
         # First generated token comes straight out of prefill: the
         # prompt's last position predicts position plen.
         first = self._sample_token(
@@ -617,33 +679,47 @@ class LMEngine:
         self._expire_active()
         if self.active_streams == 0:
             return
-        if self.chaos is not None and self.chaos.active:
+        # ONE span per decode iteration, batching all active slots (the
+        # iteration-level scheduler's unit of work): while it is the
+        # scheduler thread's current span, a chaos fault fired below
+        # parents its own span here — the previously invisible gap
+        # between lm_admit and lm_evict becomes a causal lane.
+        iter_span = self.tracer.start(
+            "lm.decode_iter", kind="decode_iter",
+            iteration=self.batch_seq, active=self.active_streams,
+        )
+        with iter_span:
+            if self.chaos is not None and self.chaos.active:
+                try:
+                    self.chaos.on_infer(step=self.batch_seq)
+                except Exception as e:
+                    # Raised BEFORE the dispatch: nothing was donated,
+                    # the pools are intact, the iteration can simply be
+                    # retried (bounded by max_consecutive_failures).
+                    iter_span.end("error", error=type(e).__name__)
+                    self._record_predispatch_failure(e)
+                    return
+            t0 = time.perf_counter()
             try:
-                self.chaos.on_infer(step=self.batch_seq)
+                self._pools, lp = self.decoder.decode(
+                    self._pools,
+                    jnp.asarray(self._tokens),
+                    jnp.asarray(self._page_tables),
+                    jnp.asarray(self._positions),
+                )
+                lp_host = np.asarray(lp)   # the per-iteration sync point
             except Exception as e:
-                # Raised BEFORE the dispatch: nothing was donated, the
-                # pools are intact, the iteration can simply be retried
-                # (bounded by max_consecutive_failures).
-                self._record_predispatch_failure(e)
+                # A failure INSIDE the dispatch cannot be retried: the
+                # pools were donated to it and may already be deleted.
+                # Fail every active stream loudly and rebuild fresh
+                # pools so the engine keeps serving future requests
+                # (same compiled programs — the shapes are unchanged,
+                # no recompile).
+                iter_span.end("error", error=type(e).__name__)
+                self._dispatch_failure(e)
                 return
-        t0 = time.perf_counter()
-        try:
-            self._pools, lp = self.decoder.decode(
-                self._pools,
-                jnp.asarray(self._tokens),
-                jnp.asarray(self._page_tables),
-                jnp.asarray(self._positions),
-            )
-            lp_host = np.asarray(lp)       # the per-iteration sync point
-        except Exception as e:
-            # A failure INSIDE the dispatch cannot be retried: the
-            # pools were donated to it and may already be deleted. Fail
-            # every active stream loudly and rebuild fresh pools so the
-            # engine keeps serving future requests (same compiled
-            # programs — the shapes are unchanged, no recompile).
-            self._dispatch_failure(e)
-            return
-        dt = time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            iter_span.end("ok", iter_ms=round(dt * 1e3, 3))
         self._consecutive_failures = 0
         self.iter_hist.observe(dt)
         if self._sanitizer is not None:
@@ -753,6 +829,8 @@ class LMEngine:
         self.allocator.free(st.pages)
         req = st.req
         req.slot = None
+        st.decode_span.end(status, tokens=req.n_emitted,
+                           iteration=self.batch_seq)
         self._finish(req, status, detail, slot=slot,
                      pages_freed=len(st.pages),
                      wall_ms=round(
@@ -782,6 +860,14 @@ class LMEngine:
     def _finish_unslotted(
         self, req: LMRequest, status: str, detail: str
     ) -> None:
+        if self.tracer.enabled and req.span is not NULL_SPAN:
+            # Never admitted: its whole life WAS queue wait — the span
+            # tree says so explicitly (a queued-deadline 504 shows up
+            # queue-dominated in tail attribution, as it should).
+            self.tracer.record(
+                "lm.queue", kind="queue", parent=req.span,
+                t0=req.enqueued_at, t1=time.monotonic(),
+            )
         self._finish(req, status, detail, slot=None, pages_freed=0,
                      wall_ms=round(
                          (time.monotonic() - req.enqueued_at) * 1e3, 3))
@@ -792,6 +878,8 @@ class LMEngine:
     ) -> None:
         req.status = status
         self.requests_ctr.inc(status=status)
+        req.span.end(status, tokens_emitted=req.n_emitted,
+                     iteration=self.batch_seq)
         if self.telemetry is not None:
             fields: Dict[str, Any] = {
                 "id": req.id, "status": status, "slot": slot,

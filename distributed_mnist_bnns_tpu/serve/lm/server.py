@@ -85,6 +85,10 @@ class LMServeConfig:
                                         # re-bank
     aot_dir: Optional[str] = None       # store root (JG_AOT_STORE /
                                         # <repo>/.jax_aot default)
+    trace: Optional[bool] = None        # per-request span trees in the
+                                        # event log (obs/trace): None =
+                                        # the JG_TRACE env var; needs
+                                        # telemetry_dir
 
 
 class LMServer:
@@ -94,7 +98,9 @@ class LMServer:
         self.config = config
         from ...obs import Telemetry
 
-        self.telemetry = Telemetry(config.telemetry_dir, heartbeat=False)
+        self.telemetry = Telemetry(
+            config.telemetry_dir, heartbeat=False, trace=config.trace
+        )
         from ...resilience.chaos import ChaosController
 
         self.chaos = ChaosController.from_config(
@@ -289,10 +295,14 @@ class _LMHandler(JsonHandler):
 
     # -- chunked ndjson streaming --------------------------------------------
 
-    def _start_stream(self) -> None:
+    def _start_stream(
+        self, headers: Optional[Dict[str, str]] = None
+    ) -> None:
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
 
     def _write_line(self, obj: Dict[str, Any]) -> None:
@@ -310,7 +320,9 @@ class _LMHandler(JsonHandler):
         if self.path == "/healthz":
             self._reply(200, self.srv.health())
         elif self.path == "/metrics":
-            self._reply(200, self.srv.telemetry.registry.snapshot())
+            # JSON by default, Prometheus text under Accept: text/plain
+            # (shared negotiation in httpbase).
+            self._reply_metrics(self.srv.telemetry.registry)
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -402,13 +414,25 @@ class _LMHandler(JsonHandler):
             })
             return
         deadline = time.monotonic() + deadline_ms / 1e3
+        # x-jg-trace: the client mints, this server adopts (obs/trace);
+        # malformed headers degrade to a fresh trace, never a 4xx.
+        from ...obs.trace import TRACE_HEADER, parse_header
+
+        ctx = parse_header(self.headers.get(TRACE_HEADER))
         req = engine.submit(
-            prompt, max_new, deadline, temperature=temperature, seed=seed,
+            prompt, max_new, deadline, temperature=temperature,
+            seed=seed, ctx=ctx,
         )
         if isinstance(req, str):       # shed reason
             self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req})
             return
         self._stream_reply(req, deadline)
+
+    def _trace_headers(self, req: LMRequest):
+        from ...obs.trace import TRACE_HEADER, format_header
+
+        ctx = req.span.context
+        return {TRACE_HEADER: format_header(ctx)} if ctx else None
 
     def _stream_reply(self, req: LMRequest, deadline: float) -> None:
         """Wait for the first event (bounded by the deadline — a
@@ -421,17 +445,18 @@ class _LMHandler(JsonHandler):
             )
         except queue.Empty:
             req.cancelled = True       # scheduler drops + frees on sight
-            self._reply(504, {"error": "deadline exceeded", "id": req.id})
+            self._reply(504, {"error": "deadline exceeded", "id": req.id},
+                        headers=self._trace_headers(req))
             return
         if ev["kind"] == "done" and not req.tokens:
             # finished before emitting anything: map to a plain status
             code = {"deadline": 504, "error": 502}.get(ev["status"], 502)
             self._reply(code, {
                 "error": ev.get("detail") or ev["status"], "id": req.id,
-            })
+            }, headers=self._trace_headers(req))
             return
         try:
-            self._start_stream()
+            self._start_stream(self._trace_headers(req))
             while True:
                 if ev["kind"] == "done":
                     self._write_line({

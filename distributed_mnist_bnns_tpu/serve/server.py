@@ -89,6 +89,10 @@ class ServeConfig:
                                      # compile, re-banked for next time
     aot_dir: Optional[str] = None    # store root (default: JG_AOT_STORE
                                      # or <repo>/.jax_aot)
+    trace: Optional[bool] = None     # per-request span trees into the
+                                     # event log (obs/trace): True/False
+                                     # explicit, None = the JG_TRACE env
+                                     # var; needs telemetry_dir
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -99,7 +103,9 @@ class PackedInferenceServer:
         self.config = config
         from ..obs import Telemetry
 
-        self.telemetry = Telemetry(config.telemetry_dir, heartbeat=False)
+        self.telemetry = Telemetry(
+            config.telemetry_dir, heartbeat=False, trace=config.trace
+        )
         from ..resilience.chaos import ChaosController
 
         self.chaos = ChaosController.from_config(
@@ -410,7 +416,9 @@ class _Handler(JsonHandler):
         if self.path == "/healthz":
             self._reply(200, self.srv.health())
         elif self.path == "/metrics":
-            self._reply(200, self.srv.telemetry.registry.snapshot())
+            # JSON by default, Prometheus text under Accept: text/plain
+            # (shared negotiation in httpbase).
+            self._reply_metrics(self.srv.telemetry.registry)
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -480,11 +488,25 @@ class _Handler(JsonHandler):
             })
             return
         deadline = time.monotonic() + deadline_ms / 1e3
-        req = engine.submit(images, deadline)
+        # x-jg-trace: the client mints, this server adopts — the
+        # request's span tree joins the caller's trace (obs/trace;
+        # malformed headers degrade to a fresh trace, never a 4xx).
+        from ..obs.trace import TRACE_HEADER, parse_header
+
+        ctx = parse_header(self.headers.get(TRACE_HEADER))
+        req = engine.submit(images, deadline, ctx)
         if isinstance(req, str):  # shed reason
             self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req})
             return
         self._wait_and_reply(req, deadline)
+
+    def _trace_headers(self, req: Request) -> Optional[Dict[str, str]]:
+        """Echo the request's trace id so an untraced-by-the-client
+        caller can still find its span tree in the server's log."""
+        from ..obs.trace import TRACE_HEADER, format_header
+
+        ctx = req.span.context
+        return {TRACE_HEADER: format_header(ctx)} if ctx else None
 
     def _wait_and_reply(self, req: Request, deadline: float) -> None:
         """Block until the engine resolves ``req`` or its deadline
@@ -494,12 +516,17 @@ class _Handler(JsonHandler):
         remaining = deadline - time.monotonic() + _WAIT_SLACK_S
         if not req.event.wait(max(remaining, 0.0)):
             if req.finish("deadline", error="deadline exceeded"):
+                # The waiter won the claim: it owns the root span's end
+                # too (the engine's later _finish end is a no-op).
+                req.span.end("deadline")
                 self._reply(504, {
                     "error": "deadline exceeded", "id": req.id,
-                })
+                }, headers=self._trace_headers(req))
                 return
             # engine won the race after our timeout check: fall through
         status = req.status
+        m_resp = time.monotonic()
+        trace_headers = self._trace_headers(req)
         if status == "ok":
             lp = req.log_probs
             assert lp is not None
@@ -509,13 +536,22 @@ class _Handler(JsonHandler):
             self._reply(200, {
                 "argmax": [int(i) for i in lp.argmax(-1)],
                 "log_probs": [[float(v) for v in row] for row in lp],
-            })
+            }, headers=trace_headers)
         elif status == "deadline":
             self._reply(504, {"error": req.error or "deadline exceeded",
-                              "id": req.id})
+                              "id": req.id}, headers=trace_headers)
         elif status == "breaker_open":
             self._reply(503, {"error": "shed", "reason": "breaker_open",
-                              "id": req.id})
+                              "id": req.id}, headers=trace_headers)
         else:
             self._reply(502, {"error": req.error or "backend failure",
-                              "id": req.id})
+                              "id": req.id}, headers=trace_headers)
+        engine = self.srv.engine
+        if engine is not None and engine.tracer.enabled:
+            # The handler-side tail of the tree: wake-to-reply-written
+            # (serialization + socket write), the "respond" phase of
+            # admit -> queue -> dispatch -> respond.
+            engine.tracer.record(
+                "serve.respond", kind="respond", parent=req.span,
+                t0=m_resp, t1=time.monotonic(), status=str(status),
+            )

@@ -579,6 +579,12 @@ class TrainConfig:
                                    # checkpoint, error), per-process
                                    # heartbeats, recompile tracking.
                                    # None = registry-only (no files).
+    trace: Optional[bool] = None   # per-request/step span trees in the
+                                   # event log (obs/trace): step,
+                                   # checkpoint, restore and remesh
+                                   # windows become `cli trace`-readable
+                                   # spans. None = the JG_TRACE env var;
+                                   # needs telemetry_dir.
     sanitize: Optional[str] = None  # runtime fences (analysis/guards):
                                    # comma list of "recompile" (hard-
                                    # error on over-budget retraces),
@@ -966,7 +972,7 @@ class Trainer:
         from ..obs import Telemetry, peak_for_default_device, train_step_flops
 
         cfg = self.config
-        self.telemetry = Telemetry(cfg.telemetry_dir)
+        self.telemetry = Telemetry(cfg.telemetry_dir, trace=cfg.trace)
         # Global batch: each process feeds batch_size examples per step
         # (the DistributedSampler shard contract of batch_iterator).
         self._global_batch = cfg.batch_size * jax.process_count()
@@ -2029,6 +2035,8 @@ class Trainer:
                 # reaches cross-host agreement first.
                 if self.stop.requested and jax.process_count() <= 1:
                     self._graceful_stop(epoch, batches_done=seen)
+                tracer = self.telemetry.tracer
+                m0 = time.monotonic() if tracer.enabled else 0.0
                 t0 = time.perf_counter()
                 if self.mesh is None:
                     # (prefetched) single-device upload; the mesh paths
@@ -2068,6 +2076,16 @@ class Trainer:
                             f", {n}-step scan" if n > 1 else "",
                         )
                 dt = time.perf_counter() - t0
+                if tracer.enabled:
+                    # One span per DISPATCH (a scan chunk is one span
+                    # covering n optimizer steps) — banked retro-
+                    # spectively, so tracing adds zero work to the
+                    # dispatch itself and nothing when disabled.
+                    tracer.record(
+                        "train.step", kind="step", t0=m0,
+                        t1=time.monotonic(), step=seen, n_steps=n,
+                        epoch=epoch,
+                    )
                 self.batch_meter.update(dt / n, n)
                 batch_times.extend([dt / n] * n)
                 self._record_step(dt / n, n, seen, synced_metrics)
@@ -2297,14 +2315,18 @@ class Trainer:
                 extra["batch_in_epoch"] = int(batches_done)
             # epoch meta records the last COMPLETED epoch (-1: none) so
             # a digest-only reader resumes at worst a whole epoch back.
-            self._saver()(
-                self.state,
-                cfg.checkpoint_dir,
-                epoch=epoch - 1 if batches_done is not None else epoch,
-                extra_meta=extra,
-                keep_generations=cfg.checkpoint_keep,
-                chaos=self.chaos,
-            )
+            with self.telemetry.tracer.start(
+                "train.checkpoint", kind="checkpoint", epoch=epoch,
+                preempted=True,
+            ):
+                self._saver()(
+                    self.state,
+                    cfg.checkpoint_dir,
+                    epoch=epoch - 1 if batches_done is not None else epoch,
+                    extra_meta=extra,
+                    keep_generations=cfg.checkpoint_keep,
+                    chaos=self.chaos,
+                )
             if self._checkpointer is not None:
                 self._checkpointer.wait()  # exiting: the write must land
             saved = True
@@ -2361,6 +2383,7 @@ class Trainer:
             ):
                 return 0, 0
             load = load_checkpoint_resilient
+        m_restore = time.monotonic()   # the restore window's span start
         load_kwargs = {}
         if load is load_checkpoint_resilient:
             # Elastic runs tolerate a world-size mismatch (the remesh
@@ -2384,6 +2407,11 @@ class Trainer:
                 "rollback", path=ckpt, file=None,
                 outcome="fresh_start", error=str(e)[:500],
             )
+            if self.telemetry.tracer.enabled:
+                self.telemetry.tracer.record(
+                    "train.restore", kind="restore", t0=m_restore,
+                    t1=time.monotonic(), status="fresh_start", path=ckpt,
+                )
             return 0, 0
         meta = info.get("meta") or {}
         remeshed = False
@@ -2475,6 +2503,16 @@ class Trainer:
             checkpoint_world_size=meta.get("world_size"),
             remeshed=remeshed,
         )
+        if self.telemetry.tracer.enabled:
+            # The whole restore window (load + digest verify + any
+            # remesh re-placement + mesh placement), retrospective so a
+            # failed restore never leaves an open span behind.
+            self.telemetry.tracer.record(
+                "train.restore", kind="restore", t0=m_restore,
+                t1=time.monotonic(), path=ckpt, epoch=start,
+                remeshed=remeshed,
+                rolled_back=bool(info.get("rolled_back")),
+            )
         log.info(
             "resumed from %s at epoch %d%s (step %d)", ckpt, start,
             f" batch {start_batch}" if start_batch else "",
@@ -2551,24 +2589,33 @@ class Trainer:
                         is_best = acc > self.best_acc
                         self.best_acc = max(self.best_acc, acc)
                         world_size, mesh_shape = trainer_topology(self)
-                        self._saver()(
-                            self.state,
-                            self.config.checkpoint_dir,
-                            is_best=is_best,
-                            epoch=epoch,
-                            save_all=self.config.save_all_epochs,
-                            extra_meta={
-                                "best_acc": self.best_acc,
-                                "world_size": world_size,
-                                "mesh_shape": mesh_shape,
-                                **{
-                                    k: v for k, v in row.items()
-                                    if isinstance(v, float)
+                        # The save window as a span: checkpoint cost is
+                        # attributable next to the step spans it delays
+                        # (async saves only cover the handoff here).
+                        with self.telemetry.tracer.start(
+                            "train.checkpoint", kind="checkpoint",
+                            epoch=epoch, best=is_best,
+                        ):
+                            self._saver()(
+                                self.state,
+                                self.config.checkpoint_dir,
+                                is_best=is_best,
+                                epoch=epoch,
+                                save_all=self.config.save_all_epochs,
+                                extra_meta={
+                                    "best_acc": self.best_acc,
+                                    "world_size": world_size,
+                                    "mesh_shape": mesh_shape,
+                                    **{
+                                        k: v for k, v in row.items()
+                                        if isinstance(v, float)
+                                    },
                                 },
-                            },
-                            keep_generations=self.config.checkpoint_keep,
-                            chaos=self.chaos,
-                        )
+                                keep_generations=(
+                                    self.config.checkpoint_keep
+                                ),
+                                chaos=self.chaos,
+                            )
                         self.telemetry.checkpoint(
                             epoch, self.config.checkpoint_dir, best=is_best
                         )

@@ -21,6 +21,14 @@ staggered-length concurrent streaming requests through it and asserts:
   * every page is back in the pool when traffic ends, and SIGTERM
     drains to **exit 0** with a ``drain`` event.
 
+Tracing rides the whole scenario (``--trace``, OBSERVABILITY.md
+"Tracing"): every completed stream must leave a CLOSED span tree
+(root ``lm.request`` + queue/prefill/decode children, parents
+resolving) joined to its ``lm_evict`` event by id, the export must be
+Perfetto-loadable Chrome-trace JSON, and the zero-post-warmup-
+recompile check above now runs WITH tracing on — the budget-0 fence
+must stay green while spans flow.
+
 Usage: python scripts/lm_serve_smoke.py [--dir DIR] [--keep]
 """
 
@@ -97,6 +105,7 @@ def main(argv=None) -> int:
             "--prefill-chunk", "8",
             "--queue-depth", "4",
             "--telemetry-dir", tel_dir,
+            "--trace",
             "--chaos", CHAOS_SPEC,
             "--interpret",
             "--log-file", os.path.join(work, "lm_serve.log"),
@@ -273,6 +282,74 @@ def main(argv=None) -> int:
     if drains and not drains[-1].get("flushed"):
         failures.append("drain did not flush streaming work")
 
+    # -- tracing acceptance (OBSERVABILITY.md "Tracing") ----------------
+    from distributed_mnist_bnns_tpu.obs.trace import unresolved_parents
+
+    spans = [e for e in events if e["kind"] == "span"]
+    if not spans:
+        failures.append("tracing was enabled but no span events landed")
+    roots = {
+        (s.get("attrs") or {}).get("id"): s
+        for s in spans if s.get("span_kind") == "request"
+    }
+    kinds_by_root = {}
+    for s in spans:
+        key = (s.get("trace"), s.get("parent"))
+        for rid, r in roots.items():
+            if key == (r.get("trace"), r.get("span")):
+                kinds_by_root.setdefault(rid, set()).add(s.get("span_kind"))
+    for e in evicts:
+        if e["status"] != "ok":
+            continue
+        rid = e["id"]
+        if rid not in roots:
+            failures.append(
+                f"completed stream {rid} has no root span — every "
+                "request must leave a closed span tree"
+            )
+            continue
+        have = kinds_by_root.get(rid, set())
+        if not {"queue", "prefill", "decode"} <= have:
+            failures.append(
+                f"stream {rid}'s span tree is missing phases: have "
+                f"{sorted(have)}, want queue+prefill+decode"
+            )
+    if not any(s.get("span_kind") == "decode_iter" for s in spans):
+        failures.append(
+            "no decode-iteration spans — the scheduler's per-iteration "
+            "lane must be trace-visible"
+        )
+    if not any(s.get("span_kind") == "stall" for s in spans):
+        failures.append(
+            "chaos stalls fired but no stall span landed — fault->"
+            "latency causality must be trace-visible"
+        )
+    broken = unresolved_parents(spans)
+    if broken:
+        failures.append(
+            f"{len(broken)} span(s) reference a parent missing from "
+            "the log — span trees must close"
+        )
+    export_path = os.path.join(work, "chrome_trace.json")
+    cli = subprocess.run(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+         "trace", tel_dir, "--export", export_path],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if cli.returncode != 0:
+        failures.append(f"cli trace failed: {cli.stderr[-300:]}")
+    else:
+        try:
+            with open(export_path) as f:
+                chrome = json.load(f)
+            assert chrome["traceEvents"], "empty traceEvents"
+            for ev in chrome["traceEvents"]:
+                assert ev["ph"] in ("X", "M"), ev
+                assert {"name", "pid", "tid"} <= set(ev), ev
+        except (OSError, ValueError, KeyError, AssertionError) as e:
+            failures.append(f"Chrome-trace export invalid: {e!r}")
+
     summary = {
         "streams": {
             tid: {"code": r["code"], "n_tokens": len(r["tokens"]),
@@ -283,6 +360,7 @@ def main(argv=None) -> int:
         "queued_deadline_probe": code_504,
         "events": {k: sum(1 for e in events if e["kind"] == k)
                    for k in EXPECTED_KINDS},
+        "spans": len(spans),
         "recompiles_post_warmup": health.get("recompiles_post_warmup"),
         "drain": drains[-1] if drains else None,
         "ok": not failures,

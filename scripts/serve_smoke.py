@@ -10,6 +10,13 @@ event log that the server shed explicitly (never queue collapse), the
 circuit breaker opened AND closed again, and the drain flushed
 (SERVING.md "Live serving", RESILIENCE.md).
 
+Tracing rides the whole scenario (``--trace``, OBSERVABILITY.md
+"Tracing"): every completed request must leave a CLOSED span tree
+joined to its ``request`` event by id (root + resolvable children), a
+client-minted ``x-jg-trace`` context must be adopted by the server,
+and ``cli trace --export`` must render Perfetto-loadable
+Chrome-trace JSON from the same log.
+
 Usage: python scripts/serve_smoke.py [--dir DIR] [--keep]
 """
 
@@ -91,6 +98,7 @@ def main(argv=None) -> int:
             "--breaker-threshold", "3",
             "--breaker-reset-s", "0.4",
             "--telemetry-dir", tel_dir,
+            "--trace",
             "--chaos", CHAOS_SPEC,
             "--interpret",
             "--log-file", os.path.join(work, "serve.log"),
@@ -145,10 +153,15 @@ def main(argv=None) -> int:
         for t in threads:
             t.start()
 
-        # mid-traffic hot reload + bitwise identity probe
+        # mid-traffic hot reload + bitwise identity probe; the before-
+        # probe also exercises the x-jg-trace client half (minted
+        # context, server must adopt it — asserted from the log below)
+        from distributed_mnist_bnns_tpu.obs import mint_context
+
+        probe_ctx = mint_context()
         time.sleep(HAMMER_SECONDS / 2)
         probe_before = sc.predict(base, rng_imgs, deadline_ms=5000,
-                                  timeout=10)
+                                  timeout=10, trace=probe_ctx)
         reload_code, _ = sc.reload_artifact(base, timeout=60)
         probe_after = sc.predict(base, rng_imgs, deadline_ms=5000,
                                  timeout=10)
@@ -207,12 +220,88 @@ def main(argv=None) -> int:
     if drains and not drains[-1].get("flushed"):
         failures.append("drain did not flush in-flight work")
 
+    # -- tracing acceptance (OBSERVABILITY.md "Tracing") ----------------
+    from distributed_mnist_bnns_tpu.obs.trace import unresolved_parents
+
+    spans = [e for e in events if e["kind"] == "span"]
+    if not spans:
+        failures.append("tracing was enabled but no span events landed")
+    roots = {}
+    for s in spans:
+        if s.get("span_kind") == "request":
+            rid = (s.get("attrs") or {}).get("id")
+            if rid is not None:
+                roots[rid] = s
+    req_events = [e for e in events if e["kind"] == "request"]
+    missing = [e["id"] for e in req_events if e["id"] not in roots]
+    if missing:
+        failures.append(
+            f"{len(missing)} completed request(s) have no root span "
+            f"(e.g. {missing[:3]}) — every admitted request must leave "
+            "a closed span tree"
+        )
+    parents = {(s.get("trace"), s.get("parent")) for s in spans}
+    admitted = {e["id"] for e in req_events}
+    # Shed-at-admission roots are legitimately leaf-only (the request
+    # never entered the engine); every ADMITTED request must decompose.
+    childless = [
+        rid for rid, s in roots.items()
+        if rid in admitted
+        and (s.get("trace"), s.get("span")) not in parents
+    ]
+    if childless:
+        failures.append(
+            f"{len(childless)} request root span(s) have no children "
+            f"(e.g. {childless[:3]}) — admit->queue->dispatch->respond "
+            "must decompose the request"
+        )
+    broken = unresolved_parents(spans)
+    if broken:
+        failures.append(
+            f"{len(broken)} span(s) reference a parent missing from "
+            "the log — span trees must close"
+        )
+    if not any(s.get("trace") == probe_ctx.trace_id for s in spans):
+        failures.append(
+            "the client-minted x-jg-trace context was not adopted "
+            "(no span carries its trace id)"
+        )
+    if not any(s.get("span_kind") == "stall" for s in spans):
+        failures.append(
+            "chaos stalls fired but no stall span landed — fault->"
+            "latency causality must be trace-visible"
+        )
+    # Perfetto-loadable export through the real CLI
+    export_path = os.path.join(work, "chrome_trace.json")
+    cli = subprocess.run(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+         "trace", tel_dir, "--export", export_path],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if cli.returncode != 0:
+        failures.append(f"cli trace failed: {cli.stderr[-300:]}")
+    else:
+        try:
+            with open(export_path) as f:
+                chrome = json.load(f)
+            assert chrome["traceEvents"], "empty traceEvents"
+            for ev in chrome["traceEvents"]:
+                assert ev["ph"] in ("X", "M"), ev
+                assert {"name", "pid", "tid"} <= set(ev), ev
+                if ev["ph"] == "X":
+                    assert ev["dur"] >= 0 and "ts" in ev, ev
+        except (OSError, ValueError, KeyError, AssertionError) as e:
+            failures.append(f"Chrome-trace export invalid: {e!r}")
+
     summary = {
         "responses_by_code": by_code,
         "events": {
             k: sum(1 for e in events if e["kind"] == k)
             for k in EXPECTED_KINDS
         },
+        "spans": len(spans),
+        "request_span_trees": len(roots),
         "drain": drains[-1] if drains else None,
         "ok": not failures,
     }

@@ -1,0 +1,399 @@
+"""obs/trace tests: span nesting + cross-thread parenting, x-jg-trace
+header round-trip through a live server, bounded-buffer drop
+accounting, Chrome-trace-event export schema, the tail-attribution
+report under a chaos ``infer_slow`` stall (the critical path must be
+stall-dominated), run-scoped request ids, and the /metrics Prometheus
+content negotiation — the acceptance surface of the tracing layer
+(OBSERVABILITY.md "Tracing")."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.infer import export_packed
+from distributed_mnist_bnns_tpu.models import bnn_mlp_small
+from distributed_mnist_bnns_tpu.obs import (
+    EventLog,
+    Telemetry,
+    load_spans,
+    render_prometheus,
+)
+from distributed_mnist_bnns_tpu.obs.registry import MetricsRegistry
+from distributed_mnist_bnns_tpu.obs.trace import (
+    TRACE_HEADER,
+    RequestIdSource,
+    TraceContext,
+    Tracer,
+    format_header,
+    mint_context,
+    next_request_id,
+    parse_header,
+    tail_attribution,
+    to_chrome_trace,
+    unresolved_parents,
+)
+from distributed_mnist_bnns_tpu.resilience import reset_fire_counts
+from distributed_mnist_bnns_tpu.serve import (
+    PackedInferenceServer,
+    ServeConfig,
+)
+from distributed_mnist_bnns_tpu.serve import client as sc
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_ledger():
+    reset_fire_counts()
+    yield
+    reset_fire_counts()
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    model = bnn_mlp_small(backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=True,
+    )
+    path = tmp_path_factory.mktemp("trace_artifact") / "m.msgpack"
+    export_packed(model, variables, str(path))
+    return str(path)
+
+
+def _server(artifact, tmp_path, **kw):
+    kw.setdefault("port", 0)
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("default_deadline_ms", 5000.0)
+    kw.setdefault("telemetry_dir", str(tmp_path / "tel"))
+    kw.setdefault("interpret", True)
+    kw.setdefault("trace", True)
+    srv = PackedInferenceServer(ServeConfig(artifact=artifact, **kw))
+    host, port = srv.start()
+    return srv, f"http://{host}:{port}"
+
+
+def _spans(tmp_path):
+    return load_spans(str(tmp_path / "tel" / "events.jsonl"))
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 28, 28, 1).tolist()
+
+
+# -- tracer units (no jax, no HTTP) ------------------------------------------
+
+
+def test_span_nesting_and_cross_thread_parenting():
+    t = Tracer(sink=None)
+    with t.start("root", kind="request", fresh=True, id="r-0") as root:
+        with t.start("inner", kind="queue") as inner:
+            # thread-local current: inner parents to root automatically
+            assert inner.parent_id == root.span_id
+            assert inner.trace_id == root.trace_id
+        # cross-thread: an explicit parent handle carries the context
+        # to a worker thread (the serve engine's admission->worker hop)
+        done = threading.Event()
+
+        def worker():
+            sp = t.start("worker-side", kind="infer", parent=root)
+            sp.end("ok")
+            done.set()
+
+        threading.Thread(target=worker).start()
+        assert done.wait(5.0)
+    recs = t.drain()
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["inner"]["parent"] == by_name["root"]["span"]
+    assert by_name["worker-side"]["parent"] == by_name["root"]["span"]
+    assert by_name["worker-side"]["trace"] == by_name["root"]["trace"]
+    assert by_name["root"]["parent"] is None
+    # monotonic intervals, child inside parent
+    assert by_name["root"]["dur_ms"] >= by_name["inner"]["dur_ms"] >= 0
+    assert not unresolved_parents(recs)
+
+
+def test_span_end_is_claim_once():
+    t = Tracer(sink=None)
+    sp = t.start("raced", kind="request", fresh=True)
+    assert sp.end("deadline") is True
+    assert sp.end("ok") is False          # the late engine delivery loses
+    recs = t.drain()
+    assert len(recs) == 1 and recs[0]["status"] == "deadline"
+
+
+def test_buffer_overflow_drop_accounting():
+    reg = MetricsRegistry()
+    t = Tracer(sink=None, capacity=4, registry=reg)
+    for i in range(10):
+        t.record("s", kind="chaos", t0=float(i))
+    assert t.dropped == 6
+    assert len(t.drain()) == 4
+    ctr = reg.counter("trace_spans_dropped_total")
+    assert ctr.total() == 6
+    # drops are counted, never raised, and drain resets the buffer
+    t.record("s2", kind="chaos", t0=0.0)
+    assert len(t.drain()) == 1
+
+
+def test_disabled_tracer_is_inert():
+    t = Tracer(sink=None, enabled=False)
+    with t.start("x", kind="request") as sp:
+        assert sp.end() is False
+    assert t.record("y", kind="queue", t0=0.0) is None
+    assert t.drain() == [] and t.dropped == 0
+
+
+def test_header_contract_roundtrip_and_malformed():
+    ctx = mint_context()
+    assert parse_header(format_header(ctx)) == ctx
+    assert parse_header(None) is None
+    assert parse_header("") is None
+    assert parse_header("not-a-trace!") is None
+    assert parse_header("deadbeef") is None            # missing span half
+    assert parse_header("UPPER-CASE") is None
+    # ids propagate through TraceContext adoption
+    t = Tracer(sink=None)
+    sp = t.start("adopted", kind="request", ctx=ctx)
+    assert sp.trace_id == ctx.trace_id
+    assert sp.parent_id == ctx.span_id
+    sp.end()
+
+
+def test_request_id_source_is_run_scoped():
+    a, b = RequestIdSource(), RequestIdSource()
+    ids_a = [a.next() for _ in range(3)]
+    ids_b = [b.next() for _ in range(3)]
+    # monotonic within a source, nonce-disjoint across sources (two
+    # replicas / a restart can no longer mint colliding ids)
+    assert ids_a == [f"{a.nonce}-{i}" for i in range(3)]
+    assert set(ids_a).isdisjoint(ids_b)
+    assert next_request_id() != next_request_id()
+
+
+def test_event_log_sink_and_spans_flush_on_close(tmp_path):
+    tel = Telemetry(str(tmp_path), heartbeat=False, trace=True)
+    assert tel.tracer.enabled
+    with tel.tracer.start("a", kind="request", fresh=True):
+        pass
+    tel.close()
+    spans = load_spans(os.path.join(str(tmp_path), "events.jsonl"))
+    assert [s["name"] for s in spans] == ["a"]
+    assert spans[0]["kind"] == "span" and spans[0]["v"] == 1
+
+
+def test_telemetry_trace_disabled_by_default(tmp_path):
+    assert not Telemetry(str(tmp_path), heartbeat=False).tracer.enabled
+    assert not Telemetry(trace=True).tracer.enabled  # no sink, no files
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    t = Tracer(sink=log, flush_every=1)
+    with t.start("req", kind="request", fresh=True, id="n-1"):
+        with t.start("queue", kind="queue"):
+            pass
+    log.close()
+    spans = load_spans(str(tmp_path / "events.jsonl"))
+    chrome = to_chrome_trace(spans, pid=7, process_name="unit")
+    assert set(chrome) == {"traceEvents", "displayTimeUnit"}
+    events = chrome["traceEvents"]
+    assert len(events) == 3                 # M metadata + 2 X spans
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 2
+    for e in xs:
+        # the trace-event schema fields Perfetto requires of "X"
+        assert isinstance(e["name"], str)
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert e["pid"] == 7 and isinstance(e["tid"], int)
+        assert e["args"]["trace"] and e["args"]["span"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta[0]["args"]["name"] == "unit"
+    json.dumps(chrome)                      # must be pure-JSON types
+
+
+# -- end-to-end through the live server --------------------------------------
+
+
+def test_server_adopts_client_trace_and_echoes_header(artifact, tmp_path):
+    srv, base = _server(artifact, tmp_path)
+    try:
+        ctx = mint_context()
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"images": _imgs(1)}).encode(),
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: format_header(ctx)},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+            echoed = resp.headers.get(TRACE_HEADER)
+        # echoed header carries the ADOPTED trace id + the server span
+        parsed = parse_header(echoed)
+        assert parsed is not None and parsed.trace_id == ctx.trace_id
+        # an untraced-by-the-client request still gets a fresh trace
+        code, _ = sc.predict(base, _imgs(1))
+        assert code == 200
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+    spans = _spans(tmp_path)
+    adopted = [s for s in spans if s.get("trace") == ctx.trace_id]
+    assert adopted, "server did not adopt the client context"
+    root = [s for s in adopted if s["span_kind"] == "request"][0]
+    # the client's span is the server root's parent — the cross-process
+    # tree link the future router inherits
+    assert root["parent"] == ctx.span_id
+    kinds = {s["span_kind"] for s in adopted}
+    assert {"queue", "infer", "respond"} <= kinds
+    assert not unresolved_parents(spans)
+
+
+def test_traced_request_tree_complete_and_joined_by_id(artifact, tmp_path):
+    srv, base = _server(artifact, tmp_path)
+    try:
+        assert sc.predict(base, _imgs(2))[0] == 200
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+    events = list(
+        json.loads(line) for line in open(
+            tmp_path / "tel" / "events.jsonl"
+        )
+    )
+    req_ev = [e for e in events if e["kind"] == "request"][0]
+    spans = [e for e in events if e["kind"] == "span"]
+    roots = [s for s in spans if s["span_kind"] == "request"
+             and (s.get("attrs") or {}).get("id") == req_ev["id"]]
+    assert len(roots) == 1, "request event joins exactly one root span"
+    root = roots[0]
+    assert root["status"] == "ok"
+    children = [s for s in spans if s.get("parent") == root["span"]
+                and s["trace"] == root["trace"]]
+    kinds = {s["span_kind"] for s in children}
+    assert {"queue", "assemble", "infer", "respond"} <= kinds
+    # ids are the run-scoped nonce-counter strings, not bare ints
+    assert isinstance(req_ev["id"], str) and "-" in req_ev["id"]
+
+
+def test_tail_attribution_stall_dominates(artifact, tmp_path):
+    """The acceptance shape: under a chaos infer_slow stall, the slow
+    request's critical path — and therefore the tail report — must be
+    attributed to the stall span, not smeared into infer time."""
+    srv, base = _server(
+        artifact, tmp_path,
+        chaos="infer_slow@step=2,times=1,delay_s=0.35",
+        stall_timeout_s=10.0,
+    )
+    try:
+        assert sc.predict(base, _imgs(1))[0] == 200    # batch 1: fast
+        assert sc.predict(base, _imgs(1))[0] == 200    # batch 2: stalled
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+    spans = _spans(tmp_path)
+    report = tail_attribution(spans, pct=99.0)
+    assert report["n_requests"] == 2
+    assert report["dominant"] == "stall"
+    worst = report["tail"][0]
+    assert worst["dominant"] == "stall"
+    assert worst["breakdown_ms"]["stall"] == pytest.approx(350, rel=0.5)
+    # the chaos fire itself is span-visible, parented under the batch
+    chaos_spans = [s for s in spans if s["span_kind"] == "chaos"]
+    stall_spans = [s for s in spans if s["span_kind"] == "stall"]
+    assert chaos_spans and stall_spans
+    batch = [s for s in spans if s["span_kind"] == "batch"]
+    batch_ids = {(s["trace"], s["span"]) for s in batch}
+    assert any(
+        (s["trace"], s.get("parent")) in batch_ids for s in stall_spans
+    ), "chaos stall span must parent under the serving batch span"
+
+
+def test_shed_is_span_visible(artifact, tmp_path):
+    srv, base = _server(artifact, tmp_path)
+    try:
+        srv.engine.begin_drain()
+        assert sc.predict(base, _imgs(1))[0] == 503
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+    sheds = [s for s in _spans(tmp_path)
+             if s["span_kind"] == "request" and s["status"] == "shed"]
+    assert sheds and (sheds[0].get("attrs") or {})["reason"] == "draining"
+
+
+# -- /metrics content negotiation (satellite) --------------------------------
+
+
+def test_render_prometheus_exposition():
+    reg = MetricsRegistry()
+    reg.counter("reqs_total", "requests").inc(3, status="ok")
+    reg.gauge("depth", "queue depth").set(2.5)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = render_prometheus(reg.snapshot())
+    assert "# TYPE reqs_total counter" in text
+    assert 'reqs_total{status="ok"} 3' in text
+    assert "# TYPE depth gauge" in text and "depth 2.5" in text
+    assert "# TYPE lat_seconds histogram" in text
+    # cumulative le buckets, +Inf == count
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1.0"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+    # label escaping never produces an unparsable line
+    reg.counter("weird_total").inc(label='va"l\nue')
+    assert '\\"' in render_prometheus(reg.snapshot())
+
+
+def test_metrics_content_negotiation(artifact, tmp_path):
+    srv, base = _server(artifact, tmp_path)
+    try:
+        assert sc.predict(base, _imgs(1))[0] == 200
+        # default: JSON (the repo's own tooling)
+        code, body = sc.metrics(base)
+        assert code == 200
+        assert json.loads(body)["serve_requests_total"]["series"]
+        # Accept: text/plain -> Prometheus exposition
+        req = urllib.request.Request(
+            base + "/metrics", headers={"Accept": "text/plain"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "# TYPE serve_requests_total counter" in text
+        assert 'serve_requests_total{status="ok"}' in text
+    finally:
+        srv.request_stop("test over")
+        srv.drain_and_stop()
+
+
+# -- trainer spans -----------------------------------------------------------
+
+
+def test_trainer_step_and_checkpoint_spans(tmp_path):
+    from distributed_mnist_bnns_tpu.data import load_mnist
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    data = load_mnist("/nonexistent", synthetic_sizes=(256, 64))
+    cfg = TrainConfig(
+        model="bnn-mlp-small", epochs=1, batch_size=16,
+        telemetry_dir=str(tmp_path / "tel"), trace=True,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    Trainer(cfg, input_shape=data.input_shape).fit(data)
+    spans = load_spans(str(tmp_path / "tel" / "events.jsonl"))
+    kinds = {s["span_kind"] for s in spans}
+    assert "step" in kinds and "checkpoint" in kinds
+    steps = [s for s in spans if s["span_kind"] == "step"]
+    assert all(s["dur_ms"] >= 0 for s in steps)
+    assert {"step", "n_steps", "epoch"} <= set(steps[0]["attrs"])
