@@ -1146,13 +1146,67 @@ def _bench_lm_serve(args, deadline):
         out["blocks"] = blocks
         return out
 
-    variants = {"packed_1bit": frozen, "dense_fp32": densify(frozen)}
     n_new = max(8, min(64, ctx // 4))
     out = {
         "ctx": ctx, "embed_dim": args.lm_embed_dim,
         "depth": args.lm_depth, "n_new_tokens_per_stream": n_new,
         "interpret_mode": interp,
     }
+
+    def run_streams(fz, streams, spec_k=0):
+        """One engine at `streams` concurrent staggered requests;
+        returns the throughput/latency row (+ spec acceptance)."""
+        reg = MetricsRegistry()
+        tel = Telemetry(None, registry=reg)
+        dec = make_paged_lm_decoder(
+            fz, slots=streams, page_size=16,
+            prefill_chunk=16, interpret=interp, spec_k=spec_k,
+        )
+        eng = LMEngine(dec, queue_depth=streams * 2,
+                       telemetry=tel).start()
+        try:
+            rng = np.random.RandomState(streams)
+            prompts = [
+                rng.randint(0, 256, size=8 + 4 * i).astype(np.int32)
+                for i in range(streams)       # staggered lengths
+            ]
+            t0 = time.perf_counter()
+            reqs = [
+                eng.submit(p, n_new, time.monotonic() + 600)
+                for p in prompts
+            ]
+            done = 0
+            for r in reqs:
+                while True:
+                    ev = r.events.get(timeout=600)
+                    if ev["kind"] == "done":
+                        assert ev["status"] == "ok", ev
+                        done += ev["n"]
+                        break
+            wall = time.perf_counter() - t0
+            hist = reg.histogram(DECODE_ITERATION_SECONDS)
+            p50 = hist.percentile(50)
+            p99 = hist.percentile(99)
+            row = {
+                "tokens_per_sec": round(done / wall, 1),
+                "p50_intertoken_ms": (
+                    round(p50 * 1e3, 3) if p50 is not None else None
+                ),
+                "p99_intertoken_ms": (
+                    round(p99 * 1e3, 3) if p99 is not None else None
+                ),
+                "recompiles_post_warmup": eng.recompiles_post_warmup,
+            }
+            if spec_k:
+                rate = eng.spec_acceptance_rate
+                row["acceptance_rate"] = (
+                    round(rate, 4) if rate is not None else None
+                )
+            return row
+        finally:
+            eng.stop()
+
+    variants = {"packed_1bit": frozen, "dense_fp32": densify(frozen)}
     for vname, fz in variants.items():
         if time.monotonic() > deadline - 30:
             out[vname] = "skipped (bench deadline)"
@@ -1161,49 +1215,7 @@ def _bench_lm_serve(args, deadline):
         for streams in (1, 4, 8):
             if time.monotonic() > deadline:
                 break
-            reg = MetricsRegistry()
-            tel = Telemetry(None, registry=reg)
-            dec = make_paged_lm_decoder(
-                fz, slots=streams, page_size=16,
-                prefill_chunk=16, interpret=interp,
-            )
-            eng = LMEngine(dec, queue_depth=streams * 2,
-                           telemetry=tel).start()
-            try:
-                rng = np.random.RandomState(streams)
-                prompts = [
-                    rng.randint(0, 256, size=8 + 4 * i).astype(np.int32)
-                    for i in range(streams)   # staggered lengths
-                ]
-                t0 = time.perf_counter()
-                reqs = [
-                    eng.submit(p, n_new, time.monotonic() + 600)
-                    for p in prompts
-                ]
-                done = 0
-                for r in reqs:
-                    while True:
-                        ev = r.events.get(timeout=600)
-                        if ev["kind"] == "done":
-                            assert ev["status"] == "ok", ev
-                            done += ev["n"]
-                            break
-                wall = time.perf_counter() - t0
-                hist = reg.histogram(DECODE_ITERATION_SECONDS)
-                p50 = hist.percentile(50)
-                p99 = hist.percentile(99)
-                rows[f"streams_{streams}"] = {
-                    "tokens_per_sec": round(done / wall, 1),
-                    "p50_intertoken_ms": (
-                        round(p50 * 1e3, 3) if p50 is not None else None
-                    ),
-                    "p99_intertoken_ms": (
-                        round(p99 * 1e3, 3) if p99 is not None else None
-                    ),
-                    "recompiles_post_warmup": eng.recompiles_post_warmup,
-                }
-            finally:
-                eng.stop()
+            rows[f"streams_{streams}"] = run_streams(fz, streams)
         out[vname] = rows
     pk, dn = out.get("packed_1bit"), out.get("dense_fp32")
     if (
@@ -1214,6 +1226,108 @@ def _bench_lm_serve(args, deadline):
             pk["streams_8"]["tokens_per_sec"]
             / dn["streams_8"]["tokens_per_sec"], 2,
         )
+
+    # -- self-speculative decoding (SERVING.md "Speculative decoding"):
+    # spec-on (packed 1-bit draft + fixed-K bf16 verify) vs the
+    # verifier alone (spec_k=1: one bf16 verify dispatch per token —
+    # the engine whose OUTPUT spec mode reproduces token-identically)
+    # and vs the plain packed engine above.
+    spec_k = 4
+    try:
+        if time.monotonic() < deadline - 30:
+            spec = {"spec_k": spec_k}
+            for streams in (1, 4):
+                if time.monotonic() > deadline:
+                    break
+                spec[f"streams_{streams}"] = run_streams(
+                    frozen, streams, spec_k=spec_k
+                )
+            s1 = spec.get("streams_1", {})
+            if "acceptance_rate" in s1:
+                spec["acceptance_rate"] = s1["acceptance_rate"]
+            out["spec"] = spec
+            # The reference run costs a whole extra engine build +
+            # stream: honour the bench deadline like every section.
+            if time.monotonic() < deadline - 30:
+                out["verifier_alone"] = {
+                    "streams_1": run_streams(frozen, 1, spec_k=1),
+                }
+                v1 = out["verifier_alone"]["streams_1"]["tokens_per_sec"]
+                if s1.get("tokens_per_sec") and v1:
+                    out["spec_speedup_vs_verifier_1stream"] = round(
+                        s1["tokens_per_sec"] / v1, 2,
+                    )
+                p1 = (pk or {}).get("streams_1", {}).get(
+                    "tokens_per_sec"
+                )
+                if s1.get("tokens_per_sec") and p1:
+                    out["spec_speedup_vs_packed_1stream"] = round(
+                        s1["tokens_per_sec"] / p1, 2,
+                    )
+            else:
+                out["verifier_alone"] = "skipped (bench deadline)"
+        else:
+            out["spec"] = "skipped (bench deadline)"
+    except Exception as e:
+        out["spec"] = f"failed: {e!r:.300}"
+
+    # -- prefix caching (SERVING.md "Prefix caching"): identical-prompt
+    # admissions through one engine — the second is a radix hit whose
+    # prefill covers only the uncached suffix. The measured claim:
+    # prefill time drops on shared-prefix admission.
+    try:
+        if time.monotonic() < deadline - 30:
+            import tempfile
+
+            from distributed_mnist_bnns_tpu.obs import load_events
+
+            tdir = tempfile.mkdtemp(prefix="bench_lm_prefix_")
+            tel = Telemetry(tdir)
+            dec = make_paged_lm_decoder(
+                frozen, slots=1, page_size=16,
+                prefill_chunk=16, interpret=interp,
+            )
+            eng = LMEngine(dec, queue_depth=4, telemetry=tel,
+                           prefix_cache=True).start()
+            try:
+                plen = max(32, min(ctx - n_new - 1, ctx // 2))
+                prompt = np.random.RandomState(7).randint(
+                    0, 256, size=plen
+                ).astype(np.int32)
+                for _ in range(2):        # cold admit, then the hit
+                    r = eng.submit(
+                        prompt, 8, time.monotonic() + 600
+                    )
+                    while r.events.get(timeout=600)["kind"] != "done":
+                        pass
+                stats = eng.prefix_cache_stats()
+            finally:
+                eng.stop()
+                tel.close()
+            admits = [
+                e for e in load_events(os.path.join(
+                    tdir, "events.jsonl"
+                )) if e["kind"] == "lm_admit"
+            ]
+            cold, hit = admits[0], admits[1]
+            out["prefix"] = {
+                "prompt_tokens": int(plen),
+                "cached_tokens": hit["cached_tokens"],
+                "cold_prefill_ms": cold["prefill_ms"],
+                "hit_prefill_ms": hit["prefill_ms"],
+                "cold_prefill_tokens": cold["prefill_tokens"],
+                "hit_prefill_tokens": hit["prefill_tokens"],
+                "prefill_ms_saved_ratio": round(
+                    1.0 - hit["prefill_ms"] / max(
+                        cold["prefill_ms"], 1e-9
+                    ), 4,
+                ),
+                "cache_entries": stats["entries"],
+            }
+        else:
+            out["prefix"] = "skipped (bench deadline)"
+    except Exception as e:
+        out["prefix"] = f"failed: {e!r:.300}"
     return out
 
 

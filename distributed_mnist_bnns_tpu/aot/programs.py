@@ -1,12 +1,17 @@
 """The known AOT program signatures and their store loaders.
 
-Four programs cover every hot entry point's first dispatch (PERF.md
+Five programs cover every hot entry point's first dispatch (PERF.md
 "Cold start"):
 
   * ``classifier_predict`` — the packed classifier at the server's ONE
     compiled micro-batch shape (serve/core.py's whole contract);
   * ``lm_prefill`` / ``lm_decode`` — the continuous-batching engine's
     exactly-two programs (infer_transformer.make_paged_lm_decoder);
+  * ``lm_verify`` — the engine's THIRD program when speculative
+    decoding is armed (``spec_k > 0``): the fixed-K dense-bf16 verify
+    dispatch. An ``--aot --spec-decode K`` boot extends the LM pair's
+    all-or-nothing discipline to the triple — any absent member is a
+    miss for all of them;
   * ``train_step`` — the single-device jitted train step (the mesh
     dispatches re-lower per topology and stay on the online path).
 
@@ -70,6 +75,11 @@ _REV_MODULES: Dict[str, Tuple[str, ...]] = {
         f"{_PKG}.ops.xnor_gemm",
     ),
     "lm_decode": (
+        f"{_PKG}.infer_transformer", f"{_PKG}.ops.paged_kv",
+        f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
+        f"{_PKG}.ops.xnor_gemm",
+    ),
+    "lm_verify": (
         f"{_PKG}.infer_transformer", f"{_PKG}.ops.paged_kv",
         f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
         f"{_PKG}.ops.xnor_gemm",
@@ -206,6 +216,7 @@ def load_packed_aot(
 def _lm_geometry(
     frozen: Dict[str, Any], *, slots: int, page_size: int,
     num_pages: Optional[int], prefill_chunk: int, max_len: Optional[int],
+    spec_k: int = 0,
 ) -> Dict[str, int]:
     """Host-side mirror of ``make_paged_lm_decoder``'s geometry math
     (validated against the real decoder on every miss, so drift cannot
@@ -237,18 +248,22 @@ def _lm_geometry(
     max_pages = pages_needed(max_len, page_size)
     if num_pages is None:
         num_pages = slots * max_pages + 1
+    spec_k = int(spec_k)
+    if spec_k < 0:
+        raise ValueError(f"spec_k must be >= 0, got {spec_k}")
     return {
         "slots": slots, "page_size": page_size,
         "num_pages": int(num_pages), "max_pages": max_pages,
         "max_len": max_len, "prefill_chunk": prefill_chunk,
         "vocab": vocab, "num_blocks": n_blocks,
         "num_heads": num_heads, "head_dim": embed_dim // num_heads,
+        "spec_k": spec_k,
     }
 
 
 def _lm_avals(geom: Dict[str, int]):
-    """(pools, prefill-args, decode-args) ShapeDtypeStruct trees for
-    the two programs' fixed signatures."""
+    """(pools, prefill-args, decode-args, verify-args-or-None)
+    ShapeDtypeStruct trees for the programs' fixed signatures."""
     import jax
     import jax.numpy as jnp
 
@@ -264,38 +279,57 @@ def _lm_avals(geom: Dict[str, int]):
     decode = (pools, s((geom["slots"],), i32),
               s((geom["slots"], geom["max_pages"]), i32),
               s((geom["slots"],), i32))
-    return pools, prefill, decode
+    verify = None
+    if geom.get("spec_k"):
+        verify = (pools, s((geom["slots"], geom["spec_k"]), i32),
+                  s((geom["slots"], geom["max_pages"]), i32),
+                  s((geom["slots"],), i32))
+    return pools, prefill, decode, verify
 
 
 def lm_decoder_keys(
     artifact_digest: str, geom: Dict[str, int], *, interpret: bool,
-) -> Tuple[AotKey, AotKey]:
-    _, prefill_avals, decode_avals = _lm_avals(geom)
-    extra = {**geom, "interpret": bool(interpret),
-             "donate": aot_donate()}
+) -> Tuple[AotKey, AotKey, Optional[AotKey]]:
+    """(prefill, decode, verify-or-None) keys. ``spec_k`` shapes ONLY
+    the verify key: the prefill/decode programs are identical with
+    spec decode on or off, so the pair banked by a plain boot serves a
+    spec-armed boot too — which still misses as a set until
+    ``lm_verify`` is banked (the all-or-nothing discipline)."""
+    _, prefill_avals, decode_avals, verify_avals = _lm_avals(geom)
+    extra = {k: v for k, v in geom.items() if k != "spec_k"}
+    extra.update(interpret=bool(interpret), donate=aot_donate())
+    key_v = None
+    if verify_avals is not None:
+        key_v = make_key(
+            "lm_verify", avals=format_avals(verify_avals),
+            consts=artifact_digest,
+            extra={**extra, "spec_k": geom["spec_k"]},
+        )
     return (
         make_key("lm_prefill", avals=format_avals(prefill_avals),
                  consts=artifact_digest, extra=extra),
         make_key("lm_decode", avals=format_avals(decode_avals),
                  consts=artifact_digest, extra=extra),
+        key_v,
     )
 
 
 def load_paged_lm_decoder_aot(
     path: str, *, slots: int, page_size: int = 16,
     num_pages: Optional[int] = None, prefill_chunk: int = 16,
-    max_len: Optional[int] = None, interpret: bool = False,
-    store: AotStore,
+    max_len: Optional[int] = None, spec_k: int = 0,
+    interpret: bool = False, store: AotStore,
 ):
     """AOT-aware ``make_paged_lm_decoder`` from an artifact file.
 
-    Returns ``(PagedLMDecoder, info, aot_meta)``. Hit (BOTH programs
-    present): the decoder's ``prefill``/``decode`` are deserialized
+    Returns ``(PagedLMDecoder, info, aot_meta)``. Hit (EVERY program
+    present — the prefill/decode pair, plus ``lm_verify`` when
+    ``spec_k > 0``): the decoder's programs are deserialized
     executables and ``init_pools`` builds the KV pools via
     ``device_put`` of host zeros — the whole load performs **zero**
     XLA compiles, which is what lets the engine's recompile fence pin
     its budget-0 baseline at BOOT instead of post-warmup. Miss: the
-    real decoder is built, both programs are explicitly lowered +
+    real decoder is built, every program is explicitly lowered +
     compiled (donation preserved), banked, and returned as
     ``Compiled``s.
     """
@@ -307,17 +341,25 @@ def load_paged_lm_decoder_aot(
     info = dict(frozen.get("info", {}))
     geom = _lm_geometry(
         frozen, slots=slots, page_size=page_size, num_pages=num_pages,
-        prefill_chunk=prefill_chunk, max_len=max_len,
+        prefill_chunk=prefill_chunk, max_len=max_len, spec_k=spec_k,
     )
-    key_p, key_d = lm_decoder_keys(digest, geom, interpret=interpret)
+    key_p, key_d, key_v = lm_decoder_keys(
+        digest, geom, interpret=interpret
+    )
+    keys = [key_p, key_d] + ([key_v] if key_v is not None else [])
     # All-or-nothing: only touch get() (which emits hit/miss events and
-    # counters) when BOTH programs are present — a half-present pair is
-    # a miss for the pair, and must not record an aot_hit for a program
-    # this boot then compiles anyway.
-    loaded_p = loaded_d = None
-    if store.contains(key_p) and store.contains(key_d):
-        loaded_p = store.get(key_p)
-        loaded_d = store.get(key_d) if loaded_p is not None else None
+    # counters) when EVERY program is present — a partially-present set
+    # is a miss for the whole set, and must not record an aot_hit for a
+    # program this boot then compiles anyway. With spec decode armed
+    # the pair-miss discipline extends to the triple.
+    loaded: list = []
+    if all(store.contains(k) for k in keys):
+        for k in keys:
+            exe = store.get(k)
+            if exe is None:
+                loaded = []
+                break
+            loaded.append(exe)
 
     pool_shape = (geom["num_pages"], geom["page_size"],
                   geom["num_heads"], geom["head_dim"])
@@ -332,53 +374,62 @@ def load_paged_lm_decoder_aot(
             for _ in range(geom["num_blocks"])
         )
 
-    if loaded_p is not None and loaded_d is not None:
+    if len(loaded) == len(keys):
         decoder = PagedLMDecoder(
             init_pools=init_pools_host,
-            prefill=loaded_p,
-            decode=loaded_d,
+            prefill=loaded[0],
+            decode=loaded[1],
             slots=geom["slots"], page_size=geom["page_size"],
             num_pages=geom["num_pages"], max_pages=geom["max_pages"],
             max_len=geom["max_len"], prefill_chunk=geom["prefill_chunk"],
             vocab=geom["vocab"], num_blocks=geom["num_blocks"],
+            verify=loaded[2] if key_v is not None else None,
+            spec_k=geom["spec_k"],
         )
         return decoder, info, {
             "status": "hit",
-            "digests": [key_p.digest, key_d.digest],
+            "digests": [k.digest for k in keys],
         }
 
-    # miss (or half an entry): build the real decoder, compile + bank
+    # miss (or a partial set): build the real decoder, compile + bank
     from ..infer_transformer import make_paged_lm_decoder
 
     dec = make_paged_lm_decoder(
         frozen, slots=slots, page_size=page_size, num_pages=num_pages,
-        prefill_chunk=prefill_chunk, max_len=max_len,
+        prefill_chunk=prefill_chunk, max_len=max_len, spec_k=spec_k,
         interpret=interpret,
         donate=aot_donate(),   # see module docstring: donation +
                                # deserialize double-frees on 0.4.37
     )
     derived = (geom["slots"], geom["page_size"], geom["num_pages"],
                geom["max_pages"], geom["max_len"],
-               geom["prefill_chunk"], geom["vocab"], geom["num_blocks"])
+               geom["prefill_chunk"], geom["vocab"],
+               geom["num_blocks"], geom["spec_k"])
     actual = (dec.slots, dec.page_size, dec.num_pages, dec.max_pages,
-              dec.max_len, dec.prefill_chunk, dec.vocab, dec.num_blocks)
+              dec.max_len, dec.prefill_chunk, dec.vocab,
+              dec.num_blocks, dec.spec_k)
     if derived != actual:
         raise RuntimeError(
             f"aot LM geometry drifted from make_paged_lm_decoder: "
             f"derived {derived} != actual {actual} — fix "
             f"aot/programs._lm_geometry"
         )
-    _, prefill_avals, decode_avals = _lm_avals(geom)
+    _, prefill_avals, decode_avals, verify_avals = _lm_avals(geom)
     comp_p = dec.prefill.lower(*prefill_avals).compile()
     comp_d = dec.decode.lower(*decode_avals).compile()
     meta = {"artifact": path, **geom}
     store.put(key_p, comp_p, meta=meta)
     store.put(key_d, comp_d, meta=meta)
+    comp_v = None
+    if key_v is not None:
+        comp_v = dec.verify.lower(*verify_avals).compile()
+        store.put(key_v, comp_v, meta=meta)
     decoder = dec._replace(
-        init_pools=init_pools_host, prefill=comp_p, decode=comp_d
+        init_pools=init_pools_host, prefill=comp_p, decode=comp_d,
+        verify=comp_v,
     )
     return decoder, info, {
-        "status": "miss", "digests": [key_p.digest, key_d.digest],
+        "status": "miss", "digests": [k.digest for k in keys],
     }
 
 

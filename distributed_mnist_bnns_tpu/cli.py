@@ -288,6 +288,23 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--max-prompt-tokens", type=int, default=None,
                     help="--lm: reject longer prompts with 413 "
                          "(default: max_len - 1)")
+    sv.add_argument("--prefix-cache", action="store_true",
+                    help="--lm: copy-on-write prompt-prefix sharing "
+                         "over the paged KV pool — requests sharing a "
+                         "prompt prefix (system prompts) prefill it "
+                         "once; full pages fork refcounted into new "
+                         "sequences and publish back to a radix index "
+                         "at eviction, LRU-evicted under pool pressure "
+                         "(SERVING.md 'Prefix caching')")
+    sv.add_argument("--spec-decode", type=int, default=0, metavar="K",
+                    help="--lm: self-speculative decoding — per round, "
+                         "K-1 tokens drafted through the packed 1-bit "
+                         "decode program and the whole K-token window "
+                         "verified in ONE dense-bf16 dispatch; greedy "
+                         "output is token-identical to the verifier "
+                         "alone, accept/reject is host-side so the "
+                         "compiled signatures stay fixed (SERVING.md "
+                         "'Speculative decoding'). 0 = off")
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument("--port", type=int, default=8000,
                     help="0 = pick an ephemeral port (logged)")
@@ -504,6 +521,11 @@ def build_parser() -> argparse.ArgumentParser:
     ab.add_argument("--num-pages", type=int, default=None)
     ab.add_argument("--prefill-chunk", type=int, default=16)
     ab.add_argument("--max-len", type=int, default=None)
+    ab.add_argument("--spec-decode", type=int, default=0, metavar="K",
+                    help="also bank the fixed-K bf16 verify program so "
+                         "`serve --lm --aot --spec-decode K` boots "
+                         "zero-compile (the prefill/decode pair-miss "
+                         "discipline extends to the triple)")
     ab.add_argument("--interpret", action=argparse.BooleanOptionalAction,
                     default=None,
                     help="packed-kernel interpreter mode; must match "
@@ -694,6 +716,7 @@ def _cmd_aot(args) -> int:
             num_pages=args.num_pages,
             prefill_chunk=args.prefill_chunk,
             max_len=args.max_len,
+            spec_k=args.spec_decode,
             interpret=interpret,
             store=store,
         )
@@ -1060,6 +1083,8 @@ def main(argv=None) -> int:
                 aot=args.aot,
                 aot_dir=args.aot_dir,
                 trace=args.trace,
+                prefix_cache=args.prefix_cache,
+                spec_decode=args.spec_decode,
             ))
             return lm_server.run()
 

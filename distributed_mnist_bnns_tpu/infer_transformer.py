@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, Callable, Dict, NamedTuple, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -99,6 +99,49 @@ def _packed_dense_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
             bits.reshape(-1, k), wp, k, n, interpret=interpret
         )
         y = y.reshape(*lead, n)
+        if alpha is not None:
+            y = y * alpha
+        return y + bias
+
+    return fn
+
+
+def _dense_bf16_fn(layer: Dict[str, Any]) -> Callable:
+    """The SAME weights as :func:`_packed_dense_fn`, carried dense:
+    bitplanes unpacked to a ±1 bf16 kernel, GEMM accumulated in fp32.
+
+    This is the speculative-decode **verifier** format (PERF.md §3's
+    crossover: packed bitplanes win the bandwidth-bound small-M decode
+    regime, dense bf16 wins the large-M batched regime the fixed-K
+    verify dispatch lives in). ±1 is exact in bf16 and the fp32
+    accumulation of ±1 products is exact for any summation order, so
+    the projection output is numerically IDENTICAL to the packed
+    kernel's — draft and verifier disagree only through reduction-order
+    ulps in LN/attention, which is what keeps greedy draft acceptance
+    near 1. Carried-fp32 layers (partial binarization) stay fp32 — they
+    have no packed twin to be exact against."""
+    if "wp" not in layer:
+        kernel = jnp.asarray(layer["kernel"], jnp.float32)
+        bias = jnp.asarray(layer["bias"], jnp.float32)
+        return lambda x: jnp.dot(x, kernel) + bias
+    from .ops.bitpack import unpack_bits
+
+    k, n = int(layer["k"]), int(layer["n"])
+    w = unpack_bits(jnp.asarray(layer["wp"]).T, k)[:n].T   # (k, n) ±1
+    w_bf16 = w.astype(jnp.bfloat16)
+    bias = jnp.asarray(layer["bias"], jnp.float32)
+    alpha = (
+        jnp.asarray(layer["alpha"], jnp.float32)
+        if layer.get("alpha") is not None else None
+    )
+
+    def fn(x: jnp.ndarray) -> jnp.ndarray:
+        bits = binarize_ste(x).astype(jnp.bfloat16)
+        lead = bits.shape[:-1]
+        y = jnp.dot(
+            bits.reshape(-1, k), w_bf16,
+            preferred_element_type=jnp.float32,
+        ).reshape(*lead, n)
         if alpha is not None:
             y = y * alpha
         return y + bias
@@ -738,10 +781,11 @@ def generate(
 
 
 class PagedLMDecoder(NamedTuple):
-    """The compiled pair behind the continuous-batching engine
-    (SERVING.md "Continuous LM serving") plus its fixed geometry.
+    """The compiled programs behind the continuous-batching engine
+    (SERVING.md "Continuous LM serving") plus their fixed geometry.
 
-    Exactly TWO programs exist after warmup, and every dynamic quantity
+    Exactly TWO programs exist after warmup — THREE when speculative
+    decoding is armed (``spec_k > 0``) — and every dynamic quantity
     (tokens, page tables, positions, chunk start/length) is an array
     argument, so the engine admits/evicts sequences at any iteration
     with zero recompiles:
@@ -755,8 +799,20 @@ class PagedLMDecoder(NamedTuple):
         (S,))`` -> ``(pools, log_probs (S, vocab))`` — one iteration
         for all S batch slots at once; inactive slots carry all-null
         tables and are garbage-out/ignored.
+      * ``verify(pools, tokens (S, K), page_tables (S, P), positions
+        (S,))`` -> ``(pools, log_probs (S, K, vocab))`` — the
+        speculative-decode scorer: K consecutive input tokens per slot
+        starting at each slot's base position, K/V written (overwriting
+        the draft's packed-weight writes with the verifier's canonical
+        values) and causal log-probs returned for every position, in
+        ONE large-M dispatch on the **dense bf16** carry of the same
+        weights (PERF.md §3 crossover — see :func:`_dense_bf16_fn`).
+        ``K = spec_k`` is fixed at build time: the compiled signature
+        never depends on how many drafts a round accepts (accept/
+        reject is host-side), which is what keeps the budget-0
+        recompile fence green with spec decode armed.
 
-    Both are jitted with the pools donated (``donate``): the KV pool is
+    All are jitted with the pools donated (``donate``): the KV pool is
     the engine's dominant buffer and must be updated in place, not
     copied per token. Callers therefore must NOT reuse a pools value
     after passing it in — hold only the returned pools.
@@ -773,6 +829,8 @@ class PagedLMDecoder(NamedTuple):
     prefill_chunk: int
     vocab: int
     num_blocks: int
+    verify: Optional[Callable] = None   # spec-decode scorer (or None)
+    spec_k: int = 0         # verify window width (0 = spec decode off)
 
 
 def make_paged_lm_decoder(
@@ -784,13 +842,22 @@ def make_paged_lm_decoder(
     max_len: int | None = None,
     interpret: bool = False,
     donate: bool = True,
+    spec_k: int = 0,
 ) -> PagedLMDecoder:
     """Build the paged prefill/decode pair from a ``kind == "lm"``
     artifact (see :class:`PagedLMDecoder`). ``num_pages`` defaults to
     enough for every slot to reach ``max_len`` simultaneously, plus the
     reserved null page — callers running oversubscribed (more admitted
     work than worst-case pages) size it down and rely on the engine's
-    admission control."""
+    admission control.
+
+    ``spec_k > 0`` additionally compiles the fixed-K ``verify``
+    program (self-speculative decoding, SERVING.md): the engine drafts
+    ``spec_k - 1`` tokens through the packed ``decode`` program and
+    scores the whole window — the pending token plus the drafts — in
+    one dense-bf16 dispatch. ``spec_k == 1`` degenerates to a
+    one-token-per-round bf16 verifier with no drafts (the
+    "verifier-alone" reference engine of the equivalence suite)."""
     from .ops import paged_kv
 
     if frozen.get("kind") != "lm":
@@ -825,6 +892,9 @@ def make_paged_lm_decoder(
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}"
         )
+    spec_k = int(spec_k)
+    if spec_k < 0:
+        raise ValueError(f"spec_k must be >= 0, got {spec_k}")
     max_pages = paged_kv.pages_needed(max_len, page_size)
     if num_pages is None:
         num_pages = slots * max_pages + 1        # +1: the null page
@@ -889,6 +959,59 @@ def make_paged_lm_decoder(
         return tuple(new), _head(x)
 
     donate_kw = {"donate_argnums": (0,)} if donate else {}
+
+    verify_fn = None
+    if spec_k:
+        # The verifier carry of the SAME weights: dense ±1 bf16 kernels
+        # (exact-equal GEMM math to the packed path, _dense_bf16_fn) —
+        # the large-M format for the one batched dispatch that scores
+        # the whole K-token window.
+        vlayers = [
+            {
+                "ln_attn": _ln_fn(blk["ln_attn"]),
+                "ln_mlp": _ln_fn(blk["ln_mlp"]),
+                "q": _dense_bf16_fn(blk["q"]),
+                "k": _dense_bf16_fn(blk["k"]),
+                "v": _dense_bf16_fn(blk["v"]),
+                "out": _dense_bf16_fn(blk["out"]),
+                "mlp1": _dense_bf16_fn(blk["mlp1"]),
+                "mlp2": _dense_bf16_fn(blk["mlp2"]),
+            }
+            for blk in frozen["blocks"]
+        ]
+
+        def _verify(pools, tokens, page_tables, positions):
+            s, k = tokens.shape
+            qpos = positions[:, None] + jnp.arange(k)[None, :]  # (S, K)
+            x = tok[tokens] + pos_embed[0][jnp.clip(qpos, 0, pos_len - 1)]
+            tables_k = jnp.broadcast_to(
+                page_tables[:, None, :],
+                (s, k, page_tables.shape[-1]),
+            )
+            idx = paged_kv.flat_write_indices(tables_k, qpos, page_size)
+            new = []
+            for lay, (kp, vp) in zip(vlayers, pools):
+                y = lay["ln_attn"](x)
+                q = lay["q"](y).reshape(s, k, num_heads, head_dim)
+                kk = lay["k"](y).reshape(s, k, num_heads, head_dim)
+                v = lay["v"](y).reshape(s, k, num_heads, head_dim)
+                # Overwrites the draft's packed-weight K/V for the
+                # window with the verifier's canonical values — the
+                # accepted prefix's cache state is the verifier's, so
+                # later rounds (and published prefix pages) attend to
+                # verifier-grade history.
+                kp = paged_kv.write_kv(kp, idx, kk)
+                vp = paged_kv.write_kv(vp, idx, v)
+                core = paged_kv.paged_verify_attention(
+                    q, kp, vp, page_tables, positions
+                )
+                x = x + lay["out"](core.reshape(s, k, embed_dim))
+                x = _mlp(lay, x)
+                new.append((kp, vp))
+            return tuple(new), _head(x)
+
+        verify_fn = jax.jit(_verify, **donate_kw)
+
     return PagedLMDecoder(
         init_pools=init_pools,
         prefill=jax.jit(_prefill, **donate_kw),
@@ -901,4 +1024,6 @@ def make_paged_lm_decoder(
         prefill_chunk=prefill_chunk,
         vocab=int(tok.shape[0]),
         num_blocks=n_blocks,
+        verify=verify_fn,
+        spec_k=spec_k,
     )

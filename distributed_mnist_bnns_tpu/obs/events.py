@@ -51,6 +51,11 @@ Event kinds (schema v1):
   lm_decode      periodic decode-iteration snapshot (active streams,
                  iteration latency, page occupancy, recompile count)
   lm_decode_error a decode dispatch failed and was retried (serve/lm/)
+  lm_prefix_hit  admission found a cached prompt prefix: forked its
+                 pages COW and prefilled only the suffix (serve/lm/,
+                 SERVING.md "Prefix caching")
+  lm_spec_round  periodic speculative-decode round snapshot (spec_k,
+                 drafts accepted/rejected, cumulative acceptance rate)
   aot_hit        a boot installed a stored AOT executable — no trace,
                  no compile (aot/, PERF.md "Cold start")
   aot_miss       the AOT store had no entry; normal compile + re-bank
@@ -161,7 +166,8 @@ class EventLog:
     loses at most the last few high-rate lines, never the milestone
     records."""
 
-    BUFFERED_KINDS = ("step", "request", "lm_admit", "lm_evict", "span")
+    BUFFERED_KINDS = ("step", "request", "lm_admit", "lm_evict",
+                      "lm_prefix_hit", "span")
 
     def __init__(
         self, path: str, *, primary_only: bool = True,

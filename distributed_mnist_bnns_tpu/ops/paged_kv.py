@@ -52,12 +52,23 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
 
 
 class PageAllocator:
-    """Free-list allocator over ``num_pages`` pages; page 0 reserved.
+    """Refcounted free-list allocator over ``num_pages`` pages; page 0
+    reserved.
 
     ``alloc`` is all-or-nothing: a request that cannot get every page it
     asked for gets none (the caller re-queues instead of holding a
     partial reservation that could deadlock admission). Thread-safe —
     the HTTP handlers query occupancy while the scheduler allocates.
+
+    Pages carry **refcounts** so sequences can share an immutable prompt
+    prefix copy-on-write (SERVING.md "Prefix caching"): ``alloc``
+    returns pages at refcount 1, ``fork`` takes an extra reference on
+    live pages (the prefix-cache hit path maps them into a second
+    sequence's page table), and ``free`` *releases* one reference — the
+    page only returns to the free list when its last holder releases
+    it. The double-free hard error is preserved exactly for that last
+    holder: releasing a page whose refcount is already 0 means the
+    caller's page-lifetime bookkeeping is corrupt.
     """
 
     def __init__(self, num_pages: int):
@@ -69,6 +80,7 @@ class PageAllocator:
         self.num_pages = int(num_pages)
         self._lock = threading.Lock()
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._refs: List[int] = [0] * self.num_pages
 
     @property
     def capacity(self) -> int:
@@ -87,7 +99,8 @@ class PageAllocator:
         return self.used_count() / max(self.capacity, 1)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` page ids, or None if fewer than ``n`` are free."""
+        """``n`` page ids at refcount 1, or None if fewer than ``n``
+        are free."""
         n = int(n)
         if n < 0:
             raise ValueError(f"cannot allocate {n} pages")
@@ -95,23 +108,56 @@ class PageAllocator:
             if len(self._free) < n:
                 return None
             out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._refs[p] = 1
         return out
 
-    def free(self, pages: Sequence[int]) -> None:
-        """Return pages to the free list. Double-free and null-page
-        frees are hard errors — both mean the caller's page-lifetime
-        bookkeeping is corrupt, and silently absorbing them would let
-        two sequences share a page."""
+    def fork(self, pages: Sequence[int]) -> None:
+        """Take one extra reference on each live page — the COW prefix
+        share: a second sequence maps the same physical pages read-only
+        (its own writes land at positions past the shared prefix, in
+        pages it allocated itself). Forking a free page is a hard error
+        — the prefix index is holding a page it no longer owns."""
         with self._lock:
-            held = set(self._free)
+            for p in pages:
+                p = int(p)
+                if p == NULL_PAGE or not 0 < p < self.num_pages:
+                    raise ValueError(f"cannot fork page {p}")
+                if self._refs[p] <= 0:
+                    raise ValueError(f"fork of free page {p}")
+            for p in pages:
+                self._refs[int(p)] += 1
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs[int(page)]
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Release one reference per page; a page returns to the free
+        list only when its LAST holder releases it. Double-free (a
+        release past refcount 0) and null-page frees are hard errors —
+        both mean the caller's page-lifetime bookkeeping is corrupt,
+        and silently absorbing them would let two sequences share a
+        page one of them no longer owns."""
+        with self._lock:
+            seen = set()
             for p in pages:
                 p = int(p)
                 if p == NULL_PAGE or not 0 < p < self.num_pages:
                     raise ValueError(f"cannot free page {p}")
-                if p in held:
+                if p in seen:
+                    # One owner releasing the same page twice in one
+                    # call is the classic double-free shape even when
+                    # other holders keep the refcount positive.
                     raise ValueError(f"double free of page {p}")
-                held.add(p)
-                self._free.append(p)
+                if self._refs[p] <= 0:
+                    raise ValueError(f"double free of page {p}")
+                seen.add(p)
+            for p in pages:
+                p = int(p)
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    self._free.append(p)
 
 
 def init_pools(
@@ -221,6 +267,38 @@ def paged_attention(
     scores = jnp.where(mask[:, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("shl,slhd->shd", probs, vc)
+
+
+def paged_verify_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_tables: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """K-position attention through page tables — the speculative-decode
+    verify dispatch (SERVING.md "Speculative decoding").
+
+    ``q`` (S, K, H, D) — K consecutive queries per batch slot, query j
+    of slot s sitting at global position ``positions[s] + j``;
+    ``page_tables`` (S, P); ``positions`` (S,) — the base position of
+    each slot's verify window (its K/V, and the window's, must already
+    be written). Query j attends causally to key positions
+    <= positions[s] + j; everything later (unwritten tail, null-page
+    garbage, rejected-draft leftovers) is masked to -inf before the
+    softmax, so each query equals :func:`paged_attention` at its own
+    position exactly.
+    """
+    kc = gather_kv(k_pool, page_tables)            # (S, L, H, D)
+    vc = gather_kv(v_pool, page_tables)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("skhd,slhd->skhl", q, kc) * scale
+    l = kc.shape[1]
+    qpos = positions[:, None] + jnp.arange(q.shape[1])[None, :]
+    mask = jnp.arange(l)[None, None, :] <= qpos[:, :, None]  # (S, K, L)
+    scores = jnp.where(mask[:, :, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("skhl,slhd->skhd", probs, vc)
 
 
 def paged_prefill_attention(

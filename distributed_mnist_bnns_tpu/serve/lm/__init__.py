@@ -12,17 +12,23 @@ bitplanes — the bandwidth-bound regime the packed kernel wins
 
   engine.py   LMEngine: bounded admission, iteration-level scheduler,
               chunked prefill at admission, page lifecycle, deadlines,
-              recompile fence armed at budget 0
+              recompile fence armed at budget 0; optional COW prefix
+              caching and self-speculative decode rounds
+  prefix_cache.py  radix index of page-size token blocks over the
+              refcounted page pool (SERVING.md "Prefix caching")
   server.py   LMServer: POST /generate (ndjson over chunked HTTP),
               /healthz, /metrics, SIGTERM graceful drain
   client.py   stdlib streaming client (tests + CI smoke)
 
-The compiled prefill/decode pair itself lives in
+The compiled prefill/decode/verify programs themselves live in
 ``infer_transformer.make_paged_lm_decoder``; the page primitives in
 ``ops.paged_kv``.
 """
 
 from .engine import LMEngine, LMRequest
+from .prefix_cache import PrefixCache
 from .server import LMServeConfig, LMServer
 
-__all__ = ["LMEngine", "LMRequest", "LMServeConfig", "LMServer"]
+__all__ = [
+    "LMEngine", "LMRequest", "LMServeConfig", "LMServer", "PrefixCache",
+]

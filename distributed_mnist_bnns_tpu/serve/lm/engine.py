@@ -55,6 +55,7 @@ from ...obs.trace import (
     next_request_id,
 )
 from ...ops.paged_kv import PageAllocator, pages_needed
+from .prefix_cache import PrefixCache
 
 log = logging.getLogger(__name__)
 
@@ -66,6 +67,14 @@ DECODE_ITERATION_SECONDS = "lm_decode_iteration_seconds"
 REQUESTS_TOTAL = "lm_requests_total"
 SHED_TOTAL = "lm_shed_total"
 DECODE_ERRORS_TOTAL = "lm_decode_errors_total"
+PREFIX_HITS_TOTAL = "lm_prefix_cache_hits_total"
+SPEC_TOKENS_TOTAL = "lm_spec_tokens_total"
+
+# Final statuses whose KV contents are trustworthy at eviction: the
+# pools were never torn down under the stream, so its pages can be
+# published into the prefix index. "error" evictions (dispatch failure,
+# fence trip) must NOT publish — the pools may have been rebuilt.
+_PUBLISHABLE_STATUSES = ("ok", "deadline", "cancelled")
 
 # Millisecond buckets for the prefill histogram (the default registry
 # buckets are seconds-scaled; prefill is a handful of chunk dispatches).
@@ -169,6 +178,7 @@ class LMEngine:
         max_consecutive_failures: int = 4,
         recompile_fence: bool = True,
         boot_compile_baseline: Optional[int] = None,
+        prefix_cache: bool = False,
     ):
         self.decoder = decoder
         self.telemetry = telemetry
@@ -177,6 +187,21 @@ class LMEngine:
         self.decode_event_every = max(int(decode_event_every), 1)
         self.max_consecutive_failures = int(max_consecutive_failures)
         self.allocator = PageAllocator(decoder.num_pages)
+        # COW prompt-prefix sharing (SERVING.md "Prefix caching"):
+        # admission forks cached pages, eviction publishes back.
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, decoder.page_size)
+            if prefix_cache else None
+        )
+        # Self-speculative decoding is armed by the DECODER carrying a
+        # verify program (make_paged_lm_decoder(spec_k=...)): rounds of
+        # spec_k-1 packed drafts + one bf16 verify dispatch, host-side
+        # accept/reject. Greedy streams only — a temperature stream in
+        # the batch falls the whole round back to plain decode.
+        self.spec_k = (
+            int(decoder.spec_k)
+            if getattr(decoder, "verify", None) is not None else 0
+        )
         self.max_len = int(decoder.max_len)
         s, p = int(decoder.slots), int(decoder.max_pages)
         self._page_tables = np.zeros((s, p), np.int32)
@@ -239,6 +264,17 @@ class LMEngine:
         self.errors_ctr = reg.counter(
             DECODE_ERRORS_TOTAL, "decode dispatch failures (retried)"
         )
+        self.prefix_hits_ctr = reg.counter(
+            PREFIX_HITS_TOTAL,
+            "prefix-cache admission lookups (result=hit|miss)",
+        )
+        self.spec_tokens_ctr = reg.counter(
+            SPEC_TOKENS_TOTAL,
+            "draft tokens by verify outcome (outcome=accepted|rejected)",
+        )
+        self._spec_drafted = 0         # cumulative drafts proposed
+        self._spec_accepted = 0        # cumulative drafts accepted
+        self._spec_rounds = 0
         self._sanitizer = Sanitizer(
             SanitizerConfig(
                 recompile_fence=recompile_fence,
@@ -265,6 +301,25 @@ class LMEngine:
         if self._compile_baseline is None:
             return None
         return self._tracker.count - self._compile_baseline
+
+    @property
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """Accepted / drafted over the engine's lifetime (None until a
+        spec round drafted anything)."""
+        if self._spec_drafted == 0:
+            return None
+        return self._spec_accepted / self._spec_drafted
+
+    def prefix_cache_stats(self) -> Optional[Dict[str, Any]]:
+        """Entry count + shared-page occupancy for /healthz, or None
+        when the cache is off."""
+        if self.prefix_cache is None:
+            return None
+        stats = self.prefix_cache.stats()
+        stats["page_occupancy"] = round(
+            stats["pages"] / max(self.allocator.capacity, 1), 4
+        )
+        return stats
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -293,6 +348,21 @@ class LMEngine:
             jnp.asarray(self._page_tables), jnp.asarray(self._positions),
         )
         jax.block_until_ready(lp)
+        if self.spec_k:
+            # Third program: the fixed-K verify dispatch must be warm
+            # too, or the first spec round's compile trips the budget-0
+            # fence (and with an AOT boot, the verify executable must
+            # come from the store — the pair-miss discipline extends to
+            # the triple).
+            pools, vlp = dec.verify(
+                pools,
+                jnp.asarray(np.zeros(
+                    (dec.slots, self.spec_k), np.int32
+                )),
+                jnp.asarray(self._page_tables),
+                jnp.asarray(self._positions),
+            )
+            jax.block_until_ready(vlp)
         self._pools = pools
         self._compile_baseline = (
             self._boot_baseline if self._boot_baseline is not None
@@ -330,6 +400,12 @@ class LMEngine:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        if self.prefix_cache is not None:
+            # The cache must be fully evictable at teardown: release
+            # every cache-held page reference so pages_in_use drains to
+            # 0 (live forks, if any remain, keep their own references).
+            self.prefix_cache.clear()
+            self.occupancy_gauge.set(self.allocator.occupancy())
 
     # -- admission (transport threads) --------------------------------------
 
@@ -497,24 +573,54 @@ class LMEngine:
             total = min(
                 len(req.prompt) + req.max_new_tokens, self.max_len
             )
-            need = pages_needed(total, self.decoder.page_size)
-            if need > self.allocator.capacity:
+            ps = self.decoder.page_size
+            need_total = pages_needed(total, ps)
+            if need_total > self.allocator.capacity:
                 # Would never fit even on an idle engine: failing it now
                 # beats wedging the FIFO head forever.
                 self._finish_unslotted(
                     req, "error",
-                    f"request needs {need} pages, pool holds "
+                    f"request needs {need_total} pages, pool holds "
                     f"{self.allocator.capacity}",
                 )
                 continue
             alloc_t0 = time.monotonic()
+            cached_tokens, forked = 0, []
+            if self.prefix_cache is not None:
+                # Longest cached full-page prefix, capped so at least
+                # ONE suffix token prefills (admission samples the
+                # first generated token from the suffix's log-probs).
+                cached_tokens, forked = self.prefix_cache.lookup(
+                    req.prompt, len(req.prompt) - 1
+                )
+            need = need_total - len(forked)
             pages = self.allocator.alloc(need)
+            if pages is None and self.prefix_cache is not None:
+                # Pool pressure: drop LRU cache-only entries and retry
+                # before giving up the admission.
+                shortfall = need - self.allocator.free_count()
+                if self.prefix_cache.evict(shortfall) > 0:
+                    pages = self.allocator.alloc(need)
             if pages is None:
                 # Not enough KV memory: requeue at the head and let
-                # running sequences finish — eviction frees pages.
+                # running sequences finish — eviction frees pages. The
+                # forked prefix references go back too (the next
+                # attempt re-forks).
+                if forked:
+                    self.allocator.free(forked)
                 with self._cond:
                     self._queue.appendleft(req)
                 return
+            if self.prefix_cache is not None:
+                # Count hit/miss only for admissions that proceed: a
+                # pool-pressure requeue re-runs the lookup every
+                # scheduler pass, and counting those retries would
+                # inflate the hit rate by orders of magnitude.
+                self.prefix_cache.note_result(bool(forked))
+                self.prefix_hits_ctr.inc(
+                    result="hit" if forked else "miss"
+                )
+            pages = forked + pages     # table order: prefix first
             if self.tracer.enabled:
                 # Queue wait ends when the scheduler starts working on
                 # the request (= alloc start); page_alloc follows it.
@@ -529,9 +635,12 @@ class LMEngine:
                     "lm.page_alloc", kind="page_alloc", parent=req.span,
                     t0=alloc_t0, t1=time.monotonic(),
                     pages=len(pages), need=need,
+                    forked=len(forked),
                 )
             try:
-                self._prefill_into_slot(req, slot, pages, total)
+                self._prefill_into_slot(
+                    req, slot, pages, total, cached_tokens
+                )
             except Exception as e:
                 log.exception("lm-engine prefill for request %s failed",
                               req.id)
@@ -566,7 +675,8 @@ class LMEngine:
                     self._dispatch_failure(cause)
 
     def _prefill_into_slot(
-        self, req: LMRequest, slot: int, pages: List[int], total: int
+        self, req: LMRequest, slot: int, pages: List[int], total: int,
+        cached_tokens: int = 0,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -577,20 +687,27 @@ class LMEngine:
         table = np.zeros(dec.max_pages, np.int32)  # not double-count
         table[: len(pages)] = pages
         plen = len(req.prompt)
+        # Prefix-cache hit: the first cached_tokens positions' K/V
+        # already sit in the forked pages (page-aligned by
+        # construction) — prefill runs only on the uncached suffix,
+        # in the same fixed-chunk dispatches, attending through the
+        # shared pages for its causal history.
+        suffix = plen - cached_tokens       # >= 1 (lookup is capped)
         chunk = dec.prefill_chunk
-        padded = -(-plen // chunk) * chunk
+        padded = -(-suffix // chunk) * chunk
         prompt = np.zeros(padded, np.int32)
-        prompt[:plen] = req.prompt
+        prompt[:suffix] = req.prompt[cached_tokens:]
         table_j = jnp.asarray(table)
         # 0-d ndarrays, not numpy scalars: a scalar would eagerly
         # compile a convert program and trip the boot-pinned fence.
         length_j = jnp.asarray(np.asarray(plen, np.int32))
         lp_last = None
-        last_start = 0
+        last_start = cached_tokens
         try:
-            for start in range(0, padded, chunk):
+            for off in range(0, padded, chunk):
+                start = cached_tokens + off
                 self._pools, clp = dec.prefill(
-                    self._pools, jnp.asarray(prompt[start:start + chunk]),
+                    self._pools, jnp.asarray(prompt[off:off + chunk]),
                     table_j, jnp.asarray(np.asarray(start, np.int32)),
                     length_j,
                 )
@@ -605,7 +722,10 @@ class LMEngine:
             ) from e
         prefill_ms = (time.perf_counter() - t0) * 1e3
         self.prefill_hist.observe(prefill_ms)
-        self.tokens_ctr.inc(plen, phase="prefill")
+        # Counter delta = tokens actually prefilled: a cache hit's
+        # skipped work is visible as lm_tokens_total{phase=prefill}
+        # growing by the suffix only (the CI smoke asserts on this).
+        self.tokens_ctr.inc(suffix, phase="prefill")
         st = _Slot(req, pages, total, self.batch_seq, admitted_at)
         if self.tracer.enabled:
             # The queue + page_alloc children were banked at admission
@@ -616,6 +736,7 @@ class LMEngine:
                 "lm.prefill", kind="prefill", parent=req.span,
                 t0=admitted_at, t1=admitted_at + prefill_ms / 1e3,
                 prompt_tokens=plen, chunks=padded // chunk, slot=slot,
+                cached_tokens=cached_tokens,
             )
             # The decode window: first token out of prefill -> evict.
             # A live span (ended by _evict) so a request that dies
@@ -639,12 +760,24 @@ class LMEngine:
             self.telemetry.emit(
                 "lm_admit",
                 id=req.id, slot=slot, prompt_tokens=plen,
+                prefill_tokens=suffix, cached_tokens=cached_tokens,
                 max_new_tokens=req.max_new_tokens, pages=len(pages),
                 iteration=self.batch_seq,
                 queue_ms=round((st.admitted_at - req.enqueued_at) * 1e3, 3),
                 prefill_ms=round(prefill_ms, 3),
                 page_occupancy=round(self.allocator.occupancy(), 4),
             )
+            if cached_tokens:
+                self.telemetry.emit(
+                    "lm_prefix_hit",
+                    id=req.id, slot=slot, prompt_tokens=plen,
+                    cached_tokens=cached_tokens,
+                    pages_forked=pages_needed(
+                        cached_tokens, dec.page_size
+                    ),
+                    prefill_tokens=suffix,
+                    prefill_ms=round(prefill_ms, 3),
+                )
         self._emit_token(req, first)
         self._maybe_finish(slot)
 
@@ -672,13 +805,26 @@ class LMEngine:
         })
 
     def _decode_once(self) -> None:
-        import jax
-        import jax.numpy as jnp
-
         self.batch_seq += 1
         self._expire_active()
         if self.active_streams == 0:
             return
+        # Speculative rounds need every active slot greedy: accepted-
+        # prefix verification is an argmax identity, and a temperature
+        # slot's host RNG must consume exactly one draw per emitted
+        # token — so a mixed batch falls back to plain decode for the
+        # whole round (SERVING.md "Speculative decoding").
+        if self.spec_k and all(
+            s is None or s.rng is None for s in self._slots
+        ):
+            self._spec_round()
+        else:
+            self._plain_round()
+
+    def _plain_round(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
         # ONE span per decode iteration, batching all active slots (the
         # iteration-level scheduler's unit of work): while it is the
         # scheduler thread's current span, a chaos fault fired below
@@ -744,6 +890,148 @@ class LMEngine:
                     recompiles_post_warmup=self.recompiles_post_warmup,
                 )
 
+    def _spec_round(self) -> None:
+        """One self-speculative round for all active (greedy) slots:
+        ``spec_k - 1`` drafts through the packed decode program, ONE
+        dense-bf16 verify dispatch scoring the whole window, host-side
+        accept/reject (SERVING.md "Speculative decoding").
+
+        Emits ``a + 1`` tokens per slot — the accepted draft prefix
+        plus the verifier's correction (or bonus) token — so greedy
+        output is token-identical to the verifier alone by
+        construction: every emitted token IS a verifier argmax, drafts
+        merely prepay the positions the verifier then scores in one
+        large-M dispatch. The compiled signatures are fixed (K never
+        varies with acceptance), keeping the budget-0 fence green.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        k_win = self.spec_k
+        iter_span = self.tracer.start(
+            "lm.decode_iter", kind="decode_iter",
+            iteration=self.batch_seq, active=self.active_streams,
+            spec_k=k_win,
+        )
+        with iter_span:
+            if self.chaos is not None and self.chaos.active:
+                try:
+                    self.chaos.on_infer(step=self.batch_seq)
+                except Exception as e:
+                    # Pre-dispatch: nothing donated, pools intact —
+                    # the round is simply retried (bounded by
+                    # max_consecutive_failures), same as plain decode.
+                    iter_span.end("error", error=type(e).__name__)
+                    self._record_predispatch_failure(e)
+                    return
+            t0 = time.perf_counter()
+            tables_j = jnp.asarray(self._page_tables)
+            window = np.zeros((len(self._slots), k_win), np.int32)
+            window[:, 0] = self._tokens    # input 0: the pending token
+            try:
+                # Draft phase: k_win - 1 packed small-M dispatches.
+                # Positions/tokens advance in LOCAL copies — the
+                # engine's arrays only move when the host accepts.
+                draft_t0 = time.monotonic()
+                d_tokens = self._tokens.copy()
+                d_positions = self._positions.copy()
+                for j in range(1, k_win):
+                    self._pools, lp = self.decoder.decode(
+                        self._pools, jnp.asarray(d_tokens), tables_j,
+                        jnp.asarray(d_positions),
+                    )
+                    d_tokens = np.argmax(
+                        np.asarray(lp), axis=-1
+                    ).astype(np.int32)
+                    window[:, j] = d_tokens
+                    d_positions = d_positions + 1
+                draft_t1 = time.monotonic()
+                # Verify phase: ONE large-M bf16 dispatch scores every
+                # window position (and overwrites the drafts' K/V with
+                # the verifier's canonical values).
+                self._pools, vlp = self.decoder.verify(
+                    self._pools, jnp.asarray(window), tables_j,
+                    jnp.asarray(self._positions),
+                )
+                v_host = np.asarray(vlp)   # (S, K, vocab) — the sync
+                verify_t1 = time.monotonic()
+            except Exception as e:
+                # Mid-dispatch failure with the pools donated: KV is
+                # gone for everyone — same recovery as plain decode.
+                iter_span.end("error", error=type(e).__name__)
+                self._dispatch_failure(e)
+                return
+            dt = time.perf_counter() - t0
+            if self.tracer.enabled:
+                self.tracer.record(
+                    "lm.draft", kind="draft", parent=iter_span,
+                    t0=draft_t0, t1=draft_t1, drafts=k_win - 1,
+                )
+                self.tracer.record(
+                    "lm.verify", kind="verify", parent=iter_span,
+                    t0=draft_t1, t1=verify_t1, window=k_win,
+                )
+            iter_span.end("ok", iter_ms=round(dt * 1e3, 3))
+        self._consecutive_failures = 0
+        self.iter_hist.observe(dt)
+        if self._sanitizer is not None:
+            self._sanitizer.after_step(step=self.batch_seq)
+        greedy = np.argmax(v_host, axis=-1)          # (S, K)
+        round_accepted = round_drafted = 0
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            # Accept the longest draft prefix the verifier agrees
+            # with: window[j+1] (draft) vs greedy[j] (the verifier's
+            # choice after consuming window[j]).
+            a = 0
+            while (a < k_win - 1
+                   and window[slot, a + 1] == greedy[slot, a]):
+                a += 1
+            round_drafted += k_win - 1
+            round_accepted += a
+            # Emit the accepted drafts plus the verifier's correction
+            # (or, at full acceptance, its bonus token). Positions
+            # advance past every emitted-except-pending token; the
+            # correction becomes the new pending token.
+            self._positions[slot] += a + 1
+            self._tokens[slot] = int(greedy[slot, a])
+            emit = [int(window[slot, j]) for j in range(1, a + 1)]
+            emit.append(int(greedy[slot, a]))
+            for token in emit:
+                self._emit_token(st.req, token)
+                self._maybe_finish(slot)
+                if self._slots[slot] is None:
+                    break                  # finished mid-window
+        self._spec_rounds += 1
+        self._spec_drafted += round_drafted
+        self._spec_accepted += round_accepted
+        if round_accepted:
+            self.spec_tokens_ctr.inc(round_accepted, outcome="accepted")
+        if round_drafted - round_accepted:
+            self.spec_tokens_ctr.inc(
+                round_drafted - round_accepted, outcome="rejected"
+            )
+        if self.batch_seq % self.decode_event_every == 0:
+            if self.telemetry is not None:
+                rate = self.spec_acceptance_rate
+                self.telemetry.emit(
+                    "lm_spec_round",
+                    iteration=self.batch_seq,
+                    active=self.active_streams,
+                    spec_k=k_win,
+                    accepted=round_accepted,
+                    drafted=round_drafted,
+                    acceptance_rate=(
+                        round(rate, 4) if rate is not None else None
+                    ),
+                    iter_ms=round(dt * 1e3, 3),
+                    page_occupancy=round(
+                        self.allocator.occupancy(), 4
+                    ),
+                    recompiles_post_warmup=self.recompiles_post_warmup,
+                )
+
     def _record_predispatch_failure(self, e: Exception) -> None:
         self._consecutive_failures += 1
         self.errors_ctr.inc(kind=type(e).__name__)
@@ -793,6 +1081,18 @@ class LMEngine:
             f"decode dispatch failed, KV state lost "
             f"({type(e).__name__}: {e})",
         )
+        if self.prefix_cache is not None:
+            # The rebuilt pools make every cached page's CONTENTS
+            # garbage even though the page ids stay valid: serving a
+            # stale prefix would be silently-wrong log-probs. Drop the
+            # whole index (error evictions above did not publish).
+            dropped = self.prefix_cache.clear()
+            if dropped:
+                log.warning(
+                    "prefix cache invalidated after dispatch failure "
+                    "(%d entr%s dropped)", dropped,
+                    "y" if dropped == 1 else "ies",
+                )
         self._pools = self.decoder.init_pools()
 
     def _expire_active(self) -> None:
@@ -826,13 +1126,30 @@ class LMEngine:
         self._page_tables[slot] = 0
         self._positions[slot] = 0
         self._tokens[slot] = 0
-        self.allocator.free(st.pages)
         req = st.req
+        published = 0
+        if (self.prefix_cache is not None
+                and status in _PUBLISHABLE_STATUSES
+                and req.n_emitted >= 1):
+            # Publish instead of free: the sequence's written K/V —
+            # prompt plus every emitted token except the pending last
+            # one (its K/V was never written) — re-enters the prefix
+            # index; full pages transfer their reference to the cache,
+            # duplicates and the partial tail are released.
+            written = len(req.prompt) + req.n_emitted - 1
+            seq = np.concatenate([
+                req.prompt,
+                np.asarray(req.tokens[:req.n_emitted - 1], np.int32),
+            ])[:written]
+            published = self.prefix_cache.insert(seq, st.pages)
+        else:
+            self.allocator.free(st.pages)
         req.slot = None
         st.decode_span.end(status, tokens=req.n_emitted,
                            iteration=self.batch_seq)
         self._finish(req, status, detail, slot=slot,
                      pages_freed=len(st.pages),
+                     pages_published=published,
                      wall_ms=round(
                          (time.monotonic() - st.admitted_at) * 1e3, 3))
         self.active_gauge.set(self.active_streams)
@@ -875,6 +1192,7 @@ class LMEngine:
     def _finish(
         self, req: LMRequest, status: str, detail: str, *,
         slot: Optional[int], pages_freed: int, wall_ms: float,
+        pages_published: int = 0,
     ) -> None:
         req.status = status
         self.requests_ctr.inc(status=status)
@@ -887,6 +1205,8 @@ class LMEngine:
                 "pages_freed": pages_freed, "wall_ms": wall_ms,
                 "iteration": self.batch_seq,
             }
+            if pages_published:
+                fields["pages_published"] = pages_published
             if detail:
                 fields["detail"] = detail[:500]
             try:

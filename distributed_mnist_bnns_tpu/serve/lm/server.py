@@ -89,6 +89,15 @@ class LMServeConfig:
                                         # event log (obs/trace): None =
                                         # the JG_TRACE env var; needs
                                         # telemetry_dir
+    prefix_cache: bool = False          # COW prompt-prefix sharing
+                                        # over the paged pool
+                                        # (SERVING.md "Prefix caching")
+    spec_decode: int = 0                # self-speculative decoding
+                                        # window K (0 = off): K-1
+                                        # packed drafts + one fixed-K
+                                        # bf16 verify dispatch per
+                                        # round (SERVING.md
+                                        # "Speculative decoding")
 
 
 class LMServer:
@@ -140,6 +149,7 @@ class LMServer:
                 num_pages=cfg.num_pages,
                 prefill_chunk=cfg.prefill_chunk,
                 max_len=cfg.max_len,
+                spec_k=cfg.spec_decode,
                 interpret=self._interpret(),
                 store=AotStore(cfg.aot_dir, telemetry=self.telemetry),
             )
@@ -165,6 +175,7 @@ class LMServer:
                 num_pages=cfg.num_pages,
                 prefill_chunk=cfg.prefill_chunk,
                 max_len=cfg.max_len,
+                spec_k=cfg.spec_decode,
                 interpret=self._interpret(),
             )
             self.aot_status = "disabled"
@@ -177,6 +188,7 @@ class LMServer:
             boot_compile_baseline=(
                 boot_mark if self.aot_status == "hit" else None
             ),
+            prefix_cache=cfg.prefix_cache,
         ).start()
         server = self
 
@@ -204,6 +216,8 @@ class LMServer:
                 "default_deadline_ms": cfg.default_deadline_ms,
                 "chaos": self.chaos.spec or None,
                 "aot": self.aot_status,
+                "prefix_cache": cfg.prefix_cache,
+                "spec_decode": cfg.spec_decode,
             },
             artifact_info=self.artifact_info,
         )
@@ -223,7 +237,7 @@ class LMServer:
             status = "draining"
         else:
             status = "ok"
-        return {
+        health = {
             "status": status,
             "engine": "lm",
             "slots": eng.decoder.slots,
@@ -237,6 +251,22 @@ class LMServer:
             "aot": self.aot_status,
             "uptime_s": round(time.time() - self._started_at, 3),
         }
+        cache_stats = eng.prefix_cache_stats()
+        if cache_stats is not None:
+            # Prefix-cache entry count + shared-page occupancy: how
+            # much of pages_in_use is the cache (reclaimable under
+            # pressure), not live streams.
+            health["prefix_cache_entries"] = cache_stats["entries"]
+            health["shared_page_occupancy"] = (
+                cache_stats["page_occupancy"]
+            )
+        if eng.spec_k:
+            rate = eng.spec_acceptance_rate
+            health["spec_k"] = eng.spec_k
+            health["spec_acceptance_rate"] = (
+                round(rate, 4) if rate is not None else None
+            )
+        return health
 
     def request_stop(self, reason: str = "stop requested") -> None:
         self.stop_request.request(reason)
@@ -262,8 +292,21 @@ class LMServer:
             "shed_total": int(self.engine.shed_ctr.total()),
             "iterations_total": self.engine.batch_seq,
             "recompiles_post_warmup": self.engine.recompiles_post_warmup,
+            # After stop() the prefix cache has been cleared: every
+            # page must be back in the pool — the CI smoke asserts the
+            # cache was fully evictable at drain.
+            "pages_in_use": self.engine.allocator.used_count(),
+            "prefix_cache_entries": (
+                self.engine.prefix_cache.entries
+                if self.engine.prefix_cache is not None else None
+            ),
             "wall_s": round(time.monotonic() - t0, 3),
         }
+        if self.engine.spec_k:
+            rate = self.engine.spec_acceptance_rate
+            stats["spec_acceptance_rate"] = (
+                round(rate, 4) if rate is not None else None
+            )
         self.telemetry.emit("drain", engine="lm", **stats)
         self.telemetry.close()
         log.info("lm server drained and stopped: %s", stats)

@@ -21,6 +21,17 @@ staggered-length concurrent streaming requests through it and asserts:
   * every page is back in the pool when traffic ends, and SIGTERM
     drains to **exit 0** with a ``drain`` event.
 
+Both ISSUE-13 features ride the whole scenario (``--prefix-cache
+--spec-decode 4``): the streams share a system-prompt prefix, so a
+late admission must land a **prefix hit** that skipped prefill work
+(``lm_prefix_hit`` + the ``lm_admit`` prefill-tokens delta), the
+faults above fire while speculative rounds run (draft acceptance
+visible in ``lm_spec_tokens_total``), an idle engine's held pages are
+exactly the cache's (shared-page accounting in /healthz), the cache is
+fully evictable at drain (``drain`` event: ``pages_in_use == 0``), and
+the budget-0 recompile fence stays green with all THREE compiled
+programs in flight.
+
 Tracing rides the whole scenario (``--trace``, OBSERVABILITY.md
 "Tracing"): every completed stream must leave a CLOSED span tree
 (root ``lm.request`` + queue/prefill/decode children, parents
@@ -51,10 +62,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 CHAOS_SPEC = (
     "infer_slow@step=4,times=3,delay_s=0.25"   # stalls: streams spread,
                                                # the queued probe 504s
-    ";infer_error@step=16,times=2"             # transient: retried
+    ";infer_error@step=8,times=2"              # transient: retried —
+                                               # early enough that spec
+                                               # rounds (≈K tokens per
+                                               # iteration) still reach
+                                               # it before streams end
 )
-EXPECTED_KINDS = ("lm_admit", "lm_evict", "fault_injected", "drain")
+EXPECTED_KINDS = ("lm_admit", "lm_evict", "fault_injected", "drain",
+                  "lm_prefix_hit")
 STREAMS = ((0.0, 24), (0.15, 8), (0.3, 12))    # (start delay s, max_new)
+# Shared system prompt: two full 8-token pages, so the stream admitted
+# after another's eviction must fork them as a prefix hit.
+SYSTEM_PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
 
 
 def _free_port() -> int:
@@ -104,6 +123,8 @@ def main(argv=None) -> int:
             "--page-size", "8",
             "--prefill-chunk", "8",
             "--queue-depth", "4",
+            "--prefix-cache",
+            "--spec-decode", "4",
             "--telemetry-dir", tel_dir,
             "--trace",
             "--chaos", CHAOS_SPEC,
@@ -140,7 +161,8 @@ def main(argv=None) -> int:
             done = None
             try:
                 code, resp = lc.open_stream(
-                    base, [1 + tid, 2, 3], max_new_tokens=max_new,
+                    base, SYSTEM_PROMPT + [1 + tid, 2, 3],
+                    max_new_tokens=max_new,
                     deadline_ms=120000, timeout=120,
                 )
                 if code == 200:
@@ -219,15 +241,59 @@ def main(argv=None) -> int:
             failures.append(
                 "post-warmup recompiles: "
                 f"{health.get('recompiles_post_warmup')} (want 0) — the "
-                "one-compiled-signature contract broke"
+                "one-compiled-signature contract broke with prefix "
+                "caching AND spec decode armed (three programs)"
             )
-        if health.get("pages_in_use") != 0:
+        # With the prefix cache on, an idle engine's held pages must be
+        # EXACTLY the cache's published entries — anything else is a
+        # stream leaking pages.
+        if health.get("pages_in_use") != health.get(
+            "prefix_cache_entries"
+        ):
             failures.append(
-                f"{health.get('pages_in_use')} pages still held after "
-                "all streams ended (page leak)"
+                f"{health.get('pages_in_use')} pages held at idle but "
+                f"the prefix cache owns {health.get('prefix_cache_entries')}"
+                " — a stream leaked pages"
+            )
+        if not health.get("prefix_cache_entries"):
+            failures.append(
+                "no prefix-cache entries after eviction — publication "
+                "back to the index never happened"
+            )
+        rate = health.get("spec_acceptance_rate")
+        if rate is None or rate < 0.5:
+            failures.append(
+                f"spec acceptance rate {rate!r} (want >= 0.5): the "
+                "packed draft and bf16 verifier carry the same weights"
             )
         if health.get("fence_error"):
             failures.append(f"fence error: {health['fence_error']}")
+        code, body = lc.metrics(base)
+        snap = json.loads(body) if code == 200 else {}
+        accepted = sum(
+            s["value"]
+            for s in snap.get("lm_spec_tokens_total", {}).get(
+                "series", []
+            )
+            if s["labels"].get("outcome") == "accepted"
+        )
+        if not accepted:
+            failures.append(
+                "lm_spec_tokens_total{outcome=accepted} is zero — "
+                "speculative rounds never ran (or never accepted)"
+            )
+        prefix_hits = sum(
+            s["value"]
+            for s in snap.get("lm_prefix_cache_hits_total", {}).get(
+                "series", []
+            )
+            if s["labels"].get("result") == "hit"
+        )
+        if not prefix_hits:
+            failures.append(
+                "lm_prefix_cache_hits_total{result=hit} is zero — no "
+                "admission found the shared system prompt"
+            )
 
         # graceful drain: SIGTERM -> flush -> exit 0
         proc.send_signal(signal.SIGTERM)
@@ -281,6 +347,56 @@ def main(argv=None) -> int:
     drains = [e for e in events if e["kind"] == "drain"]
     if drains and not drains[-1].get("flushed"):
         failures.append("drain did not flush streaming work")
+    # prefix-cache acceptance: a later admission skipped prefill work —
+    # its lm_admit carries cached_tokens > 0 and a prefill-tokens delta
+    # strictly below its prompt length (the counter only grew by the
+    # suffix), corroborated by an lm_prefix_hit event.
+    prefix_hits_ev = [e for e in events if e["kind"] == "lm_prefix_hit"]
+    hit_admits = [a for a in admits if a.get("cached_tokens", 0) > 0]
+    if not hit_admits:
+        failures.append(
+            "no lm_admit with cached_tokens > 0 — the shared system "
+            "prompt never hit the prefix index"
+        )
+    elif not all(
+        a["prefill_tokens"] == a["prompt_tokens"] - a["cached_tokens"]
+        for a in hit_admits
+    ):
+        failures.append(
+            "a prefix-hit admission's prefill_tokens delta does not "
+            "equal prompt - cached (prefill work was not skipped): "
+            f"{hit_admits}"
+        )
+    if len(prefix_hits_ev) != len(hit_admits):
+        failures.append(
+            f"{len(prefix_hits_ev)} lm_prefix_hit events vs "
+            f"{len(hit_admits)} cache-hit admissions"
+        )
+    # drain accounting: the cache must be fully evictable at drain —
+    # after the final flush every page is back in the pool and the
+    # index is empty.
+    if drains:
+        if drains[-1].get("pages_in_use") != 0:
+            failures.append(
+                f"drain left {drains[-1].get('pages_in_use')} pages in "
+                "use — the prefix cache was not fully evictable"
+            )
+        if drains[-1].get("prefix_cache_entries") != 0:
+            failures.append(
+                "drain left prefix-cache entries behind: "
+                f"{drains[-1].get('prefix_cache_entries')}"
+            )
+    # spec decode under chaos: the injected infer_error transients must
+    # have fired DURING spec rounds and been retried (streams above all
+    # finished ok with exact token counts).
+    if not any(
+        e.get("fault") == "infer_error"
+        for e in events if e["kind"] == "fault_injected"
+    ):
+        failures.append(
+            "chaos infer_error never fired — the spec-round retry path "
+            "went unexercised"
+        )
 
     # -- tracing acceptance (OBSERVABILITY.md "Tracing") ----------------
     from distributed_mnist_bnns_tpu.obs.trace import unresolved_parents
@@ -319,6 +435,20 @@ def main(argv=None) -> int:
             "no decode-iteration spans — the scheduler's per-iteration "
             "lane must be trace-visible"
         )
+    iter_ids = {
+        (s.get("trace"), s.get("span"))
+        for s in spans if s.get("span_kind") == "decode_iter"
+    }
+    for kind in ("draft", "verify"):
+        if not any(
+            s.get("span_kind") == kind
+            and (s.get("trace"), s.get("parent")) in iter_ids
+            for s in spans
+        ):
+            failures.append(
+                f"no lm.{kind} span parented under lm.decode_iter — "
+                "the speculative round's phases must be trace-visible"
+            )
     if not any(s.get("span_kind") == "stall" for s in spans):
         failures.append(
             "chaos stalls fired but no stall span landed — fault->"
@@ -362,6 +492,8 @@ def main(argv=None) -> int:
                    for k in EXPECTED_KINDS},
         "spans": len(spans),
         "recompiles_post_warmup": health.get("recompiles_post_warmup"),
+        "prefix_hits": len(prefix_hits_ev),
+        "spec_acceptance_rate": health.get("spec_acceptance_rate"),
         "drain": drains[-1] if drains else None,
         "ok": not failures,
     }

@@ -58,6 +58,12 @@ BENCH_ARGS = [
     "--model", "bnn-mlp-small", "--batch-size", "256",
     "--comm-bench", "--comm-batch-size", "256", "--comm-steps", "5",
     "--serve-p99-bench",
+    # LM serving slice (ROADMAP item 5 remnant, landed with ISSUE 13):
+    # tiny geometry keeps the gate's wall clock sane while still
+    # exercising the real engine, scheduler and all three compiled
+    # programs (prefill/decode/verify).
+    "--lm-serve-bench", "--serving-lm-ctx", "64",
+    "--lm-embed-dim", "32", "--lm-depth", "1", "--lm-heads", "2",
     "--steps", "5", "--warmup", "3", "--reps", "1", "--scan-steps", "8",
     "--no-stretch", "--no-crossover",
     "--probe-timeout", "30", "--probe-budget-s", "30",
@@ -78,6 +84,7 @@ def _get(record: dict, path: str):
 # metric name -> (dotted path into the bench record, comparison kind)
 #   exact: measured == baseline (tolerance ignored)
 #   max:   measured <= baseline * (1 + tolerance)
+#   min:   measured >= baseline * (1 - tolerance)   (floors)
 METRIC_PATHS = {
     "fp32_dp_wire_bytes_per_step": (
         "comm.modes.none.wire_bytes_per_step", "exact"),
@@ -100,6 +107,21 @@ METRIC_PATHS = {
     # multiplies p99; runner jitter merely wiggles it).
     "classifier_p99_under_saturation_ms": (
         "serving_p99.p99_ms", "max"),
+    # LM serving bands (ISSUE 13; ROADMAP items 2+5): a decode
+    # tokens/sec FLOOR and an inter-token p99 ceiling through the real
+    # continuous-batching engine, both wide-band (CPU throughput on
+    # loaded runners swings; a host-work leak into the per-iteration
+    # hot loop collapses it rather than wiggling it) — plus the
+    # draft-acceptance-rate floor for self-speculative decoding (the
+    # draft and verifier carry the SAME weights, so greedy acceptance
+    # sits near 1.0; a numerics drift between the packed and dense-bf16
+    # paths craters it long before output equality visibly breaks).
+    "lm_tokens_per_sec_1stream": (
+        "lm_serve.packed_1bit.streams_1.tokens_per_sec", "min"),
+    "lm_p99_intertoken_ms_8streams": (
+        "lm_serve.packed_1bit.streams_8.p99_intertoken_ms", "max"),
+    "lm_spec_acceptance_rate": (
+        "lm_serve.spec.acceptance_rate", "min"),
     # Steady-state step-time ceilings (wide band, see module docstring).
     "fp32_dp_step_time_ms": (
         "comm.modes.none.step_time_ms", "max"),
@@ -112,11 +134,13 @@ METRIC_PATHS = {
 }
 
 # Wall-clock metrics sharing the wide band: step times plus the
-# serving p99-under-saturation ceiling (same runner-noise reasoning).
+# serving p99-under-saturation and LM inter-token ceilings (same
+# runner-noise reasoning).
 def _wide_band(name: str) -> bool:
     return (
         name.endswith("_step_time_ms")
         or name == "classifier_p99_under_saturation_ms"
+        or name == "lm_p99_intertoken_ms_8streams"
     )
 
 
@@ -127,6 +151,15 @@ def _wide_band(name: str) -> bool:
 # worst case observed across a few runs (a lucky-fast draw plus 4x is
 # still tighter than a loaded runner's honest jitter).
 STEP_TIME_TOLERANCE = 3.0
+
+# Banking tolerances for the floor (min) metrics: throughput may drop
+# to a quarter of the banked draw before failing (the loaded-runner
+# envelope); greedy draft acceptance may lose 10 points — exact-equal
+# GEMM math keeps it pinned near 1.0, so even that is generous.
+MIN_TOLERANCES = {
+    "lm_tokens_per_sec_1stream": 0.75,
+    "lm_spec_acceptance_rate": 0.1,
+}
 
 # bench reports "below measurement floor" instead of a number when a
 # variant ran faster than it can time honestly — never a regression.
@@ -176,6 +209,13 @@ def compare(baselines: dict, record: dict) -> list:
                     "(analytic byte model drifted — if deliberate, "
                     "re-bank with scripts/perf_gate.py --update)"
                 )
+        elif kind == "min":
+            floor = base * (1.0 - tol)
+            if measured < floor:
+                failures.append(
+                    f"{name}: measured {measured} < floor {floor} "
+                    f"(baseline {base}, tolerance {tol})"
+                )
         else:  # max
             limit = base * (1.0 + tol)
             if measured > limit:
@@ -215,7 +255,10 @@ def bank(record: dict, prev: dict | None = None) -> dict:
                 f"cannot bank {name}: missing from the record at {path!r} "
                 f"({measured!r})"
             )
-        tol = STEP_TIME_TOLERANCE if _wide_band(name) else 0.0
+        if kind == "min":
+            tol = MIN_TOLERANCES.get(name, 0.0)
+        else:
+            tol = STEP_TIME_TOLERANCE if _wide_band(name) else 0.0
         metrics[name] = {"baseline": measured, "kind": kind,
                          "tolerance": tol}
     return {
@@ -224,11 +267,13 @@ def bank(record: dict, prev: dict | None = None) -> dict:
             "slice (scripts/perf_gate.py; ROADMAP item 5). Byte counts "
             "are analytic-over-real-buffer-sizes and gated EXACTLY; "
             "compile counts and the wire ratio are ceilings; step "
-            "times and the classifier p99-under-saturation "
-            "(serve/harness.py) are WIDE-band ceilings (noise-"
-            "tolerant, catch per-step/per-request host-work leaks "
-            "into the hot path). Re-bank deliberate changes with "
-            "scripts/perf_gate.py --update."
+            "times, the classifier p99-under-saturation "
+            "(serve/harness.py) and the LM inter-token p99 are WIDE-"
+            "band ceilings (noise-tolerant, catch per-step/per-request "
+            "host-work leaks into the hot path); LM tokens/sec and the "
+            "spec-decode draft-acceptance rate are FLOORS (kind=min: "
+            "measured >= baseline*(1-tolerance)). Re-bank deliberate "
+            "changes with scripts/perf_gate.py --update."
         ),
         "bench_args": BENCH_ARGS,
         "metrics": metrics,

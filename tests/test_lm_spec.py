@@ -1,0 +1,633 @@
+"""Prefix caching + self-speculative decoding (SERVING.md "Prefix
+caching" / "Speculative decoding"; ISSUE 13).
+
+The acceptance criteria covered here:
+
+  * COW safety: forked prefix pages stay bitwise intact while the
+    forking sequence decodes divergently past them;
+  * a cache-hit admission's log-probs equal the cold-prefill oracle to
+    fp tolerance (the suffix prefill attends through shared pages);
+  * radix index mechanics: longest-prefix lookup over full page blocks,
+    publication/dedup at eviction, LRU eviction of cache-only entries,
+    entries a live sequence still maps are never evicted;
+  * greedy spec-decode output is token-identical to the spec-off engine
+    AND to the verifier-alone (spec_k=1) engine across staggered
+    concurrent streams, with the budget-0 recompile fence green;
+  * the two features compose in one engine;
+  * a dispatch failure (pools lost) invalidates the prefix index;
+  * the AOT store banks the verify program and the pair-miss discipline
+    extends to the triple.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.infer import export_packed
+from distributed_mnist_bnns_tpu.infer_transformer import (
+    _freeze_lm_tensors,
+    generate,
+    make_lm_decoder,
+    make_paged_lm_decoder,
+)
+from distributed_mnist_bnns_tpu.models.transformer import BinarizedLM
+from distributed_mnist_bnns_tpu.obs import Telemetry, load_events
+from distributed_mnist_bnns_tpu.ops.paged_kv import PageAllocator
+from distributed_mnist_bnns_tpu.resilience import reset_fire_counts
+from distributed_mnist_bnns_tpu.serve.lm import LMEngine, PrefixCache
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos_ledger():
+    reset_fire_counts()
+    yield
+    reset_fire_counts()
+
+
+@pytest.fixture(scope="module")
+def frozen():
+    model = BinarizedLM(
+        vocab=32, max_len=32, embed_dim=32, depth=2, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    return _freeze_lm_tensors(model, variables)
+
+
+@pytest.fixture(scope="module")
+def contiguous(frozen):
+    return make_lm_decoder(frozen, interpret=True)
+
+
+def _drain_tokens(req, timeout=120.0):
+    toks = []
+    deadline = time.monotonic() + timeout
+    while True:
+        ev = req.events.get(timeout=max(deadline - time.monotonic(), 0.1))
+        if ev["kind"] == "done":
+            return toks, ev
+        toks.append(ev["token"])
+
+
+def _greedy_ref(frozen, decoder, prompt, n):
+    out = generate(
+        frozen, jnp.asarray(prompt, jnp.int32)[None], n,
+        interpret=True, decoder=decoder,
+    )
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+# -- radix index units --------------------------------------------------------
+
+
+class TestPrefixCacheIndex:
+    def _cache(self, num_pages=12, ps=4):
+        alloc = PageAllocator(num_pages)
+        return alloc, PrefixCache(alloc, ps)
+
+    def test_insert_then_longest_prefix_lookup(self):
+        alloc, cache = self._cache()
+        toks = np.arange(10, dtype=np.int32)       # 2 full blocks + tail
+        pages = alloc.alloc(3)
+        assert cache.insert(toks, pages) == 2      # tail page released
+        assert alloc.refcount(pages[2]) == 0
+        # full match of both cached blocks (cap leaves one token over)
+        n, hit = cache.lookup(toks, max_tokens=9)
+        assert n == 8 and hit == pages[:2]
+        assert all(alloc.refcount(p) == 2 for p in hit)
+        alloc.free(hit)
+        # diverging second block: only the first matches
+        other = np.concatenate([toks[:4], [9, 9, 9, 9, 0]]).astype(np.int32)
+        n, hit = cache.lookup(other, max_tokens=len(other) - 1)
+        assert n == 4 and hit == pages[:1]
+        alloc.free(hit)
+        # the cap is honoured even when more blocks would match
+        n, hit = cache.lookup(toks, max_tokens=4)
+        assert n == 4 and len(hit) == 1
+        alloc.free(hit)
+
+    def test_lookup_miss_and_stats(self):
+        _alloc, cache = self._cache()
+        n, hit = cache.lookup(np.arange(8, dtype=np.int32), 7)
+        assert (n, hit) == (0, [])
+        # hit/miss accounting is the ADMISSION's, not the lookup's: a
+        # pool-pressure requeue re-looks-up without recounting
+        assert cache.stats()["misses"] == 0
+        cache.note_result(False)
+        s = cache.stats()
+        assert s["entries"] == 0 and s["misses"] == 1
+
+    def test_insert_dedups_existing_blocks(self):
+        alloc, cache = self._cache()
+        toks = np.arange(8, dtype=np.int32)
+        first = alloc.alloc(2)
+        assert cache.insert(toks, first) == 2
+        # a second sequence wrote the same blocks independently: its
+        # pages are released, the canonical entries stay
+        second = alloc.alloc(2)
+        assert cache.insert(toks, second) == 0
+        assert all(alloc.refcount(p) == 0 for p in second)
+        assert cache.entries == 2
+
+    def test_lru_eviction_prefers_oldest_and_cascades(self):
+        alloc, cache = self._cache(num_pages=16)
+        old = np.asarray([1, 1, 1, 1, 2, 2, 2, 2], np.int32)
+        new = np.asarray([3, 3, 3, 3], np.int32)
+        cache.insert(old, alloc.alloc(2))
+        cache.insert(new, alloc.alloc(1))
+        # touch `new` so `old`'s chain is strictly older
+        _, hit = cache.lookup(
+            np.concatenate([new, [0]]).astype(np.int32), 4
+        )
+        alloc.free(hit)
+        free0 = alloc.free_count()
+        assert cache.evict(2) == 2
+        assert alloc.free_count() == free0 + 2
+        # the evicted chain is old's: leaf first, then its parent
+        n, _ = cache.lookup(
+            np.concatenate([old, [0]]).astype(np.int32), 8
+        )
+        assert n == 0
+        n, hit = cache.lookup(
+            np.concatenate([new, [0]]).astype(np.int32), 4
+        )
+        assert n == 4
+        alloc.free(hit)
+
+    def test_eviction_skips_pages_live_sequences_map(self):
+        alloc, cache = self._cache()
+        toks = np.arange(8, dtype=np.int32)
+        cache.insert(toks, alloc.alloc(2))
+        n, hit = cache.lookup(toks, 8)     # a "live sequence" forks
+        assert n == 8
+        assert cache.evict(5) == 0         # nothing evictable
+        assert cache.entries == 2
+        alloc.free(hit)                    # sequence ends
+        assert cache.evict(5) == 2         # now reclaimable
+        assert cache.entries == 0
+
+    def test_clear_releases_cache_references_only(self):
+        alloc, cache = self._cache()
+        toks = np.arange(8, dtype=np.int32)
+        cache.insert(toks, alloc.alloc(2))
+        n, hit = cache.lookup(toks, 8)
+        assert n == 8
+        assert cache.clear() == 2
+        # live fork keeps its pages; the cache's refs are gone
+        assert all(alloc.refcount(p) == 1 for p in hit)
+        alloc.free(hit)
+        assert alloc.free_count() == alloc.capacity
+
+
+# -- COW + cold-prefill oracle (decoder level) --------------------------------
+
+
+class TestCowAndHitOracle:
+    def test_forked_prefix_stays_bitwise_intact_under_divergent_decode(
+        self, frozen
+    ):
+        """The COW guarantee: a second sequence decoding through forked
+        prefix pages never mutates them — the shared pages' pool rows
+        are bitwise identical before and after its divergent decode."""
+        dec = make_paged_lm_decoder(
+            frozen, slots=2, page_size=4, prefill_chunk=8,
+            interpret=True, donate=False,
+        )
+        prompt = np.asarray([5, 9, 13, 2, 7, 1, 3, 4], np.int32)  # 2 pages
+        pools = dec.init_pools()
+        table_a = np.zeros(dec.max_pages, np.int32)
+        table_a[:4] = [1, 2, 3, 4]
+        pools, _ = dec.prefill(
+            pools, jnp.asarray(prompt), jnp.asarray(table_a),
+            jnp.asarray(np.int32(0)), jnp.asarray(np.int32(8)),
+        )
+        shared = [1, 2]                     # the full-prefix pages
+        before = [
+            (np.asarray(kp)[shared].copy(), np.asarray(vp)[shared].copy())
+            for kp, vp in pools
+        ]
+        # sequence B: forked prefix + its own suffix pages, divergent
+        # suffix prefill and a few decode steps
+        table_b = np.zeros(dec.max_pages, np.int32)
+        table_b[:4] = [1, 2, 5, 6]
+        suffix = np.asarray([9, 9, 6, 1, 0, 0, 0, 0], np.int32)
+        pools, _ = dec.prefill(
+            pools, jnp.asarray(suffix), jnp.asarray(table_b),
+            jnp.asarray(np.int32(8)), jnp.asarray(np.int32(12)),
+        )
+        tables = np.zeros((2, dec.max_pages), np.int32)
+        tables[0] = table_b
+        positions = np.zeros(2, np.int32)
+        toks = np.zeros(2, np.int32)
+        for t in (12, 13, 14):
+            positions[0], toks[0] = t, (t * 7) % 32
+            pools, _ = dec.decode(
+                pools, jnp.asarray(toks), jnp.asarray(tables),
+                jnp.asarray(positions),
+            )
+        after = [
+            (np.asarray(kp)[shared], np.asarray(vp)[shared])
+            for kp, vp in pools
+        ]
+        for (kb, vb), (ka, va) in zip(before, after):
+            np.testing.assert_array_equal(kb, ka)
+            np.testing.assert_array_equal(vb, va)
+
+    def test_hit_suffix_logprobs_equal_cold_prefill(self, frozen):
+        """A cache-hit admission prefills only the suffix, attending
+        through the shared pages — its log-probs must equal a cold
+        full-prompt prefill's at every suffix position."""
+        dec = make_paged_lm_decoder(
+            frozen, slots=1, page_size=4, prefill_chunk=8,
+            interpret=True, donate=False,
+        )
+        prompt = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(3), (12,), 0, 32),
+            np.int32,
+        )
+        # cold oracle: whole prompt through prefill in one table
+        cold_pools = dec.init_pools()
+        table = np.zeros(dec.max_pages, np.int32)
+        table[:3] = [1, 2, 3]
+        cold_lp = []
+        pools = cold_pools
+        for start in (0, 8):
+            pools, clp = dec.prefill(
+                pools, jnp.asarray(np.pad(prompt, (0, 4))[start:start + 8]),
+                jnp.asarray(table), jnp.asarray(np.int32(start)),
+                jnp.asarray(np.int32(12)),
+            )
+            cold_lp.extend(np.asarray(clp))
+        cold = np.stack(cold_lp)[:12]
+        # hit path: blocks 0-1 (8 tokens) come from the "cache" (the
+        # pages just written); a second sequence forks them and
+        # prefills only tokens 8..11 into its own page
+        hit_table = np.zeros(dec.max_pages, np.int32)
+        hit_table[:3] = [1, 2, 4]           # shared, shared, own
+        sfx = np.zeros(8, np.int32)
+        sfx[:4] = prompt[8:]
+        pools, hlp = dec.prefill(
+            pools, jnp.asarray(sfx), jnp.asarray(hit_table),
+            jnp.asarray(np.int32(8)), jnp.asarray(np.int32(12)),
+        )
+        hit = np.asarray(hlp)[:4]
+        np.testing.assert_allclose(
+            hit, cold[8:12], atol=1e-5, rtol=1e-5
+        )
+
+
+# -- engine: prefix cache -----------------------------------------------------
+
+
+class TestEnginePrefixCache:
+    def test_hit_skips_prefill_and_tokens_match_cold_engine(
+        self, frozen, contiguous, tmp_path
+    ):
+        dec = make_paged_lm_decoder(
+            frozen, slots=2, page_size=4, prefill_chunk=8, interpret=True,
+        )
+        shared = np.asarray([7, 3, 1, 4, 9, 2, 6, 5, 8, 1], np.int32)
+        ext = np.concatenate([shared, [11, 12]]).astype(np.int32)
+        outs = {}
+        with Telemetry(str(tmp_path / "tel"), heartbeat=False) as tel:
+            eng = LMEngine(
+                dec, queue_depth=8, telemetry=tel, prefix_cache=True,
+            ).start()
+            for name, prompt, n in (
+                ("cold", shared, 8), ("hit", shared, 8),
+                ("partial", ext, 5),
+            ):
+                req = eng.submit(prompt, n, time.monotonic() + 120)
+                toks, done = _drain_tokens(req)
+                assert done["status"] == "ok", done
+                outs[name] = toks
+            assert eng.recompiles_post_warmup == 0
+            assert eng.fence_error is None
+            stats = eng.prefix_cache_stats()
+            assert stats["entries"] > 0 and stats["hits"] == 2
+            held = eng.allocator.used_count()
+            assert held == stats["pages"], (
+                "idle engine: every held page should be the cache's"
+            )
+            eng.stop()
+            assert eng.allocator.used_count() == 0
+        # identical prompts, identical outputs (hit vs cold), and both
+        # equal the single-sequence oracle (fp-tolerance token match)
+        assert outs["hit"] == outs["cold"]
+        assert outs["cold"] == _greedy_ref(frozen, contiguous, shared, 8)
+        assert outs["partial"] == _greedy_ref(frozen, contiguous, ext, 5)
+        events = load_events(str(tmp_path / "tel" / "events.jsonl"))
+        admits = [e for e in events if e["kind"] == "lm_admit"]
+        hits = [e for e in events if e["kind"] == "lm_prefix_hit"]
+        assert admits[0]["cached_tokens"] == 0
+        assert admits[0]["prefill_tokens"] == 10
+        assert admits[1]["cached_tokens"] == 8     # 2 full pages
+        assert admits[1]["prefill_tokens"] == 2    # suffix only
+        assert admits[2]["cached_tokens"] == 8
+        assert len(hits) == 2
+        assert all(
+            h["prefill_tokens"] < h["prompt_tokens"] for h in hits
+        )
+        evicts = [e for e in events if e["kind"] == "lm_evict"]
+        assert any(e.get("pages_published", 0) > 0 for e in evicts)
+
+    def test_pool_pressure_evicts_lru_entries_for_admission(
+        self, frozen, tmp_path
+    ):
+        """With the pool sized so the cache's published pages block the
+        next admission, the engine reclaims cache-only entries instead
+        of wedging the queue."""
+        # 7 allocatable pages; a 10-token + 6-new request needs 4
+        dec = make_paged_lm_decoder(
+            frozen, slots=1, page_size=4, prefill_chunk=8, num_pages=8,
+            interpret=True,
+        )
+        with Telemetry(str(tmp_path / "tel"), heartbeat=False) as tel:
+            eng = LMEngine(
+                dec, queue_depth=4, telemetry=tel, prefix_cache=True,
+            ).start()
+            a = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9, 1], np.int32)
+            r1 = eng.submit(a, 6, time.monotonic() + 120)
+            _, d1 = _drain_tokens(r1)
+            assert d1["status"] == "ok"
+            assert eng.prefix_cache_stats()["pages"] > 0
+            # a DIFFERENT prompt needing most of the pool: the cached
+            # pages must be evicted to admit it
+            b = np.asarray([30, 29, 28, 27, 26, 25, 24, 23, 22, 21],
+                           np.int32)
+            r2 = eng.submit(b, 6, time.monotonic() + 120)
+            toks2, d2 = _drain_tokens(r2)
+            assert d2["status"] == "ok" and len(toks2) == 6
+            eng.stop()
+
+    def test_dispatch_failure_invalidates_the_index(
+        self, frozen, tmp_path
+    ):
+        """Rebuilt pools make cached page CONTENTS garbage: after a
+        donated-dispatch failure the index must be empty, and later
+        requests (cold misses) must still serve correctly."""
+        dec = make_paged_lm_decoder(
+            frozen, slots=1, page_size=4, prefill_chunk=8, interpret=True,
+        )
+        real_decode = dec.decode
+        fail = [False]
+
+        def flaky_decode(*args, **kw):
+            if fail[0]:
+                fail[0] = False
+                raise RuntimeError("simulated mid-dispatch failure")
+            return real_decode(*args, **kw)
+
+        dec = dec._replace(decode=flaky_decode)
+        with Telemetry(str(tmp_path / "tel"), heartbeat=False) as tel:
+            eng = LMEngine(
+                dec, queue_depth=4, telemetry=tel, prefix_cache=True,
+                recompile_fence=False,
+            ).start()
+            prompt = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9], np.int32)
+            r1 = eng.submit(prompt, 4, time.monotonic() + 120)
+            _, d1 = _drain_tokens(r1)
+            assert d1["status"] == "ok"
+            assert eng.prefix_cache_stats()["entries"] > 0
+            fail[0] = True
+            r2 = eng.submit(prompt, 4, time.monotonic() + 120)
+            _, d2 = _drain_tokens(r2)
+            assert d2["status"] == "error"
+            assert eng.prefix_cache_stats()["entries"] == 0
+            r3 = eng.submit(prompt, 4, time.monotonic() + 120)
+            toks3, d3 = _drain_tokens(r3)
+            assert d3["status"] == "ok" and len(toks3) == 4
+            eng.stop()
+        events = load_events(str(tmp_path / "tel" / "events.jsonl"))
+        admits = {e["id"]: e for e in events if e["kind"] == "lm_admit"}
+        assert admits[r3.id]["cached_tokens"] == 0   # nothing stale
+
+
+# -- engine: speculative decoding ---------------------------------------------
+
+
+class TestEngineSpecDecode:
+    def test_greedy_token_identity_across_staggered_streams(
+        self, frozen, contiguous, tmp_path
+    ):
+        """THE spec acceptance scenario: 3 staggered concurrent greedy
+        streams through a spec_k=4 engine are token-identical to (a)
+        the spec-off engine, (b) the verifier-alone (spec_k=1) engine,
+        and (c) the single-sequence oracle — with the budget-0 fence
+        green in every engine."""
+        prompts = [
+            np.asarray([1, 2, 3, 4, 5], np.int32),
+            np.asarray([9, 8, 7], np.int32),
+            np.asarray([4, 4, 4, 4, 4, 4, 4, 4, 4], np.int32),
+        ]
+        wants = [14, 3, 6]
+        outs = {}
+        for mode, spec_k in (("off", 0), ("verifier", 1), ("spec", 4)):
+            dec = make_paged_lm_decoder(
+                frozen, slots=2, page_size=4, prefill_chunk=8,
+                interpret=True, spec_k=spec_k,
+            )
+            with Telemetry(
+                str(tmp_path / f"tel_{mode}"), heartbeat=False
+            ) as tel:
+                eng = LMEngine(dec, queue_depth=8, telemetry=tel).start()
+                reqs = [
+                    eng.submit(p, n, time.monotonic() + 120)
+                    for p, n in zip(prompts, wants)
+                ]
+                results = [_drain_tokens(r) for r in reqs]
+                assert eng.recompiles_post_warmup == 0, mode
+                assert eng.fence_error is None, mode
+                if spec_k > 1:
+                    assert eng.spec_acceptance_rate is not None
+                    assert eng.spec_acceptance_rate > 0.5
+                assert eng.allocator.used_count() == 0
+                eng.stop()
+            assert all(d["status"] == "ok" for _, d in results), mode
+            outs[mode] = [t for t, _ in results]
+        assert outs["spec"] == outs["off"]
+        assert outs["spec"] == outs["verifier"]
+        for toks, prompt, n in zip(outs["spec"], prompts, wants):
+            assert toks == _greedy_ref(frozen, contiguous, prompt, n)
+        # counters: accepted + rejected == drafted, visible in metrics
+        events = load_events(str(tmp_path / "tel_spec" / "events.jsonl"))
+        spec_rounds = [e for e in events if e["kind"] == "lm_spec_round"]
+        assert not spec_rounds or all(
+            e["spec_k"] == 4 for e in spec_rounds
+        )
+
+    def test_exact_token_budget_and_stream_isolation(
+        self, frozen, contiguous
+    ):
+        """Spec rounds emit up to K tokens at once: a stream whose
+        budget ends mid-window must emit EXACTLY max_new_tokens, and a
+        slot finishing mid-round must not disturb its batchmate."""
+        dec = make_paged_lm_decoder(
+            frozen, slots=2, page_size=4, prefill_chunk=8,
+            interpret=True, spec_k=4,
+        )
+        eng = LMEngine(dec, queue_depth=4).start()
+        p1 = np.asarray([3, 1, 4], np.int32)
+        p2 = np.asarray([2, 7, 1, 8], np.int32)
+        # 5 and 9 are both non-multiples of the K=4 window
+        r1 = eng.submit(p1, 5, time.monotonic() + 120)
+        r2 = eng.submit(p2, 9, time.monotonic() + 120)
+        t1, d1 = _drain_tokens(r1)
+        t2, d2 = _drain_tokens(r2)
+        assert eng.fence_error is None
+        eng.stop()
+        assert (d1["status"], d2["status"]) == ("ok", "ok")
+        assert len(t1) == 5 and len(t2) == 9
+        assert t1 == _greedy_ref(frozen, contiguous, p1, 5)
+        assert t2 == _greedy_ref(frozen, contiguous, p2, 9)
+
+    def test_temperature_stream_falls_back_to_plain_rounds(
+        self, frozen, contiguous
+    ):
+        """A temperature stream in the batch disables spec for the
+        round (host-RNG draw accounting); it still samples
+        deterministically per seed, and the greedy batchmate stays
+        oracle-equal."""
+        dec = make_paged_lm_decoder(
+            frozen, slots=2, page_size=4, prefill_chunk=8,
+            interpret=True, spec_k=4,
+        )
+        eng = LMEngine(dec, queue_depth=4).start()
+        gp = np.asarray([1, 2, 3], np.int32)
+        sampled, greedy = [], []
+        for _ in range(2):
+            rt = eng.submit(
+                np.asarray([5, 6], np.int32), 6,
+                time.monotonic() + 120, temperature=0.8, seed=7,
+            )
+            rg = eng.submit(gp, 6, time.monotonic() + 120)
+            ts, ds = _drain_tokens(rt)
+            tg, dg = _drain_tokens(rg)
+            assert ds["status"] == "ok" and dg["status"] == "ok"
+            sampled.append(ts)
+            greedy.append(tg)
+        assert eng.fence_error is None
+        eng.stop()
+        # oracle AFTER stop: a fresh generate() shape would otherwise
+        # compile under the live engine's budget-0 fence
+        ref = _greedy_ref(frozen, contiguous, gp, 6)
+        assert greedy[0] == ref and greedy[1] == ref
+        assert sampled[0] == sampled[1]
+
+    def test_spec_with_chaos_infer_error_retries(self, frozen, tmp_path):
+        """Chaos transients fire BEFORE the round's dispatches: the
+        round retries and the stream still finishes ok with the full
+        token count."""
+        from distributed_mnist_bnns_tpu.resilience.chaos import (
+            ChaosController,
+        )
+
+        dec = make_paged_lm_decoder(
+            frozen, slots=1, page_size=4, prefill_chunk=8,
+            interpret=True, spec_k=4,
+        )
+        with Telemetry(str(tmp_path / "tel"), heartbeat=False) as tel:
+            chaos = ChaosController.from_config(
+                "infer_error@step=2,times=2", seed=0, telemetry=tel,
+            )
+            eng = LMEngine(
+                dec, queue_depth=4, telemetry=tel, chaos=chaos,
+            ).start()
+            req = eng.submit(
+                np.asarray([1, 2, 3], np.int32), 12,
+                time.monotonic() + 120,
+            )
+            toks, done = _drain_tokens(req)
+            assert eng.recompiles_post_warmup == 0
+            eng.stop()
+        assert done["status"] == "ok" and len(toks) == 12
+        events = load_events(str(tmp_path / "tel" / "events.jsonl"))
+        assert any(e["kind"] == "fault_injected" for e in events)
+        assert any(e["kind"] == "lm_decode_error" for e in events)
+
+    def test_spec_composes_with_prefix_cache(
+        self, frozen, contiguous, tmp_path
+    ):
+        """Both features in ONE engine: a forked-prefix admission
+        spec-decodes token-identically to the oracle, fence green,
+        every page back in the pool after stop."""
+        dec = make_paged_lm_decoder(
+            frozen, slots=2, page_size=4, prefill_chunk=8,
+            interpret=True, spec_k=4,
+        )
+        shared = np.asarray([7, 3, 1, 4, 9, 2, 6, 5, 8, 1], np.int32)
+        outs = []
+        with Telemetry(str(tmp_path / "tel"), heartbeat=False) as tel:
+            eng = LMEngine(
+                dec, queue_depth=8, telemetry=tel, prefix_cache=True,
+            ).start()
+            for n in (10, 6):
+                req = eng.submit(shared, n, time.monotonic() + 120)
+                toks, done = _drain_tokens(req)
+                assert done["status"] == "ok"
+                outs.append(toks)
+            assert eng.recompiles_post_warmup == 0
+            assert eng.fence_error is None
+            assert eng.prefix_cache_stats()["hits"] == 1
+            eng.stop()
+            assert eng.allocator.used_count() == 0
+        assert outs[0] == _greedy_ref(frozen, contiguous, shared, 10)
+        assert outs[1] == outs[0][:6]
+        events = load_events(str(tmp_path / "tel" / "events.jsonl"))
+        admits = [e for e in events if e["kind"] == "lm_admit"]
+        assert admits[1]["cached_tokens"] == 8
+
+
+# -- AOT: the verify program banks and the triple is all-or-nothing -----------
+
+
+class TestAotVerifyTriple:
+    def test_triple_roundtrip_and_pair_only_is_a_miss(self, tmp_path):
+        from distributed_mnist_bnns_tpu.aot import (
+            AotStore,
+            load_paged_lm_decoder_aot,
+        )
+
+        model = BinarizedLM(
+            vocab=32, max_len=32, embed_dim=32, depth=1, num_heads=2,
+            attention="xla", backend="xla",
+        )
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+        artifact = str(tmp_path / "lm.msgpack")
+        export_packed(model, variables, artifact)
+        store_dir = str(tmp_path / "store")
+        kw = dict(slots=2, page_size=4, prefill_chunk=8, interpret=True)
+        # bank the plain PAIR first
+        _, _, meta = load_paged_lm_decoder_aot(
+            artifact, store=AotStore(store_dir), **kw
+        )
+        assert meta["status"] == "miss"
+        _, _, meta = load_paged_lm_decoder_aot(
+            artifact, store=AotStore(store_dir), **kw
+        )
+        assert meta["status"] == "hit"
+        # spec armed: the pair alone must NOT hit (triple discipline)
+        dec, _, meta = load_paged_lm_decoder_aot(
+            artifact, store=AotStore(store_dir), spec_k=3, **kw
+        )
+        assert meta["status"] == "miss"
+        assert dec.verify is not None and dec.spec_k == 3
+        # now the triple is banked: hit, with a callable verify
+        dec, _, meta = load_paged_lm_decoder_aot(
+            artifact, store=AotStore(store_dir), spec_k=3, **kw
+        )
+        assert meta["status"] == "hit"
+        assert len(meta["digests"]) == 3
+        assert dec.verify is not None and dec.spec_k == 3
+        eng = LMEngine(dec, queue_depth=4).start()
+        req = eng.submit(
+            np.asarray([1, 2, 3], np.int32), 4, time.monotonic() + 120
+        )
+        toks, done = _drain_tokens(req)
+        eng.stop()
+        assert done["status"] == "ok" and len(toks) == 4

@@ -68,6 +68,90 @@ class TestPageAllocator:
         with pytest.raises(ValueError, match="null page"):
             PageAllocator(1)
 
+    def test_fork_shares_until_last_release(self):
+        """COW lifecycle: a forked page survives its first release and
+        only returns to the pool at refcount 0 — where the double-free
+        hard error re-arms for the last holder."""
+        a = PageAllocator(4)
+        pages = a.alloc(2)
+        a.fork(pages)                          # second holder
+        assert all(a.refcount(p) == 2 for p in pages)
+        a.free(pages)                          # first holder releases
+        assert a.free_count() == 1             # still held once
+        assert all(a.refcount(p) == 1 for p in pages)
+        a.free(pages)                          # last holder releases
+        assert a.free_count() == 3
+        with pytest.raises(ValueError, match="double free"):
+            a.free(pages)
+
+    def test_fork_of_free_page_rejected(self):
+        a = PageAllocator(4)
+        pages = a.alloc(1)
+        a.free(pages)
+        with pytest.raises(ValueError, match="fork of free page"):
+            a.fork(pages)
+        with pytest.raises(ValueError, match="cannot fork"):
+            a.fork([NULL_PAGE])
+
+    def test_within_call_duplicate_free_rejected_even_when_shared(self):
+        """One owner listing the same page twice in one free() call is
+        a double-free even while other holders keep the page alive."""
+        a = PageAllocator(4)
+        (p,) = a.alloc(1)
+        a.fork([p])                            # refcount 2
+        with pytest.raises(ValueError, match="double free"):
+            a.free([p, p])
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fork_release_interleavings_never_double_free(self, seed):
+        """Property test: random alloc/fork/free interleavings across
+        simulated sequences never double-free and never free a page
+        another sequence still maps — the refcount model tracks every
+        page exactly."""
+        rng = np.random.RandomState(seed)
+        a = PageAllocator(9)
+        holders = []                  # list of page-lists (one ref each)
+        model_refs = {}               # page -> live reference count
+        for _ in range(300):
+            op = rng.randint(3)
+            if op == 0:               # alloc a fresh run of pages
+                n = int(rng.randint(1, 4))
+                got = a.alloc(n)
+                expected_free = a.capacity - sum(
+                    1 for r in model_refs.values() if r > 0
+                )
+                if expected_free < n:
+                    assert got is None
+                    continue
+                assert got is not None
+                for p in got:
+                    # a page with live references must never be
+                    # handed out again
+                    assert model_refs.get(p, 0) == 0
+                    model_refs[p] = 1
+                holders.append(list(got))
+            elif op == 1 and holders:  # fork an existing holder's pages
+                src = holders[rng.randint(len(holders))]
+                a.fork(src)
+                for p in src:
+                    model_refs[p] += 1
+                holders.append(list(src))
+            elif op == 2 and holders:  # release one holder
+                i = rng.randint(len(holders))
+                pages = holders.pop(i)
+                a.free(pages)
+                for p in pages:
+                    model_refs[p] -= 1
+                    assert model_refs[p] >= 0
+            for p, r in model_refs.items():
+                assert a.refcount(p) == r
+        # drain every holder; the pool must close out exactly
+        for pages in holders:
+            a.free(pages)
+        assert a.free_count() == a.capacity
+        with pytest.raises(ValueError, match="double free"):
+            a.free([next(iter(model_refs))] if model_refs else [1])
+
 
 class TestIndices:
     def test_write_indices_batch_tables(self):
