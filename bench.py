@@ -289,6 +289,7 @@ SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
     "device_resident_epoch", "train_step_per_backend", "comm",
     "comm_fsdp", "lm_serve", "serving_p99", "cold_start",
+    "device_costs",
 )
 
 
@@ -1331,6 +1332,82 @@ def _bench_lm_serve(args, deadline):
     return out
 
 
+def _bench_device_costs(args, deadline):
+    """Per-program cost-ledger section (--device-costs-bench; ROADMAP
+    item 5's MFU slice, OBSERVABILITY.md "Device profiling"): the
+    classifier train step is explicitly lowered + compiled, its
+    ``cost_analysis``/``memory_analysis`` banked, the cost-model flops
+    reconciled against the analytic obs/flops walk (the two agreeing is
+    the tested invariant — XLA's model counts optimizer/elementwise
+    noise the 3x2xMACs convention excludes, so the ratio sits near but
+    above 1), and measured MFU derived from timed dispatches of the
+    same jitted program. ``cost_flops`` is deterministic for a fixed
+    model/batch/jax version, so the perf gate bands it EXACTLY (like
+    the wire bytes); ``mfu_measured`` gets a wide floor."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.obs import peak_for_default_device
+    from distributed_mnist_bnns_tpu.obs.costs import extract_costs
+    from distributed_mnist_bnns_tpu.obs.flops import mfu as mfu_of
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    bs = min(args.batch_size, 256)
+    input_shape = (28, 28, 1)
+    trainer = Trainer(
+        TrainConfig(
+            model=args.model, batch_size=bs, optimizer="adam",
+            learning_rate=0.01, backend="bf16", seed=0,
+        ),
+        input_shape=input_shape,
+    )
+    key = jax.random.PRNGKey(0)
+    images = jax.random.normal(key, (bs, *input_shape), jnp.float32)
+    labels = jax.random.randint(key, (bs,), 0, 10)
+    compiled = trainer.train_step.lower(
+        trainer.state, images, labels, trainer.rng
+    ).compile()
+    costs = extract_costs(compiled)
+    analytic = trainer._step_flops
+    # Timed dispatches of the SAME jitted program (the compile above
+    # warmed nothing for the jit — pay its own warmup first).
+    for _ in range(3):
+        trainer.state, m = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+    jax.block_until_ready(m)
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.state, m = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+    jax.block_until_ready(m)
+    mean_s = (time.perf_counter() - t0) / steps
+    peak, precision = peak_for_default_device()
+    out = {
+        "program": "train_step",
+        "model": args.model,
+        "batch_size": bs,
+        "cost_flops": costs.get("flops"),
+        "cost_bytes_accessed": costs.get("bytes_accessed"),
+        "hbm": costs.get("hbm"),
+        "analytic_flops": analytic,
+        "flops_method": trainer._flops_method,
+        "mean_step_ms": round(mean_s * 1e3, 3),
+        "mfu_measured": mfu_of(costs.get("flops"), mean_s, peak),
+        "mfu_analytic": mfu_of(analytic, mean_s, peak),
+        "peak_precision": precision,
+    }
+    if costs.get("flops") and analytic:
+        out["flops_ratio_cost_over_analytic"] = round(
+            costs["flops"] / analytic, 4
+        )
+    if costs.get("reason"):
+        out["cost_reason"] = costs["reason"]
+    return out
+
+
 def _bench_cold_start(args, deadline):
     """Cold-start benchmark (--cold-start-bench; PERF.md "Cold start"):
     time-to-first-token for `cli serve` / `cli serve --lm` and
@@ -1491,6 +1568,12 @@ def main() -> None:
                         "saturation through the real serving engine "
                         "(serve/harness.py): the gateable Tail-at-Scale "
                         "number the perf gate bands (ROADMAP item 5)")
+    p.add_argument("--device-costs-bench", action="store_true",
+                   help="per-program HLO cost-ledger section "
+                        "(OBSERVABILITY.md 'Device profiling'): "
+                        "cost-analysis flops vs the analytic walk for "
+                        "the train step, plus measured MFU — the "
+                        "perf gate's MFU-floor feed")
     p.add_argument("--cold-start-bench", action="store_true",
                    help="measure cold-store vs warm-store boot: "
                         "time-to-first-token for cli serve and cli "
@@ -1905,11 +1988,41 @@ def main() -> None:
                 serving_p99_section,
             )
 
-            result["serving_p99"] = serving_p99_section(
-                interpret=jax.default_backend() != "tpu",
-            )
+            # With an events mirror requested, give the probe's engine
+            # its own traced telemetry dir next to the mirror: the perf
+            # gate reads the request span trees from it to EXPLAIN a
+            # tripped serving band (`cli trace` tail attribution).
+            p99_tel = None
+            p99_dir = None
+            if args.events:
+                from distributed_mnist_bnns_tpu.obs import Telemetry
+
+                p99_dir = os.path.join(
+                    os.path.dirname(os.path.abspath(args.events)) or ".",
+                    "serving_p99",
+                )
+                p99_tel = Telemetry(
+                    p99_dir, heartbeat=False, trace=True
+                )
+            try:
+                result["serving_p99"] = serving_p99_section(
+                    interpret=jax.default_backend() != "tpu",
+                    telemetry=p99_tel,
+                )
+                if p99_dir is not None:
+                    result["serving_p99"]["events_dir"] = p99_dir
+            finally:
+                if p99_tel is not None:
+                    p99_tel.close()
         except Exception as e:  # never let the extra kill the bench line
             result["serving_p99"] = f"failed: {e!r:.300}"
+
+    if args.device_costs_bench and time.monotonic() < deadline - 60:
+        try:
+            _progress("device_costs: per-program cost-ledger section")
+            result["device_costs"] = _bench_device_costs(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["device_costs"] = f"failed: {e!r:.300}"
 
     if args.comm_bench and time.monotonic() < deadline - 60:
         try:

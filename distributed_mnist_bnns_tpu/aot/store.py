@@ -393,10 +393,27 @@ class AotStore:
         ``(executable, status)`` with status ``hit`` | ``miss``."""
         loaded = self.get(key, in_tree=in_tree, out_tree=out_tree)
         if loaded is not None:
+            self._record_cost(key.name, loaded, "aot_hit")
             return loaded, "hit"
         compiled = build()
         self.put(key, compiled, meta=meta)
+        self._record_cost(key.name, compiled, "aot_miss")
         return compiled, "miss"
+
+    def _record_cost(self, name: str, compiled: Any, source: str) -> None:
+        """Bank the executable's HLO costs in the per-program ledger
+        (obs/costs; off-by-default, one attribute check). Both branches
+        hold a real ``Compiled`` — the hit path's deserialized
+        executable included — so the analysis performs no trace and no
+        compile: the budget-0 boot fence stays green with costs armed."""
+        from ..obs.costs import get_ledger
+
+        ledger = get_ledger()
+        if not ledger.enabled:
+            return
+        ledger.record(
+            name, compiled, telemetry=self.telemetry, source=source,
+        )
 
     # -- inventory (cli aot ls / gc) -----------------------------------------
 

@@ -62,6 +62,15 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "trained epoch's early steps here")
+        sp.add_argument("--profile-steps", default=None, metavar="A:B",
+                        help="step-windowed device capture "
+                             "(OBSERVABILITY.md 'Device profiling'): "
+                             "start the jax.profiler trace at "
+                             "cumulative optimizer step A, stop at B, "
+                             "into --profile-dir (or <telemetry-dir>/"
+                             "profile); summarize with `cli profile`. "
+                             "Supersedes the first-epoch --profile-dir "
+                             "heuristic")
         sp.add_argument("--telemetry-dir", default=None,
                         help="write structured run telemetry here: JSONL "
                              "events (manifest/step/epoch/checkpoint), "
@@ -353,6 +362,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "across processes by the x-jg-trace header; "
                          "read back with `cli trace`. Default: the "
                          "JG_TRACE env var; needs --telemetry-dir")
+    sv.add_argument("--costs", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="per-program HLO cost ledger + measured MFU "
+                         "(OBSERVABILITY.md 'Device profiling'): "
+                         "cost_analysis/memory_analysis at every "
+                         "compile, per-program MFU in /healthz. "
+                         "Default: the JG_COSTS env var")
+    sv.add_argument("--events-max-bytes", type=int, default=None,
+                    help="size-rotate the events.jsonl past this many "
+                         "bytes (long-lived servers; readers span the "
+                         "rotated segments). Default: the "
+                         "JG_EVENTS_MAX_BYTES env var, else unbounded")
     sv.add_argument("--chaos", default=None, metavar="SPEC",
                     help="serving fault injection (RESILIENCE.md): "
                          "e.g. 'infer_error@step=4,times=3;"
@@ -459,6 +480,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "report (default: 99)")
     tc.add_argument("--json", action="store_true",
                     help="emit the attribution report as JSON")
+    pf = sub.add_parser(
+        "profile",
+        help="summarize a jax.profiler capture directory (from "
+             "POST /admin/profile, `train --profile-steps A:B` or "
+             "--profile-dir) in the terminal: top ops by total time, "
+             "compile split, and the x-jg-trace ids its step markers "
+             "carry (OBSERVABILITY.md 'Device profiling'). For the "
+             "full timeline open the trace in ui.perfetto.dev",
+    )
+    pf.add_argument("dir",
+                    help="capture directory (the /admin/profile "
+                         "response's `dir`)")
+    pf.add_argument("--top", type=int, default=15,
+                    help="ops to list (default 15)")
+    pf.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
     ln = sub.add_parser(
         "lint",
         help="run the repo linter (JAX footguns JG001-JG006 + "
@@ -628,6 +665,7 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10,
         device_data=args.device_data,
         aot=getattr(args, "aot", False),
         aot_dir=getattr(args, "aot_dir", None),
+        profile_step_window=getattr(args, "profile_steps", None),
     )
     if overrides:
         config = dataclasses.replace(config, **overrides)
@@ -966,6 +1004,21 @@ def main(argv=None) -> int:
               else render_attribution(report))
         return 0
 
+    if args.cmd == "profile":
+        # Pure host-side capture reading (gzip + json): no jax backend.
+        import json
+
+        from .obs import render_capture_summary, summarize_capture
+
+        try:
+            summary = summarize_capture(args.dir, top=args.top)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        print(json.dumps(summary) if args.json
+              else render_capture_summary(summary))
+        return 0
+
     if args.cmd == "lm":
         from .utils import setup_logging
 
@@ -1085,6 +1138,8 @@ def main(argv=None) -> int:
                 trace=args.trace,
                 prefix_cache=args.prefix_cache,
                 spec_decode=args.spec_decode,
+                costs=args.costs,
+                events_max_bytes=args.events_max_bytes,
             ))
             return lm_server.run()
 
@@ -1113,6 +1168,8 @@ def main(argv=None) -> int:
             aot=args.aot,
             aot_dir=args.aot_dir,
             trace=args.trace,
+            costs=args.costs,
+            events_max_bytes=args.events_max_bytes,
         ))
         return server.run()
 

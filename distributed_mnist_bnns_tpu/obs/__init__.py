@@ -6,8 +6,13 @@ parallel backends and bench.py. See OBSERVABILITY.md for the event
 schema and how to read a run.
 
   registry   thread-safe counters/gauges/histograms + snapshot()
-  events     structured JSONL run events + run manifest
+  events     structured JSONL run events + run manifest (size-rotated
+             for long-lived servers; readers span segments)
   flops      analytic model FLOPs, chip peaks, MFU, HBM stats
+  costs      per-program HLO cost ledger (cost_analysis/memory_analysis
+             at every compile) + measured per-program MFU
+  profile    on-demand jax.profiler captures (/admin/profile,
+             --profile-steps) + the `profile` CLI's capture summary
   recompile  jit cache-miss counting (jax.monitoring + spike fallback)
   heartbeat  per-process liveness records
   telemetry  the facade the training/serving layers talk to
@@ -17,6 +22,7 @@ schema and how to read a run.
              (the `trace` CLI)
 """
 
+from .costs import CostLedger, extract_costs, get_ledger
 from .events import (
     EventLog,
     MANIFEST_KIND,
@@ -37,6 +43,13 @@ from .flops import (
     train_step_flops,
 )
 from .heartbeat import Heartbeat, read_heartbeats
+from .profile import (
+    ProfileBusyError,
+    ProfileManager,
+    get_profiler,
+    render_capture_summary,
+    summarize_capture,
+)
 from .recompile import RecompileTracker, get_tracker
 from .registry import (
     Counter,
@@ -63,6 +76,7 @@ from .trace import (
 )
 
 __all__ = [
+    "CostLedger",
     "Counter",
     "DEFAULT_TIME_BUCKETS",
     "EventLog",
@@ -71,6 +85,8 @@ __all__ = [
     "Histogram",
     "MANIFEST_KIND",
     "MetricsRegistry",
+    "ProfileBusyError",
+    "ProfileManager",
     "RecompileTracker",
     "SCHEMA_VERSION",
     "TRACE_HEADER",
@@ -83,7 +99,10 @@ __all__ = [
     "dense_macs_per_example",
     "device_memory_stats",
     "device_peak_flops",
+    "extract_costs",
     "format_header",
+    "get_ledger",
+    "get_profiler",
     "get_tracker",
     "git_rev",
     "jaxpr_macs_per_example",
@@ -96,9 +115,11 @@ __all__ = [
     "peak_for_default_device",
     "read_events",
     "read_heartbeats",
+    "render_capture_summary",
     "render_prometheus",
     "render_table",
     "summarize",
+    "summarize_capture",
     "tail_attribution",
     "to_chrome_trace",
     "train_step_flops",

@@ -1,0 +1,297 @@
+"""Per-program HLO cost ledger — what a compiled program *costs*.
+
+obs/flops.py answers "what SHOULD a step cost" analytically; this module
+banks what XLA says each compiled program actually costs: at every
+compile (online boot, AOT store hit/miss, trainer init) the executable's
+``cost_analysis()`` and ``memory_analysis()`` are folded into one row
+per program name — {flops, bytes accessed, argument/output/temp/peak
+HBM} — alongside measured dispatch times fed from the serving/training
+hot paths, so **measured MFU per program** (cost-analysis flops ÷ mean
+dispatch time ÷ chip peak) is derivable live (/healthz), from a bench
+record (``bench.py --device-costs-bench``), and post-hoc from an events
+dir alone (``cli telemetry`` ``programs`` section, via the
+``program_cost`` events + the closing ``metrics`` snapshot's
+``program_dispatch_seconds`` histogram).
+
+Reconciliation invariant: for the classifier train step, the
+cost-analysis flops and the analytic ``obs/flops.train_step_flops``
+walk must agree within a small factor (XLA's model counts elementwise/
+optimizer noise the 3×2×MACs convention deliberately excludes, so they
+are close but not equal) — tested per backend, and the disagreement
+surfacing IS the signal (a backend whose GEMMs stopped lowering to
+``dot``/``conv`` shows up as a ratio jump long before a wall-clock
+regression does).
+
+Cost discipline (OBSERVABILITY.md "Device profiling"):
+
+  * **off by default** — every hot-path feed (``observe``) and every
+    compile-site hook (``record``) starts with one attribute check on
+    ``enabled`` and returns; arming is ``JG_COSTS=1`` or the serving
+    ``--costs`` flag;
+  * **armed, it must keep a budget-0 recompile fence green** — on
+    executables that already expose ``cost_analysis`` (``Compiled``,
+    incl. AOT-deserialized ones) ``record`` touches only the object in
+    hand, no trace, no compile. A jitted (not-yet-lowered) function is
+    only analyzed when the caller passes ``example_args`` — that path
+    performs one throwaway ``lower().compile()`` and is therefore
+    reserved for pre-fence boot windows (cold boots, trainer init);
+  * failures degrade to a row with a ``reason`` — a backend whose cost
+    model is unavailable (remote-compile tunnels) must never take down
+    the boot that asked.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_COSTS = "JG_COSTS"
+
+PROGRAM_COMPILES_TOTAL = "program_compiles_total"
+PROGRAM_DISPATCH_SECONDS = "program_dispatch_seconds"
+PROGRAM_FLOPS = "program_flops"
+
+# Dispatch-latency buckets (seconds): serving decode iterations sit in
+# the 100us-10ms range on CPU, train steps up to seconds.
+_DISPATCH_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def extract_costs(compiled: Any) -> Dict[str, Any]:
+    """Normalize one executable's ``cost_analysis()`` +
+    ``memory_analysis()`` into a plain JSON-able row. Never raises:
+    an unavailable cost model yields ``{"reason": ...}``."""
+    row: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        # jax returns one properties-dict per computation (usually one).
+        if isinstance(ca, dict):
+            ca = [ca]
+        flops = 0.0
+        bytes_accessed = 0.0
+        for props in ca or []:
+            flops += float(props.get("flops", 0.0) or 0.0)
+            bytes_accessed += float(
+                props.get("bytes accessed", 0.0) or 0.0
+            )
+        row["flops"] = flops
+        row["bytes_accessed"] = bytes_accessed
+    except Exception as e:  # cost model unavailable on this backend
+        row["reason"] = f"cost_analysis: {type(e).__name__}: {e}"[:200]
+    try:
+        ma = compiled.memory_analysis()
+        hbm = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "generated_code_bytes": int(
+                ma.generated_code_size_in_bytes
+            ),
+        }
+        # The executable's worst-case live footprint: arguments +
+        # outputs + scratch (aliased bytes are counted once — they
+        # overlay an argument).
+        hbm["peak_bytes"] = (
+            hbm["argument_bytes"] + hbm["output_bytes"]
+            + hbm["temp_bytes"] - hbm["alias_bytes"]
+        )
+        row["hbm"] = hbm
+    except Exception as e:
+        row.setdefault(
+            "reason", f"memory_analysis: {type(e).__name__}: {e}"[:200]
+        )
+    return row
+
+
+class CostLedger:
+    """Process-wide per-program cost + dispatch-time accounting.
+
+    ``record`` banks an executable's static costs under a program name
+    (idempotent-ish: a reload/rebank overwrites the row — the ledger
+    describes the SERVING program); ``observe`` feeds measured dispatch
+    seconds from the hot paths (one attribute check + a locked float
+    add when armed, one attribute check when not); ``snapshot`` joins
+    both into per-program measured MFU."""
+
+    def __init__(
+        self, registry: Any = None, *, enabled: Optional[bool] = None,
+    ):
+        if enabled is None:
+            enabled = os.environ.get(ENV_COSTS, "") not in ("", "0")
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._programs: Dict[str, Dict[str, Any]] = {}
+        self._times: Dict[str, Dict[str, float]] = {}
+        if registry is None:
+            from .registry import default_registry
+
+            registry = default_registry()
+        self._registry = registry
+        self._compiles_ctr = registry.counter(
+            PROGRAM_COMPILES_TOTAL,
+            "cost-analyzed program compiles (program, source labels)",
+        )
+        # Cached handle: observe() runs on dispatch hot paths — the
+        # registry's get-or-create lookup must not be paid per call.
+        # Created lazily on the first ARMED observe, so a disabled
+        # ledger registers nothing (disabled-mode inertness).
+        self._dispatch_hist = None
+
+    # -- compile-site hook ---------------------------------------------------
+
+    def record(
+        self,
+        name: str,
+        executable: Any,
+        *,
+        example_args: Any = None,
+        telemetry: Any = None,
+        source: str = "online",
+        **extra: Any,
+    ) -> Optional[Dict[str, Any]]:
+        """Bank ``executable``'s costs under ``name``.
+
+        An object exposing ``cost_analysis`` (a ``Compiled``, incl.
+        AOT-deserialized) is analyzed in place — no compile. A jitted
+        function is analyzed only when ``example_args`` is given, via a
+        throwaway ``lower(*example_args).compile()`` — that DOES fire a
+        backend compile, so callers reserve it for pre-fence boot
+        windows. Emits one ``program_cost`` event when ``telemetry`` is
+        attached. No-op (one attribute check) when disabled."""
+        if not self.enabled:
+            return None
+        row: Dict[str, Any] = {"program": name, "source": source}
+        try:
+            target = executable
+            if not hasattr(target, "cost_analysis"):
+                if example_args is None or not hasattr(target, "lower"):
+                    row["reason"] = "no cost_analysis and no example_args"
+                    target = None
+                else:
+                    # Throwaway analysis compile (boot window only).
+                    target = target.lower(*example_args).compile()
+            if target is not None:
+                row.update(extract_costs(target))
+        except Exception as e:  # never take down the boot that asked
+            log.warning("cost record for %s failed: %s", name, e)
+            row["reason"] = f"{type(e).__name__}: {e}"[:200]
+        row.update(extra)
+        with self._lock:
+            self._programs[name] = row
+        self._compiles_ctr.inc(program=name, source=source)
+        if row.get("flops"):
+            self._registry.gauge(
+                PROGRAM_FLOPS, "cost-analysis flops per dispatch"
+            ).set(row["flops"], program=name)
+        if telemetry is not None:
+            try:
+                telemetry.emit("program_cost", **row)
+            except Exception:  # telemetry is best-effort here
+                log.debug("program_cost emit failed", exc_info=True)
+        return row
+
+    # -- hot-path dispatch-time feed -----------------------------------------
+
+    def observe(self, name: str, seconds: float, n: int = 1) -> None:
+        """Feed ``n`` dispatches of ``name`` totalling ``seconds``.
+        Call sites guard with ``if ledger.enabled`` so the disabled
+        cost is exactly one attribute check."""
+        if not self.enabled:
+            return
+        n = max(int(n), 1)
+        with self._lock:
+            t = self._times.setdefault(name, {"n": 0.0, "s": 0.0})
+            t["n"] += n
+            t["s"] += float(seconds)
+        hist = self._dispatch_hist
+        if hist is None:
+            # Idempotent get-or-create; a racing first observe caches
+            # the same instrument.
+            hist = self._dispatch_hist = self._registry.histogram(
+                PROGRAM_DISPATCH_SECONDS,
+                "measured dispatch latency per cost-analyzed program",
+                buckets=_DISPATCH_BUCKETS,
+            )
+        # One histogram observation PER DISPATCH (the per-dispatch mean
+        # repeated n times), so the series count/sum agree with the
+        # internal tally — post-hoc readers joining this histogram get
+        # the same dispatch counts /healthz reports. n is small
+        # (spec drafts, prefill chunks); the loop is a few locked adds.
+        per = float(seconds) / n
+        for _ in range(n):
+            hist.observe(per, program=name)
+
+    # -- reads ---------------------------------------------------------------
+
+    def costs(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            row = self._programs.get(name)
+            return dict(row) if row else None
+
+    def measured_mfu(self, name: str) -> Optional[float]:
+        """flops-per-dispatch ÷ mean dispatch seconds ÷ chip peak —
+        None until both a cost row and a dispatch observation exist."""
+        with self._lock:
+            row = self._programs.get(name)
+            t = self._times.get(name)
+        if not row or not row.get("flops") or not t or not t["n"]:
+            return None
+        from .flops import mfu
+        from .telemetry import peak_for_default_device
+
+        peak, _ = peak_for_default_device()
+        return mfu(row["flops"], t["s"] / t["n"], peak)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-program view for /healthz and bench sections: static
+        costs + dispatch count/mean + measured MFU."""
+        with self._lock:
+            programs = {k: dict(v) for k, v in self._programs.items()}
+            times = {k: dict(v) for k, v in self._times.items()}
+        from .flops import mfu
+        from .telemetry import peak_for_default_device
+
+        peak, precision = peak_for_default_device()
+        for name, row in programs.items():
+            t = times.get(name)
+            if t and t["n"]:
+                mean_s = t["s"] / t["n"]
+                row["dispatches"] = int(t["n"])
+                row["mean_dispatch_ms"] = round(mean_s * 1e3, 4)
+                m = mfu(row.get("flops"), mean_s, peak)
+                if m is not None:
+                    row["mfu"] = m
+                    row["peak_precision"] = precision
+        return programs
+
+
+_ledger: Optional[CostLedger] = None
+_ledger_lock = threading.Lock()
+
+
+def get_ledger() -> CostLedger:
+    """The process-wide ledger every compile site and hot path feeds
+    (compiles are a process property, like recompile counts)."""
+    global _ledger
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = CostLedger()
+        return _ledger
+
+
+def arm_ledger(flag: Optional[bool]) -> CostLedger:
+    """The process ledger with an explicit-flag override — the one
+    arming precedence both serving front ends share: an explicit
+    ``--costs``/``--no-costs`` wins; None keeps the JG_COSTS env
+    default the ledger was constructed with."""
+    ledger = get_ledger()
+    if flag is not None:
+        ledger.enabled = bool(flag)
+    return ledger

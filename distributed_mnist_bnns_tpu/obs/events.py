@@ -67,12 +67,31 @@ Event kinds (schema v1):
                  status, tid, attrs — the per-request span trees
                  `cli trace` folds into Perfetto exports and tail
                  attribution (OBSERVABILITY.md "Tracing")
+  program_cost   one compiled program's HLO cost row (obs/costs):
+                 flops, bytes accessed, argument/output/temp/peak HBM,
+                 source=online|aot_hit|aot_miss — the per-program cost
+                 ledger behind measured MFU (OBSERVABILITY.md "Device
+                 profiling")
+  profile_capture  an on-demand jax.profiler capture completed
+                 (obs/profile): artifact dir, file count, total bytes,
+                 wall duration — /admin/profile and `cli train
+                 --profile-steps` both emit it
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's
 ``if rank == 0`` print guards. Heartbeats intentionally bypass that rule
 (every process writes its own file) so a stalled non-primary host is
 diagnosable after the fact.
+
+Rotation: long-lived servers grow span/request-heavy logs without
+bound, so ``EventLog(max_bytes=...)`` rotates in place — the live file
+is renamed to ``events.jsonl.<seq>`` (ascending = older) and reopened
+fresh, keeping the newest ``keep_segments`` segments (the heartbeat
+history's bound-the-file discipline, segment-shaped because readers
+must still see one continuous stream). ``read_events`` — and therefore
+``cli trace`` / ``cli telemetry`` / ``summarize`` — reads across the
+surviving segments in order; rotations are counted by the owner (the
+``events_rotated_total`` counter Telemetry wires up).
 """
 
 from __future__ import annotations
@@ -172,6 +191,8 @@ class EventLog:
     def __init__(
         self, path: str, *, primary_only: bool = True,
         flush_every: int = 32,
+        max_bytes: Optional[int] = None,
+        keep_segments: int = 4,
     ):
         self.path = path
         self._active = is_primary_host() or not primary_only
@@ -179,6 +200,16 @@ class EventLog:
         self._manifest_written = False
         self._flush_every = max(int(flush_every), 1)
         self._unflushed = 0
+        # Size-based rotation (module docstring): None = unbounded
+        # (training runs are epoch-bounded; only long-lived servers
+        # need the cap). Rotation happens on flush boundaries only, so
+        # a segment can overshoot by at most one flush batch.
+        self._max_bytes = int(max_bytes) if max_bytes else None
+        self._keep_segments = max(int(keep_segments), 1)
+        self.rotations = 0             # guarded-by: _lock
+        self.on_rotate = None          # owner's counter hook
+        self._size = 0                 # guarded-by: _lock
+        self._manifest_record = None   # re-emitted into fresh segments
         # One log is written from many threads (trainer + heartbeat +
         # async checkpointer; the serving engine worker + HTTP handler
         # threads + drain): TextIOWrapper writes are not thread-safe,
@@ -188,6 +219,10 @@ class EventLog:
         if self._active:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a")
+            try:
+                self._size = os.path.getsize(path)
+            except OSError:
+                self._size = 0
 
     @property
     def active(self) -> bool:
@@ -199,18 +234,70 @@ class EventLog:
             return
         record = {"v": SCHEMA_VERSION, "kind": kind, "ts": utc_now()}
         record.update({k: _jsonable(v) for k, v in fields.items()})
+        if kind == MANIFEST_KIND and not record.get("rotated_copy"):
+            # Keep the run-scoping record survivable: rotation prunes
+            # old segments, so each fresh segment re-opens with a
+            # marked copy of the manifest (see _rotate_locked).
+            self._manifest_record = record
         line = json.dumps(record) + "\n"
+        rotated = False
         with self._lock:
             if self._fh is None:  # closed concurrently
                 return
             # jg: disable=JG009 -- serializing THIS write is the lock's whole job (interleaved TextIOWrapper writes mangle lines); the json encode already ran outside it
             self._fh.write(line)
+            self._size += len(line)
             self._unflushed += 1
             if (kind not in self.BUFFERED_KINDS
                     or self._unflushed >= self._flush_every):
                 # jg: disable=JG009 -- same critical section: the flush must pair with the write it flushes; the buffered-kind policy bounds how often hot paths hit it
                 self._fh.flush()
                 self._unflushed = 0
+                if (self._max_bytes is not None
+                        and self._size >= self._max_bytes):
+                    # jg: disable=JG009 -- rotation must swap the handle every writer is serialized on; it runs only when a flushed segment crossed max_bytes, never on the per-record path
+                    self._rotate_locked()
+                    rotated = True
+        if rotated and self.on_rotate is not None:
+            try:
+                self.on_rotate()
+            # jg: disable=JG005 -- a rotation-counter hook must never fail the write that triggered it
+            except Exception:
+                pass
+
+    def _rotate_locked(self) -> None:  # holds-lock: _lock
+        """Rename the live file to the next ``.<seq>`` segment, prune
+        segments beyond ``keep_segments``, reopen fresh. Caller holds
+        ``_lock`` (the handle swap must be atomic w.r.t. writers)."""
+        self._fh.close()
+        self._fh = None
+        seqs = [s for _, s in _segments(self.path)]
+        nxt = (max(seqs) + 1) if seqs else 1
+        try:
+            os.replace(self.path, f"{self.path}.{nxt}")
+        except OSError:
+            pass  # rename raced an external mover: just reopen
+        for seg_path, seq in _segments(self.path):
+            if seq <= nxt - self._keep_segments:
+                try:
+                    os.remove(seg_path)
+                except OSError:
+                    pass
+        # jg: disable=JG009 -- the reopen must happen under the same lock every writer serializes on (a writer observing _fh=None mid-rotation would drop its record); rotation is a rare flush-boundary event, not the per-record path
+        self._fh = open(self.path, "a")
+        self._size = 0
+        if self._manifest_record is not None:
+            # The run-scoping record must survive segment pruning:
+            # every fresh segment opens with a MARKED manifest copy
+            # (readers use it as data only — ``rotated_copy`` keeps it
+            # from re-scoping the run in summarize()).
+            line = json.dumps(
+                {**self._manifest_record, "rotated_copy": True}
+            ) + "\n"
+            # jg: disable=JG009 -- same critical section as the reopen above: the copy must land before any writer's next record, and rotation only runs at rare flush boundaries
+            self._fh.write(line)
+            self._size = len(line)
+        self.rotations += 1
 
     def manifest(
         self, config: Optional[Dict[str, Any]] = None,
@@ -273,18 +360,50 @@ class EventLog:
         self.close()
 
 
+def _segments(path: str) -> List[tuple]:
+    """Rotated segments of ``path`` as ascending ``(seg_path, seq)``
+    pairs (``events.jsonl.1`` is older than ``.2``; the live file is
+    not included)."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path) + "."
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(base):
+            continue
+        suffix = name[len(base):]
+        if suffix.isdigit():
+            out.append((os.path.join(d, name), int(suffix)))
+    out.sort(key=lambda t: t[1])
+    return out
+
+
 def read_events(path: str) -> Iterator[Dict[str, Any]]:
-    """Stream a JSONL event log; malformed lines (a crash mid-write) are
-    skipped rather than poisoning the whole read."""
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
-                continue
+    """Stream a JSONL event log — rotated segments first (oldest to
+    newest), then the live file, so readers (`cli trace`/`telemetry`,
+    ``summarize``) see one continuous stream across rotation.
+    Malformed lines (a crash mid-write) are skipped rather than
+    poisoning the whole read."""
+    paths = [p for p, _ in _segments(path)] + [path]
+    for p in paths:
+        try:
+            f = open(p)
+        except OSError:
+            if p == path and not _segments(path):
+                raise  # no log at all: keep the historical contract
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:

@@ -6,9 +6,12 @@ inside bench.py).
 Convention: training FLOPs per step = 3 x forward-GEMM FLOPs
 (fwd = 2*MACs; backward costs ~2x fwd for the dL/dW and dL/dx GEMMs per
 layer) — the standard MFU numerator, which deliberately excludes
-optimizer/elementwise noise. XLA's cost_analysis is NOT used: it is
-unavailable through remote-compile tunnel backends and counts the noise
-the convention excludes.
+optimizer/elementwise noise. XLA's cost_analysis is NOT this number's
+source: it is unavailable through remote-compile tunnel backends and
+counts the noise the convention excludes. It is banked separately by
+the per-program cost ledger (obs/costs, OBSERVABILITY.md "Device
+profiling"), and the two agreeing within a small factor is a tested
+reconciliation invariant.
 """
 
 from __future__ import annotations
@@ -193,11 +196,23 @@ def mfu(
     return round(step_flops / step_time_s / (peak * max(n_devices, 1)), 6)
 
 
-def device_memory_stats() -> Optional[dict]:
+def device_memory_stats(
+    *, live_fallback: bool = False,
+) -> Optional[dict]:
     """Per-device HBM usage via ``device.memory_stats()`` where the
     backend exposes it (TPU/GPU runtimes do, CPU returns None). Returns
     {device_index: {bytes_in_use, peak_bytes_in_use, bytes_limit}} for
-    local devices, or None when unavailable."""
+    local devices, or None when unavailable.
+
+    ``live_fallback=True`` adds the live-buffer-walk fallback: when no
+    device reports allocator stats (CPU), every ``jax.live_arrays()``
+    buffer's nbytes is attributed to the devices its sharding spans, so
+    the HBM census (/healthz ``device_memory``, OBSERVABILITY.md
+    "Device profiling") still returns a number — marked
+    ``source="live_arrays"``, and an *approximation*: it sees arrays
+    the Python side keeps alive, not allocator internals. The walk is
+    O(live arrays); reserve it for poll-rate paths (healthz), never the
+    dispatch hot loop."""
     try:
         import jax
 
@@ -211,6 +226,26 @@ def device_memory_stats() -> Optional[dict]:
                 if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
                          "largest_alloc_size")
             }
-        return out or None
+        if out or not live_fallback:
+            return out or None
+        walked: dict = {}
+        for arr in jax.live_arrays():
+            try:
+                devs = list(arr.devices())
+                nbytes = int(arr.nbytes)
+            except (AttributeError, RuntimeError, TypeError, ValueError):
+                continue  # a deleted/exotic buffer: skip, don't poison
+            if not devs:
+                continue
+            share = nbytes // len(devs)
+            for d in devs:
+                row = walked.setdefault(
+                    str(d.id),
+                    {"bytes_in_use": 0, "live_buffers": 0,
+                     "source": "live_arrays"},
+                )
+                row["bytes_in_use"] += share
+                row["live_buffers"] += 1
+        return walked or None
     except (ImportError, RuntimeError, TypeError, ValueError):
         return None

@@ -1,0 +1,305 @@
+"""On-demand XLA profiling — arm ``jax.profiler`` captures at runtime.
+
+The trainer has always been able to trace its first-epoch steps
+(``--profile-dir``); this module makes device profiling an *operational*
+tool instead of a launch-time decision:
+
+  * ``POST /admin/profile {"duration_ms": N}`` on both serving front
+    ends captures a live window off-path (the handler thread sleeps
+    through the capture; serving traffic never blocks on it) and
+    returns the artifact directory + byte sizes;
+  * ``cli train --profile-steps A:B`` captures a step window mid-run;
+  * while a capture is armed, the serving/training dispatch sites wrap
+    their device calls in ``jax.profiler.StepTraceAnnotation`` markers
+    carrying the run's ``x-jg-trace`` trace id, so the device profile
+    and the host span trees (obs/trace) of the same window join on id —
+    a Perfetto view of host spans next to the xplane of the chips;
+  * ``cli profile DIR`` summarizes a capture (top ops by total time,
+    compile-vs-execute split) from the Chrome-trace half of the
+    artifact, stdlib-only — no TensorBoard required to answer "what was
+    the device doing".
+
+One capture at a time per process (a ``jax.profiler`` limit — the
+global profiler state cannot nest); a second concurrent request gets
+:class:`ProfileBusyError` (HTTP 409). Disabled cost: instrumented
+dispatch sites check one attribute (``profiler.active``); nothing else
+runs and ``jax.profiler`` is never imported until a capture starts.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+PROFILE_CAPTURES_TOTAL = "profile_captures_total"
+
+MAX_CAPTURE_MS = 60_000.0
+
+# The profiler marker name carried into the xplane by every annotated
+# dispatch — one grep key for the join between host spans and device
+# profiles (the annotation's ``jg_trace`` arg holds the trace id).
+STEP_MARKER = "jg_step"
+
+
+class ProfileBusyError(RuntimeError):
+    """A capture is already in progress (one per process)."""
+
+
+class ProfileManager:
+    """Owns the process's single capture slot.
+
+    ``capture`` is the blocking duration-window form (the /admin
+    endpoint); ``start``/``stop`` are the step-window form (the
+    trainer drives them at step boundaries). Both emit a
+    ``profile_capture`` event (artifact dir, file count, bytes) and
+    increment ``profile_captures_total`` when telemetry is attached at
+    the call."""
+
+    def __init__(self, registry: Any = None):
+        self._lock = threading.Lock()
+        self.active = False           # the hot paths' one-attribute check
+        self._dir: Optional[str] = None
+        self._t0 = 0.0
+        if registry is None:
+            from .registry import default_registry
+
+            registry = default_registry()
+        self._captures_ctr = registry.counter(
+            PROFILE_CAPTURES_TOTAL,
+            "on-demand device-profile captures completed",
+        )
+
+    # -- step-window form (trainer) ------------------------------------------
+
+    def start(self, artifact_dir: str) -> None:
+        """Begin a capture into ``artifact_dir``. Raises
+        :class:`ProfileBusyError` when one is already running."""
+        if not self._lock.acquire(blocking=False):
+            raise ProfileBusyError(
+                "a profile capture is already in progress "
+                "(one per process)"
+            )
+        try:
+            import jax.profiler
+
+            os.makedirs(artifact_dir, exist_ok=True)
+            jax.profiler.start_trace(artifact_dir)
+        except BaseException:
+            self._lock.release()
+            raise
+        self._dir = artifact_dir
+        self._t0 = time.monotonic()
+        self.active = True
+
+    def stop(self, telemetry: Any = None) -> Dict[str, Any]:
+        """End the capture; returns the artifact summary (dir, files,
+        total bytes, wall duration) and emits ``profile_capture``."""
+        if not self.active:
+            raise RuntimeError("no profile capture in progress")
+        import jax.profiler
+
+        artifact_dir = self._dir or "."
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # The capture slot frees even if the dump failed — a wedged
+            # profiler must not permanently 409 the endpoint.
+            self.active = False
+            self._dir = None
+            self._lock.release()
+        dur_ms = round((time.monotonic() - self._t0) * 1e3, 1)
+        files = capture_files(artifact_dir)
+        summary = {
+            "dir": artifact_dir,
+            "duration_ms": dur_ms,
+            "files": len(files),
+            "total_bytes": sum(f["bytes"] for f in files),
+        }
+        self._captures_ctr.inc()
+        if telemetry is not None:
+            try:
+                telemetry.emit(
+                    "profile_capture", **summary,
+                    file_list=[f["path"] for f in files][:20],
+                )
+            except Exception:
+                log.debug("profile_capture emit failed", exc_info=True)
+        log.info("profile capture: %s", summary)
+        return summary
+
+    # -- duration-window form (/admin/profile) -------------------------------
+
+    def capture(
+        self, duration_ms: float, *, artifact_dir: str,
+        telemetry: Any = None,
+    ) -> Dict[str, Any]:
+        """Blocking duration-window capture. The caller's thread (an
+        HTTP handler — off the serving path by construction) sleeps
+        through the window; the annotated dispatch sites do the actual
+        marking. Duration is clamped to ``MAX_CAPTURE_MS``."""
+        duration_ms = float(duration_ms)
+        if not duration_ms > 0:
+            raise ValueError(
+                f"duration_ms must be > 0, got {duration_ms}"
+            )
+        duration_ms = min(duration_ms, MAX_CAPTURE_MS)
+        self.start(artifact_dir)
+        try:
+            time.sleep(duration_ms / 1e3)
+        finally:
+            summary = self.stop(telemetry=telemetry)
+        return summary
+
+
+_profiler: Optional[ProfileManager] = None
+_profiler_lock = threading.Lock()
+
+
+def get_profiler() -> ProfileManager:
+    """Process-wide manager — the capture slot is a process property
+    (``jax.profiler`` keeps global state)."""
+    global _profiler
+    with _profiler_lock:
+        if _profiler is None:
+            _profiler = ProfileManager()
+        return _profiler
+
+
+def default_capture_dir(telemetry_dir: Optional[str]) -> Optional[str]:
+    """``<telemetry_dir>/profile`` — THE default artifact location,
+    shared by both serving front ends' /admin/profile and the
+    trainer's ``--profile-steps`` window (None without a telemetry
+    dir; callers then require an explicit dir)."""
+    if not telemetry_dir:
+        return None
+    return os.path.join(telemetry_dir, "profile")
+
+
+# -- reading a capture (cli profile) -----------------------------------------
+
+
+def capture_files(artifact_dir: str) -> List[Dict[str, Any]]:
+    """Every file under a capture directory with its size — the
+    /admin/profile response body and the smoke's load assertion."""
+    out: List[Dict[str, Any]] = []
+    for root, _, files in os.walk(artifact_dir):
+        for name in files:
+            p = os.path.join(root, name)
+            try:
+                out.append({
+                    "path": os.path.relpath(p, artifact_dir),
+                    "bytes": os.path.getsize(p),
+                })
+            except OSError:
+                continue
+    out.sort(key=lambda f: f["path"])
+    return out
+
+
+def find_trace_json(artifact_dir: str) -> Optional[str]:
+    """Newest ``*.trace.json.gz`` under a capture dir (the profiler
+    writes one per host under ``plugins/profile/<ts>/``)."""
+    best: Optional[str] = None
+    best_mtime = -1.0
+    for root, _, files in os.walk(artifact_dir):
+        for name in files:
+            if not name.endswith(".trace.json.gz"):
+                continue
+            p = os.path.join(root, name)
+            try:
+                m = os.path.getmtime(p)
+            except OSError:
+                continue
+            if m > best_mtime:
+                best, best_mtime = p, m
+    return best
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """The Chrome-trace events of one ``*.trace.json.gz``."""
+    with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+        data = json.load(f)
+    return list(data.get("traceEvents", []))
+
+
+def summarize_capture(
+    artifact_dir: str, *, top: int = 15,
+) -> Dict[str, Any]:
+    """Fold a capture into a terminal-readable summary: top ops by
+    total duration (python frame events — ``$file:line`` names — are
+    grouped separately so XLA op names float to the top), the
+    compile-vs-non-compile split, and any ``jg_step`` marker trace ids
+    (the host-span join keys). Approximate by design: Chrome-trace
+    events nest, so totals over-count parents — good enough to answer
+    "what dominated" without TensorBoard."""
+    trace_path = find_trace_json(artifact_dir)
+    if trace_path is None:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {artifact_dir} — is this a "
+            "jax.profiler capture directory?"
+        )
+    events = load_trace_events(trace_path)
+    ops: Dict[str, List[float]] = {}
+    compile_us = 0.0
+    total_us = 0.0
+    trace_ids = set()
+    steps = 0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", "?"))
+        dur = float(e.get("dur", 0.0) or 0.0)
+        args = e.get("args") or {}
+        if "jg_trace" in args:
+            trace_ids.add(args["jg_trace"])
+            steps += 1
+        total_us += dur
+        if "compile" in name.lower():
+            compile_us += dur
+        if name.startswith("$"):      # python frame events
+            continue
+        row = ops.setdefault(name, [0.0, 0.0])
+        row[0] += 1
+        row[1] += dur
+    top_ops = sorted(ops.items(), key=lambda kv: -kv[1][1])[:top]
+    return {
+        "dir": artifact_dir,
+        "trace_json": trace_path,
+        "events": len(events),
+        "annotated_steps": steps,
+        "trace_ids": sorted(trace_ids),
+        "compile_ms": round(compile_us / 1e3, 3),
+        "other_ms": round(max(total_us - compile_us, 0.0) / 1e3, 3),
+        "top_ops": [
+            {"name": name, "count": int(c), "total_ms": round(us / 1e3, 3)}
+            for name, (c, us) in top_ops
+        ],
+    }
+
+
+def render_capture_summary(summary: Dict[str, Any]) -> str:
+    """Human-readable capture summary (the ``cli profile`` default)."""
+    lines = [
+        f"profile capture: {summary['dir']}",
+        f"  events {summary['events']}   annotated steps "
+        f"{summary['annotated_steps']}   compile {summary['compile_ms']}"
+        f" ms   other {summary['other_ms']} ms",
+    ]
+    if summary["trace_ids"]:
+        lines.append(
+            "  joinable trace ids: " + ", ".join(summary["trace_ids"][:8])
+        )
+    lines.append("  top ops by total time (approximate, nested):")
+    for op in summary["top_ops"]:
+        lines.append(
+            f"    {op['total_ms']:>12.3f} ms  x{op['count']:<6} "
+            f"{op['name'][:80]}"
+        )
+    return "\n".join(lines)

@@ -25,9 +25,14 @@ def summarize(path: str) -> Dict[str, Any]:
     # run (everything from the last manifest on) so a re-run never has
     # its numbers attributed to an older run's config/git rev. A log
     # with no manifest (hand-built, tests) aggregates everything.
+    # Rotation copies (``rotated_copy`` — obs/events re-emits the
+    # manifest into each fresh segment so pruning can't lose it) are
+    # DATA fallbacks only: they must never re-scope the run to the
+    # segment they open.
     last_manifest = max(
         (i for i, e in enumerate(all_events)
-         if e.get("kind") == MANIFEST_KIND),
+         if e.get("kind") == MANIFEST_KIND
+         and not e.get("rotated_copy")),
         default=None,
     )
     if last_manifest is not None:
@@ -52,12 +57,25 @@ def summarize(path: str) -> Dict[str, Any]:
     kinds: Dict[str, int] = {}
     bench_sections: List[Dict] = []
     infer_runs: List[Dict] = []
+    programs: Dict[str, Dict[str, Any]] = {}
+    metrics_snapshot: Optional[Dict] = None
+    profile_captures: List[Dict] = []
+
+    def _program(name: Any) -> Dict[str, Any]:
+        return programs.setdefault(
+            str(name), {"compiles": 0, "aot": {}}
+        )
+
+    manifest_copies: List[Dict] = []
 
     for ev in events_in_run:
         kind = ev.get("kind", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
         if kind == MANIFEST_KIND:
-            manifests.append(ev)
+            if ev.get("rotated_copy"):
+                manifest_copies.append(ev)
+            else:
+                manifests.append(ev)
         elif kind == "step":
             n = int(ev.get("n_steps", 1) or 1)
             lat = ev.get("latency_s")
@@ -90,8 +108,37 @@ def summarize(path: str) -> Dict[str, Any]:
             bench_sections.append(ev)
         elif kind == "infer":
             infer_runs.append(ev)
+        elif kind == "program_cost":
+            # Per-program cost ledger rows (obs/costs): the latest row
+            # describes the serving program (a reload overwrites); the
+            # close-time snapshot rows (final=True) carry dispatch
+            # stats + measured MFU and are NOT extra compiles.
+            row = _program(ev.get("program"))
+            if not ev.get("final") and ev.get("source") != "aot_hit":
+                # An AOT hit analyzes a stored executable — it is a
+                # cost row, not a compile (the hit itself is counted
+                # under the aot_hit event).
+                row["compiles"] += 1
+            for k in ("flops", "bytes_accessed", "hbm", "source",
+                      "reason", "dispatches", "mean_dispatch_ms",
+                      "mfu", "peak_precision"):
+                if ev.get(k) is not None:
+                    row[k] = ev[k]
+        elif kind in ("aot_hit", "aot_miss", "aot_bank", "aot_fallback"):
+            row = _program(ev.get("name"))
+            aot = row["aot"]
+            short = kind[len("aot_"):]
+            aot[short] = aot.get(short, 0) + 1
+        elif kind == "metrics":
+            metrics_snapshot = ev.get("registry")
+        elif kind == "profile_capture":
+            profile_captures.append(ev)
 
     latencies.sort()
+    if not manifests and manifest_copies:
+        # The original segment was pruned by rotation: the earliest
+        # surviving copy IS the run's manifest data.
+        manifests = manifest_copies[:1]
     manifest = manifests[0] if manifests else {}
     summary: Dict[str, Any] = {
         "path": path,
@@ -162,6 +209,40 @@ def summarize(path: str) -> Dict[str, Any]:
         summary["bench_events"] = len(bench_sections)
     if infer_runs:
         summary["infer_events"] = len(infer_runs)
+    if profile_captures:
+        summary["profile_captures"] = [
+            {"dir": c.get("dir"), "duration_ms": c.get("duration_ms"),
+             "total_bytes": c.get("total_bytes")}
+            for c in profile_captures
+        ]
+    if programs:
+        # The run's device story from the events dir alone: join the
+        # cost rows with the closing metrics snapshot's per-program
+        # dispatch histogram for measured MFU (no live server needed).
+        from .flops import NOMINAL_HOST_PEAK, chip_peak_bf16
+        from .flops import mfu as _mfu
+
+        kind_str = (
+            manifest.get("topology") or {}
+        ).get("device_kind") or ""
+        peak = chip_peak_bf16(kind_str) or NOMINAL_HOST_PEAK
+        hist = (metrics_snapshot or {}).get(
+            "program_dispatch_seconds"
+        ) or {}
+        for series in hist.get("series", []):
+            name = (series.get("labels") or {}).get("program")
+            if name not in programs:
+                continue
+            row = programs[name]
+            count = int(series.get("count", 0) or 0)
+            if count:
+                mean_s = float(series.get("sum", 0.0)) / count
+                row["dispatches"] = count
+                row["mean_dispatch_ms"] = round(mean_s * 1e3, 4)
+                m = _mfu(row.get("flops"), mean_s, peak)
+                if m is not None:
+                    row["mfu"] = m
+        summary["programs"] = programs
     heartbeats = read_heartbeats(os.path.dirname(path) or ".")
     if heartbeats:
         summary["heartbeats"] = {
@@ -221,6 +302,29 @@ def render_table(summary: Dict[str, Any]) -> str:
     width = max(len(k) for k, _ in rows)
     lines = [f"telemetry summary: {summary['path']}"]
     lines += [f"  {k.ljust(width)}  {v}" for k, v in rows]
+    programs = summary.get("programs")
+    if programs:
+        # The device story (OBSERVABILITY.md "Device profiling"): one
+        # line per compiled program — compiles, cost flops, measured
+        # MFU, AOT hit/miss — readable without a live server.
+        lines.append("  programs:")
+        for name, row in sorted(programs.items()):
+            aot = row.get("aot") or {}
+            aot_s = (
+                f" aot {aot}" if aot else ""
+            )
+            lines.append(
+                f"    {name:<20} compiles {row.get('compiles', 0)}  "
+                f"flops {_fmt(row.get('flops'))}  "
+                f"mfu {_fmt(row.get('mfu'))}  "
+                f"dispatches {_fmt(row.get('dispatches'))}"
+                f"{aot_s}"
+            )
+    for cap in summary.get("profile_captures", []):
+        lines.append(
+            f"  profile capture: {cap.get('dir')} "
+            f"({cap.get('duration_ms')} ms, {cap.get('total_bytes')} B)"
+        )
     for err in summary.get("errors", [])[:5]:
         lines.append(
             f"  ! {err.get('ts')} {err.get('type')}: {err.get('error')}"

@@ -27,6 +27,8 @@ from .trace import Tracer
 
 EVENTS_FILE = "events.jsonl"
 ENV_TRACE = "JG_TRACE"
+ENV_EVENTS_MAX_BYTES = "JG_EVENTS_MAX_BYTES"
+EVENTS_ROTATED_TOTAL = "events_rotated_total"
 
 STEP_SECONDS = "train_step_seconds"
 EXAMPLES_TOTAL = "train_examples_total"
@@ -54,6 +56,7 @@ class Telemetry:
         heartbeat_interval_s: float = 30.0,
         heartbeat: bool = True,
         trace: Optional[bool] = None,
+        events_max_bytes: Optional[int] = None,
     ):
         self.run_dir = run_dir
         self.registry = registry if registry is not None \
@@ -74,7 +77,24 @@ class Telemetry:
         )
         if run_dir is not None:
             os.makedirs(run_dir, exist_ok=True)
-            self.events = EventLog(os.path.join(run_dir, EVENTS_FILE))
+            # Size-bound the log for long-lived servers (events.py
+            # "Rotation"): explicit ``events_max_bytes`` wins, else the
+            # JG_EVENTS_MAX_BYTES env var, else unbounded (training
+            # runs are epoch-bounded). Rotations are visible as the
+            # events_rotated_total counter.
+            if events_max_bytes is None:
+                env_cap = os.environ.get(ENV_EVENTS_MAX_BYTES, "")
+                events_max_bytes = int(env_cap) if env_cap.isdigit() \
+                    else None
+            self.events = EventLog(
+                os.path.join(run_dir, EVENTS_FILE),
+                max_bytes=events_max_bytes,
+            )
+            rotated_ctr = self.registry.counter(
+                EVENTS_ROTATED_TOTAL,
+                "event-log segment rotations (size-bounded servers)",
+            )
+            self.events.on_rotate = rotated_ctr.inc
             if heartbeat:
                 self.heartbeat = Heartbeat(
                     run_dir,
@@ -126,6 +146,18 @@ class Telemetry:
         # metrics snapshot, which includes the trace drop counter).
         self.tracer.flush()
         if self.events is not None:
+            # Cost-ledger final rows (obs/costs; armed runs only): the
+            # ledger is process-wide — its dispatch times may live in
+            # the process registry, not this run's — so the closing
+            # snapshot re-emits each program's row WITH dispatches/
+            # mean/measured-MFU, making the `cli telemetry` programs
+            # section complete from the events dir alone.
+            from .costs import get_ledger
+
+            ledger = get_ledger()
+            if ledger.enabled:
+                for row in ledger.snapshot().values():
+                    self.events.emit("program_cost", final=True, **row)
             # Final registry snapshot as ONE event: counters the run
             # accumulated (comm_bytes_total phases, shed/fault counts,
             # …) become post-mortem-readable from the event log alone,

@@ -35,6 +35,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..analysis.guards import RecompileFenceError
+from ..obs.costs import get_ledger
+from ..obs.profile import STEP_MARKER, get_profiler
 from ..obs.trace import (
     NULL_SPAN,
     NULL_TRACER,
@@ -218,6 +220,13 @@ class ServeEngine:
         # telemetry the shared NULL_TRACER keeps every instrumentation
         # site a single attribute check.
         self.tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
+        # Device introspection (obs/costs, obs/profile): both disabled-
+        # by-default, both one attribute check on the hot path — the
+        # ledger accumulates per-program dispatch times for measured
+        # MFU; the profiler flag arms the StepTraceAnnotation markers
+        # that join a device capture to this run's trace ids.
+        self._ledger = get_ledger()
+        self._profiler = get_profiler()
         self.fence_error: Optional[str] = None
         self.batch_seq = 0
         self.draining = False
@@ -394,12 +403,26 @@ class ServeEngine:
             with self.tracer.start(
                 "serve.batch", kind="batch",
                 batch_seq=self.batch_seq, n=sum(r.n for r in live),
-            ):
+            ) as bspan:
                 if self.chaos is not None and self.chaos.active:
                     c0 = time.monotonic()
                     self.chaos.on_infer(step=self.batch_seq)
                     stall_s = time.monotonic() - c0
-                out = np.asarray(self.predict_fn(x))
+                if self._profiler.active:
+                    # A capture is live: mark this dispatch in the
+                    # xplane with the batch's trace id, so the device
+                    # profile and the host span tree of the same
+                    # window join on id (obs/profile).
+                    import jax.profiler
+
+                    with jax.profiler.StepTraceAnnotation(
+                        STEP_MARKER, step_num=self.batch_seq,
+                        program="classifier_predict",
+                        jg_trace=bspan.trace_id or self.tracer.run_trace,
+                    ):
+                        out = np.asarray(self.predict_fn(x))
+                else:
+                    out = np.asarray(self.predict_fn(x))
         except Exception as e:  # any backend error must trip, not crash
             dt = time.perf_counter() - t0
             m_end = time.monotonic()
@@ -420,6 +443,10 @@ class ServeEngine:
         m_end = time.monotonic()
         self.batches_ctr.inc()
         self.batch_hist.observe(dt)
+        if self._ledger.enabled:
+            # Measured-MFU feed: one dispatch of the ONE compiled
+            # program (obs/costs; the stall is chaos, not the program).
+            self._ledger.observe("classifier_predict", dt - stall_s)
         if dt > self.stall_timeout_s:
             # The Tail-at-Scale stall case: the call *returned*, but so
             # late that the backend must be presumed unhealthy.

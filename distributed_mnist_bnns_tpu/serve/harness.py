@@ -164,11 +164,17 @@ def serving_p99_section(
     duration_s: float = 2.0,
     interpret: bool = True,
     seed: int = 0,
+    telemetry: Any = None,
 ) -> Dict[str, Any]:
     """The bench-record-shaped section (``serving_p99``): tiny packed
     model, saturated engine, exact percentiles — what
     ``scripts/perf_gate.py`` bands as ``classifier_p99_under_
-    saturation_ms`` (wide tolerance, catastrophe detector)."""
+    saturation_ms`` (wide tolerance, catastrophe detector).
+
+    ``telemetry``: an optional obs Telemetry whose event log the
+    engine's request events and span trees land in — the perf gate
+    passes a traced one so a tripped serving band can EXPLAIN itself
+    via `cli trace` tail attribution over this probe's events."""
     fn, input_shape = make_tiny_packed_predictor(
         batch_size, interpret=interpret, seed=seed
     )
@@ -178,6 +184,7 @@ def serving_p99_section(
         input_shape=input_shape,
         n_threads=n_threads,
         duration_s=duration_s,
+        telemetry=telemetry,
     )
     out["interpret_mode"] = interpret
     return out

@@ -76,6 +76,53 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _admin_profile(
+        self, telemetry: Any, default_dir: Optional[str],
+    ) -> None:
+        """``POST /admin/profile {"duration_ms": N, "dir": optional}``
+        for both serving front ends (OBSERVABILITY.md "Device
+        profiling"): arm an on-demand ``jax.profiler`` capture for the
+        window, off the serving path (THIS handler thread sleeps
+        through it; the engine worker never blocks), and reply with the
+        artifact dir + sizes. One capture per process: a concurrent
+        request gets 409."""
+        from ..obs.profile import ProfileBusyError, get_profiler
+
+        body = self._read_json()
+        if body is None:
+            return
+        try:
+            duration_ms = float(body.get("duration_ms", 1000.0))
+        except (TypeError, ValueError):
+            duration_ms = float("nan")
+        if not duration_ms > 0:   # also catches NaN
+            self._reply(400, {
+                "error": "duration_ms must be a positive number, got "
+                         f"{body.get('duration_ms')!r}",
+            })
+            return
+        artifact_dir = body.get("dir") or default_dir
+        if not artifact_dir:
+            self._reply(400, {
+                "error": "no artifact dir: pass {\"dir\": ...} or run "
+                         "the server with --telemetry-dir",
+            })
+            return
+        try:
+            summary = get_profiler().capture(
+                duration_ms, artifact_dir=str(artifact_dir),
+                telemetry=telemetry,
+            )
+        except ProfileBusyError as e:
+            self._reply(409, {"error": str(e)})
+            return
+        except (OSError, RuntimeError, ValueError) as e:
+            self._reply(500, {
+                "error": f"capture failed: {type(e).__name__}: {e}",
+            })
+            return
+        self._reply(200, summary)
+
     def _read_json(self) -> Optional[Dict[str, Any]]:
         try:
             n = int(self.headers.get("Content-Length", 0))

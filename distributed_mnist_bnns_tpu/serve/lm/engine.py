@@ -48,6 +48,8 @@ from ...analysis.guards import (
     Sanitizer,
     SanitizerConfig,
 )
+from ...obs.costs import get_ledger
+from ...obs.profile import STEP_MARKER, get_profiler
 from ...obs.trace import (
     NULL_SPAN,
     NULL_TRACER,
@@ -229,6 +231,14 @@ class LMEngine:
         from ...obs import default_registry, get_tracker
 
         self._tracker = get_tracker()
+        # Device introspection (obs/costs, obs/profile): disabled =
+        # one attribute check per dispatch; armed, the ledger feeds
+        # measured per-program MFU and the profiler flag arms the
+        # xplane step markers carrying this run's trace ids.
+        self._ledger = get_ledger()
+        self._profiler = get_profiler()
+        self.pool_reserved_bytes = 0   # set once pools exist (start())
+        self.page_bytes = 0
         # Spans ride the telemetry sink's tracer (obs/trace); the shared
         # NULL_TRACER keeps instrumentation a single attribute check
         # when telemetry is off.
@@ -310,6 +320,29 @@ class LMEngine:
             return None
         return self._spec_accepted / self._spec_drafted
 
+    def kv_pool_stats(self) -> Dict[str, Any]:
+        """Paged-pool HBM attribution (OBSERVABILITY.md "Device
+        profiling"): the pool's fixed reservation vs the bytes its
+        in-use pages pin — a page leak becomes a numeric dashboard
+        fact instead of a drain-time assertion. Also refreshes the
+        ``kv_pool_*_bytes`` gauges."""
+        in_use = self.allocator.used_count()
+        stats = {
+            "reserved_bytes": self.pool_reserved_bytes,
+            "page_bytes": self.page_bytes,
+            "pages_in_use": in_use,
+            "in_use_bytes": in_use * self.page_bytes,
+        }
+        self.registry.gauge(
+            "kv_pool_reserved_bytes", "paged KV pool reservation"
+        ).set(stats["reserved_bytes"])
+        self.registry.gauge(
+            "kv_pool_in_use_bytes",
+            "bytes pinned by in-use KV pages (pages_in_use x "
+            "page_bytes)",
+        ).set(stats["in_use_bytes"])
+        return stats
+
     def prefix_cache_stats(self) -> Optional[Dict[str, Any]]:
         """Entry count + shared-page occupancy for /healthz, or None
         when the cache is off."""
@@ -364,6 +397,50 @@ class LMEngine:
             )
             jax.block_until_ready(vlp)
         self._pools = pools
+        # Pool-reservation accounting for the HBM census (/healthz
+        # kv_pool, OBSERVABILITY.md "Device profiling"): the pools'
+        # full byte footprint is fixed at boot; pages_in_use x
+        # page_bytes against it makes a page leak a dashboard number.
+        self.pool_reserved_bytes = int(sum(
+            int(k.nbytes) + int(v.nbytes) for k, v in pools
+        ))
+        self.page_bytes = self.pool_reserved_bytes // max(
+            dec.num_pages, 1
+        )
+        if self._ledger.enabled:
+            # Per-program cost ledger (obs/costs). AOT-loaded programs
+            # are Compiled — analyzed in place, zero compiles, so the
+            # boot-pinned budget-0 fence stays green; cold-boot jitted
+            # programs pay their throwaway analysis compile HERE,
+            # before the post-warmup baseline is pinned below.
+            self._ledger.record(
+                "lm_prefill", dec.prefill, telemetry=self.telemetry,
+                example_args=(
+                    pools, jnp.asarray(zeros_c), jnp.asarray(zeros_p),
+                    jnp.asarray(np.asarray(0, np.int32)),
+                    jnp.asarray(np.asarray(0, np.int32)),
+                ),
+            )
+            self._ledger.record(
+                "lm_decode", dec.decode, telemetry=self.telemetry,
+                example_args=(
+                    pools, jnp.asarray(self._tokens),
+                    jnp.asarray(self._page_tables),
+                    jnp.asarray(self._positions),
+                ),
+            )
+            if self.spec_k:
+                self._ledger.record(
+                    "lm_verify", dec.verify, telemetry=self.telemetry,
+                    example_args=(
+                        pools,
+                        jnp.asarray(np.zeros(
+                            (dec.slots, self.spec_k), np.int32
+                        )),
+                        jnp.asarray(self._page_tables),
+                        jnp.asarray(self._positions),
+                    ),
+                )
         self._compile_baseline = (
             self._boot_baseline if self._boot_baseline is not None
             else self._tracker.mark()
@@ -722,6 +799,12 @@ class LMEngine:
             ) from e
         prefill_ms = (time.perf_counter() - t0) * 1e3
         self.prefill_hist.observe(prefill_ms)
+        if self._ledger.enabled:
+            # padded // chunk fixed-shape dispatches (obs/costs).
+            self._ledger.observe(
+                "lm_prefill", prefill_ms / 1e3,
+                n=max(padded // chunk, 1),
+            )
         # Counter delta = tokens actually prefilled: a cache hit's
         # skipped work is visible as lm_tokens_total{phase=prefill}
         # growing by the suffix only (the CI smoke asserts on this).
@@ -847,13 +930,31 @@ class LMEngine:
                     return
             t0 = time.perf_counter()
             try:
-                self._pools, lp = self.decoder.decode(
-                    self._pools,
-                    jnp.asarray(self._tokens),
-                    jnp.asarray(self._page_tables),
-                    jnp.asarray(self._positions),
-                )
-                lp_host = np.asarray(lp)   # the per-iteration sync point
+                if self._profiler.active:
+                    # Capture live: mark the dispatch in the xplane
+                    # with this run's trace id (obs/profile) so the
+                    # device profile joins the host span trees.
+                    with jax.profiler.StepTraceAnnotation(
+                        STEP_MARKER, step_num=self.batch_seq,
+                        program="lm_decode",
+                        jg_trace=iter_span.trace_id
+                        or self.tracer.run_trace,
+                    ):
+                        self._pools, lp = self.decoder.decode(
+                            self._pools,
+                            jnp.asarray(self._tokens),
+                            jnp.asarray(self._page_tables),
+                            jnp.asarray(self._positions),
+                        )
+                        lp_host = np.asarray(lp)
+                else:
+                    self._pools, lp = self.decoder.decode(
+                        self._pools,
+                        jnp.asarray(self._tokens),
+                        jnp.asarray(self._page_tables),
+                        jnp.asarray(self._positions),
+                    )
+                    lp_host = np.asarray(lp)  # per-iteration sync point
             except Exception as e:
                 # A failure INSIDE the dispatch cannot be retried: the
                 # pools were donated to it and may already be deleted.
@@ -868,6 +969,8 @@ class LMEngine:
             iter_span.end("ok", iter_ms=round(dt * 1e3, 3))
         self._consecutive_failures = 0
         self.iter_hist.observe(dt)
+        if self._ledger.enabled:
+            self._ledger.observe("lm_decode", dt)
         if self._sanitizer is not None:
             self._sanitizer.after_step(step=self.batch_seq)
         for slot, st in enumerate(self._slots):
@@ -928,6 +1031,18 @@ class LMEngine:
             tables_j = jnp.asarray(self._page_tables)
             window = np.zeros((len(self._slots), k_win), np.int32)
             window[:, 0] = self._tokens    # input 0: the pending token
+            # Capture live: one step marker spans the whole spec round
+            # (drafts + verify — the scheduler's unit of work), carrying
+            # the trace id the host span trees use (obs/profile).
+            prof_ann = (
+                jax.profiler.StepTraceAnnotation(
+                    STEP_MARKER, step_num=self.batch_seq,
+                    program="lm_spec_round",
+                    jg_trace=iter_span.trace_id or self.tracer.run_trace,
+                ) if self._profiler.active else None
+            )
+            if prof_ann is not None:
+                prof_ann.__enter__()
             try:
                 # Draft phase: k_win - 1 packed small-M dispatches.
                 # Positions/tokens advance in LOCAL copies — the
@@ -961,6 +1076,9 @@ class LMEngine:
                 iter_span.end("error", error=type(e).__name__)
                 self._dispatch_failure(e)
                 return
+            finally:
+                if prof_ann is not None:
+                    prof_ann.__exit__(None, None, None)
             dt = time.perf_counter() - t0
             if self.tracer.enabled:
                 self.tracer.record(
@@ -974,6 +1092,14 @@ class LMEngine:
             iter_span.end("ok", iter_ms=round(dt * 1e3, 3))
         self._consecutive_failures = 0
         self.iter_hist.observe(dt)
+        if self._ledger.enabled:
+            # Measured-MFU feed per program: the k_win-1 packed drafts
+            # and the one dense-bf16 verify dispatch (obs/costs).
+            if k_win > 1:
+                self._ledger.observe(
+                    "lm_decode", draft_t1 - draft_t0, n=k_win - 1
+                )
+            self._ledger.observe("lm_verify", verify_t1 - draft_t1)
         if self._sanitizer is not None:
             self._sanitizer.after_step(step=self.batch_seq)
         greedy = np.argmax(v_host, axis=-1)          # (S, K)

@@ -21,8 +21,12 @@ chunked transfer encoding, one JSON object per line (ndjson).
                       the already-sent 200: the stream terminates with
                       {"done": true, "status": "deadline"} instead.
   GET  /healthz       status, active_streams, queue_depth,
-                      page_occupancy, recompiles_post_warmup
+                      page_occupancy, recompiles_post_warmup, kv_pool
+                      HBM attribution (+ per-program costs/MFU and the
+                      device-memory census when --costs is armed)
   GET  /metrics       obs registry snapshot (JSON)
+  POST /admin/profile on-demand jax.profiler capture, off-path
+                      (OBSERVABILITY.md "Device profiling")
 
 Lifecycle: SIGTERM stops admission (shed ``draining``), lets active
 streams run out (bounded by the drain budget), emits a ``drain`` event,
@@ -98,6 +102,13 @@ class LMServeConfig:
                                         # bf16 verify dispatch per
                                         # round (SERVING.md
                                         # "Speculative decoding")
+    costs: Optional[bool] = None        # per-program HLO cost ledger +
+                                        # measured MFU (obs/costs;
+                                        # None = the JG_COSTS env var)
+    events_max_bytes: Optional[int] = None  # size-rotate events.jsonl
+                                        # (obs/events "Rotation"; None
+                                        # = JG_EVENTS_MAX_BYTES, else
+                                        # unbounded)
 
 
 class LMServer:
@@ -106,10 +117,15 @@ class LMServer:
     def __init__(self, config: LMServeConfig):
         self.config = config
         from ...obs import Telemetry
+        from ...obs.costs import arm_ledger
 
         self.telemetry = Telemetry(
-            config.telemetry_dir, heartbeat=False, trace=config.trace
+            config.telemetry_dir, heartbeat=False, trace=config.trace,
+            events_max_bytes=config.events_max_bytes,
         )
+        # Device introspection (obs/costs): an explicit flag wins over
+        # the JG_COSTS env default; the LM engine feeds the ledger.
+        self._ledger = arm_ledger(config.costs)
         from ...resilience.chaos import ChaosController
 
         self.chaos = ChaosController.from_config(
@@ -266,7 +282,28 @@ class LMServer:
             health["spec_acceptance_rate"] = (
                 round(rate, 4) if rate is not None else None
             )
+        # Paged-pool HBM attribution is plain arithmetic — always on.
+        health["kv_pool"] = eng.kv_pool_stats()
+        if self._ledger.enabled:
+            # Device introspection (OBSERVABILITY.md "Device
+            # profiling"): per-program costs + measured MFU, plus the
+            # live HBM census (healthz is poll-rate; the CPU live-
+            # buffer walk is fine here).
+            from ...obs import device_memory_stats
+
+            health["programs"] = self._ledger.snapshot()
+            mem = device_memory_stats(live_fallback=True)
+            if mem is not None:
+                health["device_memory"] = mem
         return health
+
+    def profile_dir_default(self) -> Optional[str]:
+        """Default /admin/profile artifact dir (shared convention:
+        ``<telemetry_dir>/profile``; None makes the handler require an
+        explicit ``dir`` in the body)."""
+        from ...obs.profile import default_capture_dir
+
+        return default_capture_dir(self.config.telemetry_dir)
 
     def request_stop(self, reason: str = "stop requested") -> None:
         self.stop_request.request(reason)
@@ -372,6 +409,13 @@ class _LMHandler(JsonHandler):
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         if self.path == "/generate":
             self._generate()
+        elif self.path == "/admin/profile":
+            # On-demand device capture (obs/profile; shared handler in
+            # httpbase): this handler thread sleeps through the window
+            # while the scheduler keeps decoding.
+            self._admin_profile(
+                self.srv.telemetry, self.srv.profile_dir_default()
+            )
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 

@@ -17,6 +17,10 @@ modes handled and observable (SERVING.md "Live serving"):
                        is loaded AND warmed off-path, then atomically
                        swapped in; unchanged weights give bitwise-
                        identical responses across the swap
+  POST /admin/profile  {"duration_ms": N} — on-demand jax.profiler
+                       capture of a live window, off the serving path
+                       (OBSERVABILITY.md "Device profiling"); 409
+                       while another capture runs
 
 Lifecycle: SIGTERM/SIGINT install the same :class:`~..resilience.
 preempt.StopRequest` pattern as training — stop admitting (new work is
@@ -93,6 +97,16 @@ class ServeConfig:
                                      # event log (obs/trace): True/False
                                      # explicit, None = the JG_TRACE env
                                      # var; needs telemetry_dir
+    costs: Optional[bool] = None     # per-program HLO cost ledger +
+                                     # measured MFU (obs/costs,
+                                     # OBSERVABILITY.md "Device
+                                     # profiling"): True/False explicit,
+                                     # None = the JG_COSTS env var
+    events_max_bytes: Optional[int] = None  # size-rotate events.jsonl
+                                     # past this many bytes (obs/events
+                                     # "Rotation"; None = the
+                                     # JG_EVENTS_MAX_BYTES env var, else
+                                     # unbounded)
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -102,10 +116,15 @@ class PackedInferenceServer:
     def __init__(self, config: ServeConfig):
         self.config = config
         from ..obs import Telemetry
+        from ..obs.costs import arm_ledger
 
         self.telemetry = Telemetry(
-            config.telemetry_dir, heartbeat=False, trace=config.trace
+            config.telemetry_dir, heartbeat=False, trace=config.trace,
+            events_max_bytes=config.events_max_bytes,
         )
+        # Device introspection (obs/costs): the process-wide ledger;
+        # an explicit flag wins over the JG_COSTS env default.
+        self._ledger = arm_ledger(config.costs)
         from ..resilience.chaos import ChaosController
 
         self.chaos = ChaosController.from_config(
@@ -184,6 +203,27 @@ class PackedInferenceServer:
 
             fn, info = load_packed(path, interpret=self._interpret())
             meta = {"status": "disabled"}
+        if self._ledger.enabled:
+            # Per-program cost ledger (obs/costs): an AOT-path fn is a
+            # Compiled and is analyzed in place — no compile, so a
+            # budget-0 fence stays green; the online jitted fn pays one
+            # throwaway analysis compile HERE, inside the boot/reload
+            # window the fence already parks around.
+            import jax
+            import jax.numpy as jnp
+
+            sds = jax.ShapeDtypeStruct(
+                (self.config.batch_size, *self.config.input_shape),
+                jnp.float32,
+            )
+            self._ledger.record(
+                "classifier_predict", fn, example_args=(sds,),
+                telemetry=self.telemetry,
+                source={"hit": "aot_hit", "miss": "aot_miss"}.get(
+                    meta.get("status"), "online"
+                ),
+                artifact=path,
+            )
         warm = np.zeros(
             (self.config.batch_size, *self.config.input_shape), np.float32
         )
@@ -330,7 +370,7 @@ class PackedInferenceServer:
             status = "draining"
         else:
             status = "ok"
-        return {
+        health = {
             "status": status,
             "breaker": self.breaker.state,
             "queue_depth": len(self.queue),
@@ -346,6 +386,26 @@ class PackedInferenceServer:
             "fence_error": eng.fence_error if eng is not None else None,
             "uptime_s": round(time.time() - self._started_at, 3),
         }
+        if self._ledger.enabled:
+            # Device introspection (OBSERVABILITY.md "Device
+            # profiling"): the per-program cost ledger (flops/HBM +
+            # measured MFU) and the live HBM census — healthz is a
+            # poll-rate path, so the CPU live-buffer walk is fine here.
+            from ..obs import device_memory_stats
+
+            health["programs"] = self._ledger.snapshot()
+            mem = device_memory_stats(live_fallback=True)
+            if mem is not None:
+                health["device_memory"] = mem
+        return health
+
+    def profile_dir_default(self) -> Optional[str]:
+        """Default /admin/profile artifact dir (shared convention:
+        ``<telemetry_dir>/profile``; None makes the handler require an
+        explicit ``dir`` in the body)."""
+        from ..obs.profile import default_capture_dir
+
+        return default_capture_dir(self.config.telemetry_dir)
 
     def request_stop(self, reason: str = "stop requested") -> None:
         self.stop_request.request(reason)
@@ -427,6 +487,13 @@ class _Handler(JsonHandler):
             self._predict()
         elif self.path == "/admin/reload":
             self._reload()
+        elif self.path == "/admin/profile":
+            # On-demand device capture (obs/profile; shared handler in
+            # httpbase): this handler thread sleeps through the window,
+            # traffic keeps flowing through the worker.
+            self._admin_profile(
+                self.srv.telemetry, self.srv.profile_dir_default()
+            )
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 

@@ -68,6 +68,11 @@ from .optim import RegimeSchedule, make_optimizer, regime_hp_kwargs
 
 log = logging.getLogger(__name__)
 
+# Reusable no-op context for the hot loop's optional profiler
+# annotation (contextlib.nullcontext is reentrant and stateless, so one
+# instance serves every step without a per-step allocation).
+_NULL_CTX = contextlib.nullcontext()
+
 
 class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
@@ -574,6 +579,17 @@ class TrainConfig:
                                    # scan_steps when set
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     profile_steps: int = 5
+    profile_step_window: Optional[str] = None  # "A:B" — on-demand step-
+                                   # windowed capture (obs/profile,
+                                   # OBSERVABILITY.md "Device
+                                   # profiling"): start the jax.profiler
+                                   # trace when cumulative optimizer
+                                   # step A is reached, stop at B;
+                                   # supersedes the first-epoch
+                                   # profile_steps heuristic. Needs
+                                   # profile_dir (or telemetry_dir,
+                                   # which defaults the artifact dir to
+                                   # <telemetry_dir>/profile)
     telemetry_dir: Optional[str] = None  # structured run telemetry (obs/):
                                    # JSONL events (manifest, step, epoch,
                                    # checkpoint, error), per-process
@@ -774,6 +790,7 @@ class Trainer:
         self._setup_sanitizer()
         self.aot_status: Optional[str] = None
         self._maybe_aot_train_step(input_shape)
+        self._maybe_record_train_cost(input_shape)
         # Preemption + chaos (resilience/, RESILIENCE.md): the stop flag
         # is polled at step boundaries; the chaos controller is inactive
         # unless TrainConfig.chaos / JG_CHAOS scripts faults. A chaos
@@ -797,6 +814,22 @@ class Trainer:
                     "'Elastic membership')"
                 )
         self._profiled = False  # trace the first epoch this trainer runs
+        # Step-windowed on-demand capture (obs/profile; --profile-steps
+        # A:B over cumulative optimizer steps). The window supersedes
+        # the first-epoch profile_steps heuristic; both share the one
+        # process-wide jax.profiler slot.
+        self._profile_window = self._parse_profile_window(
+            config.profile_step_window
+        )
+        if self._profile_window is not None:
+            # Fail fast: a missing artifact dir must error at init,
+            # not abort the run mid-epoch when step A is reached.
+            self._profile_artifact_dir()
+        self._profile_window_started = False
+        self._steps_done = 0           # cumulative dispatch-step count
+        from ..obs.profile import get_profiler
+
+        self._profiler = get_profiler()
         self._masked_eval_step = None  # built lazily for mesh-native eval
         self._train_scan = None        # built lazily when scan_steps > 1
         self._epoch_fn = None          # built lazily for device_data
@@ -1158,6 +1191,106 @@ class Trainer:
 
         self.train_step = step
 
+    @staticmethod
+    def _parse_profile_window(spec: Optional[str]):
+        """``"A:B"`` -> (A, B) cumulative optimizer steps, or None."""
+        if not spec:
+            return None
+        parts = str(spec).split(":")
+        try:
+            a, b = int(parts[0]), int(parts[1])
+        except (IndexError, ValueError):
+            raise ValueError(
+                f"profile_step_window must be 'A:B' integer steps, got "
+                f"{spec!r}"
+            ) from None
+        if not 0 <= a < b:
+            raise ValueError(
+                f"profile_step_window needs 0 <= A < B, got {spec!r}"
+            )
+        return a, b
+
+    def _profile_artifact_dir(self) -> str:
+        cfg = self.config
+        if cfg.profile_dir:
+            return cfg.profile_dir
+        from ..obs.profile import default_capture_dir
+
+        d = default_capture_dir(cfg.telemetry_dir)
+        if d is None:
+            raise ValueError(
+                "--profile-steps needs --profile-dir or "
+                "--telemetry-dir for the capture artifacts"
+            )
+        return d
+
+    def _drive_profile_window(self, *, before_dispatch: bool) -> None:
+        """Start/stop the --profile-steps A:B capture at step
+        boundaries: the trace opens before the dispatch that crosses A
+        and closes after the one that crosses B (device work synced
+        first, so the dump holds complete steps)."""
+        a, b = self._profile_window
+        if before_dispatch:
+            if (not self._profile_window_started
+                    and self._steps_done >= a):
+                from ..obs.profile import ProfileBusyError
+
+                try:
+                    self._profiler.start(self._profile_artifact_dir())
+                    self._profile_window_started = True
+                except ProfileBusyError:
+                    log.warning(
+                        "profile window %s skipped: a capture is "
+                        "already in progress", self.config.
+                        profile_step_window,
+                    )
+                    self._profile_window = None
+        elif self._profile_window_started and self._steps_done >= b:
+            jax.block_until_ready(self.state.params)
+            self._profiler.stop(telemetry=self.telemetry)
+            self._profile_window = None
+            self._profile_window_started = False
+
+    def _maybe_record_train_cost(self, input_shape) -> None:
+        """Per-program cost ledger for the train step (obs/costs,
+        OBSERVABILITY.md "Device profiling"): when armed, bank
+        ``cost_analysis``/``memory_analysis`` of the single-device
+        jitted step under ``train_step`` so measured MFU reconciles
+        against the analytic obs/flops walk. The AOT store path
+        already records through ``load_or_compile``; this covers the
+        online jit with one throwaway analysis compile at init —
+        inside the pre-warmup window, so the recompile fence never
+        sees it. Mesh/scan/device-data dispatches are skipped (their
+        programs are topology-specific; the comm bench owns those
+        numbers)."""
+        from ..obs.costs import get_ledger
+
+        self._ledger = get_ledger()
+        cfg = self.config
+        if not self._ledger.enabled:
+            return
+        if self.aot_status in ("hit", "miss"):
+            return  # the store's load_or_compile recorded this program
+        if (
+            self.mesh is not None
+            or int(cfg.scan_steps) > 1
+            or cfg.device_data
+            or cfg.pipeline_parallel > 1
+            or cfg.tensor_parallel > 1
+            or cfg.grad_compress != "none"
+            or jax.process_count() > 1
+        ):
+            return
+        images_aval = jax.ShapeDtypeStruct(
+            (cfg.batch_size, *input_shape), jnp.float32
+        )
+        labels_aval = jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32)
+        self._ledger.record(
+            "train_step", self.train_step,
+            example_args=(self.state, images_aval, labels_aval, self.rng),
+            telemetry=self.telemetry, model=cfg.model,
+        )
+
     def _record_step(self, per_step_s: float, n: int, seen: int,
                      metrics: Optional[Dict[str, float]] = None) -> None:
         """Step-level derived telemetry: examples/sec, latency histogram,
@@ -1173,6 +1306,11 @@ class Trainer:
             n_devices=self._n_devices,
             metrics=metrics,
         )
+        if self._ledger.enabled and n == 1 and self.mesh is None:
+            # Measured-MFU feed for the cost ledger (obs/costs): the
+            # single-device program the ledger cost-analyzed at init
+            # (scan chunks/mesh dispatches are different programs).
+            self._ledger.observe("train_step", per_step_s)
         if self.comm_plan is not None and self.comm_plan.world > 1:
             # Gradient-exchange bytes on the wire (analytic ring model
             # over the real packed sizes — PERF.md "Gradient comms"),
@@ -2003,7 +2141,15 @@ class Trainer:
         # Profile the first epoch actually run (resume may skip epoch 0);
         # stop_trace in a finally so a failing step can't leave the global
         # profiler started (which would crash any later start_trace).
-        profiling = bool(cfg.profile_dir and not self._profiled)
+        # An explicit --profile-steps A:B window supersedes this
+        # heuristic (both share the one process-wide profiler slot) —
+        # tested on the CONFIG, not the mutable window state, so the
+        # heuristic cannot re-arm in a later epoch once the window has
+        # completed and cleared itself.
+        profiling = bool(
+            cfg.profile_dir and not self._profiled
+            and cfg.profile_step_window is None
+        )
         if profiling:
             self._profiled = True
             jax.profiler.start_trace(cfg.profile_dir)
@@ -2035,6 +2181,10 @@ class Trainer:
                 # reaches cross-host agreement first.
                 if self.stop.requested and jax.process_count() <= 1:
                     self._graceful_stop(epoch, batches_done=seen)
+                if self._profile_window is not None:
+                    # --profile-steps A:B: open the capture before the
+                    # dispatch that crosses A (obs/profile).
+                    self._drive_profile_window(before_dispatch=True)
                 tracer = self.telemetry.tracer
                 m0 = time.monotonic() if tracer.enabled else 0.0
                 t0 = time.perf_counter()
@@ -2044,21 +2194,38 @@ class Trainer:
                     # no host round-trip through the default device.
                     images, labels = jnp.asarray(images), jnp.asarray(labels)
                 step_fn = scan_step if n > 1 else self.train_step
-                if self.mesh is None:
-                    # single-device: inputs are already on device (the
-                    # jnp.asarray above), so the whole dispatch runs
-                    # under the transfer guard; the mesh paths guard
-                    # inside their wrappers, after shard_batch.
-                    with self.sanitizer.guard_transfers():
+                # While a capture is live (first-epoch heuristic OR an
+                # on-demand window/admin capture), mark the dispatch in
+                # the xplane with this run's trace id so the device
+                # profile joins the host span trees (obs/profile).
+                if profiling or self._profiler.active:
+                    from ..obs.profile import STEP_MARKER
+
+                    step_ann = jax.profiler.StepTraceAnnotation(
+                        STEP_MARKER, step_num=seen,
+                        program="train_step",
+                        jg_trace=self.telemetry.tracer.run_trace,
+                    )
+                else:
+                    step_ann = _NULL_CTX
+                with step_ann:
+                    if self.mesh is None:
+                        # single-device: inputs are already on device
+                        # (the jnp.asarray above), so the whole dispatch
+                        # runs under the transfer guard; the mesh paths
+                        # guard inside their wrappers, after
+                        # shard_batch.
+                        with self.sanitizer.guard_transfers():
+                            self.state, metrics = step_fn(
+                                self.state, images, labels, self.rng,
+                            )
+                    else:
                         self.state, metrics = step_fn(
                             self.state, images, labels, self.rng,
                         )
-                else:
-                    self.state, metrics = step_fn(
-                        self.state, images, labels, self.rng,
-                    )
                 first = seen == start_batch
                 seen += n
+                self._steps_done += n
                 synced_metrics = None
                 if first or seen % max(cfg.log_interval, 1) < n:
                     # sync only at log boundaries to keep the pipeline full
@@ -2100,10 +2267,26 @@ class Trainer:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
                     profiling = False
+                if self._profile_window is not None:
+                    # --profile-steps A:B: close the capture after the
+                    # dispatch that crossed B (syncs first; emits the
+                    # profile_capture event).
+                    self._drive_profile_window(before_dispatch=False)
             jax.block_until_ready(self.state.params)
         finally:
             if profiling:  # epoch shorter than profile_steps, or a raise
                 jax.profiler.stop_trace()
+            if self._profile_window_started:
+                # A raise (or an epoch ending inside the window) must
+                # not leave the process-wide profiler slot held; the
+                # truncated capture is final — the window does not
+                # re-open next epoch.
+                try:
+                    self._profiler.stop(telemetry=self.telemetry)
+                except RuntimeError:
+                    pass
+                self._profile_window_started = False
+                self._profile_window = None
         epoch_time = time.perf_counter() - epoch_start
         if cfg.timing_csv_prefix and jax.process_index() == 0:
             self._dump_timing_csvs(epoch, batch_times, epoch_time)

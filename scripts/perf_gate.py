@@ -58,6 +58,11 @@ BENCH_ARGS = [
     "--model", "bnn-mlp-small", "--batch-size", "256",
     "--comm-bench", "--comm-batch-size", "256", "--comm-steps", "5",
     "--serve-p99-bench",
+    # Per-program cost ledger (ISSUE 14; ROADMAP item 5's MFU slice):
+    # cost-analysis flops are exact for a fixed model/batch/jax, the
+    # measured-MFU floor is wide-band (OBSERVABILITY.md "Device
+    # profiling", PERF.md "MFU floor").
+    "--device-costs-bench",
     # LM serving slice (ROADMAP item 5 remnant, landed with ISSUE 13):
     # tiny geometry keeps the gate's wall clock sane while still
     # exercising the real engine, scheduler and all three compiled
@@ -122,6 +127,18 @@ METRIC_PATHS = {
         "lm_serve.packed_1bit.streams_8.p99_intertoken_ms", "max"),
     "lm_spec_acceptance_rate": (
         "lm_serve.spec.acceptance_rate", "min"),
+    # Per-program cost ledger (ISSUE 14): XLA's cost-model flops for
+    # the train step are a pure function of (model, batch, jax
+    # version) — gated EXACTLY like the wire bytes; a drift means the
+    # lowered program changed (a GEMM stopped being a dot, an
+    # optimizer fusion broke) and must be re-banked deliberately. The
+    # measured-MFU floor is the wide-band catastrophe detector ROADMAP
+    # item 5 asked for: CPU throughput jitters, but a hot-path host
+    # leak COLLAPSES achieved flops/s rather than wiggling it.
+    "train_step_cost_flops": (
+        "device_costs.cost_flops", "exact"),
+    "train_step_mfu_measured": (
+        "device_costs.mfu_measured", "min"),
     # Steady-state step-time ceilings (wide band, see module docstring).
     "fp32_dp_step_time_ms": (
         "comm.modes.none.step_time_ms", "max"),
@@ -159,20 +176,36 @@ STEP_TIME_TOLERANCE = 3.0
 MIN_TOLERANCES = {
     "lm_tokens_per_sec_1stream": 0.75,
     "lm_spec_acceptance_rate": 0.1,
+    "train_step_mfu_measured": 0.75,
 }
+
+# Serving-latency bands whose trips the gate EXPLAINS with `cli
+# trace`-style tail attribution over the bench run's probe events
+# (ROADMAP item 5: "EXPLAIN any band trip, not just detect it").
+SERVING_BANDS = (
+    "classifier_p99_under_saturation_ms",
+    "lm_p99_intertoken_ms_8streams",
+)
+# MFU/cost bands whose trips print the per-program cost ledger.
+MFU_BANDS = ("train_step_mfu_measured", "train_step_cost_flops")
 
 # bench reports "below measurement floor" instead of a number when a
 # variant ran faster than it can time honestly — never a regression.
 _FLOOR = "below measurement floor"
 
 
-def run_bench() -> dict:
+def run_bench(events_dir: str | None = None) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     ).strip()
     cmd = [sys.executable, os.path.join(REPO, "bench.py"), *BENCH_ARGS]
+    if events_dir:
+        # Traced probe events land next to the mirror: a tripped
+        # serving band explains itself from these (explain_failures).
+        env["JG_TRACE"] = "1"
+        cmd += ["--events", os.path.join(events_dir, "bench_events.jsonl")]
     print("perf_gate: running", " ".join(cmd), file=sys.stderr, flush=True)
     out = subprocess.run(
         cmd, env=env, cwd=REPO, check=True, capture_output=True, text=True
@@ -226,6 +259,64 @@ def compare(baselines: dict, record: dict) -> list:
     return failures
 
 
+def explain_failures(
+    failures: list, record: dict, events_dir: str | None,
+) -> str:
+    """Turn a band trip into a diagnosis, not just a detection
+    (ROADMAP item 5's "EXPLAIN any band trip"):
+
+    * a serving-latency trip runs the `cli trace` tail attribution over
+      the bench probe's traced events (bench wrote them under
+      ``<events_dir>/serving_p99/`` when the gate armed tracing) and
+      appends the per-kind critical-path breakdown — "p99 is
+      queue-dominated" vs "slow dispatch" in the failure output itself;
+    * an MFU/cost trip appends the per-program cost ledger section
+      (flops, HBM, measured-vs-analytic reconciliation) so the reader
+      sees WHICH program drifted and by how much.
+
+    Best-effort: a missing/untraced events dir degrades to a note, the
+    gate's verdict never depends on the explanation succeeding."""
+    failed_names = {f.split(":", 1)[0] for f in failures}
+    parts: list = []
+    if failed_names & set(SERVING_BANDS):
+        probe_events = os.path.join(
+            events_dir or "", "serving_p99", "events.jsonl"
+        )
+        try:
+            sys.path.insert(0, REPO)
+            from distributed_mnist_bnns_tpu.obs.trace import (
+                load_spans,
+                render_attribution,
+                tail_attribution,
+            )
+
+            spans = load_spans(probe_events)
+            if spans:
+                report = tail_attribution(spans, pct=99.0)
+                parts.append(
+                    "serving band tripped — tail attribution over the "
+                    f"probe's traced events ({probe_events}):\n"
+                    + render_attribution(report)
+                )
+            else:
+                parts.append(
+                    f"serving band tripped but {probe_events} holds no "
+                    "spans (probe untraced?)"
+                )
+        except (OSError, ImportError) as e:
+            parts.append(
+                f"serving band tripped; tail attribution unavailable "
+                f"({type(e).__name__}: {e})"
+            )
+    if failed_names & set(MFU_BANDS):
+        section = record.get("device_costs")
+        parts.append(
+            "MFU/cost band tripped — per-program cost ledger:\n"
+            + json.dumps(section, indent=1, sort_keys=True)
+        )
+    return "\n\n".join(parts)
+
+
 def bank(record: dict, prev: dict | None = None) -> dict:
     metrics = {}
     prev_metrics = (prev or {}).get("metrics", {})
@@ -265,15 +356,19 @@ def bank(record: dict, prev: dict | None = None) -> dict:
         "note": (
             "Perf-regression baselines for the CPU-measurable comm "
             "slice (scripts/perf_gate.py; ROADMAP item 5). Byte counts "
-            "are analytic-over-real-buffer-sizes and gated EXACTLY; "
+            "and the train-step cost-analysis flops (device_costs "
+            "section, ISSUE 14) are deterministic and gated EXACTLY; "
             "compile counts and the wire ratio are ceilings; step "
             "times, the classifier p99-under-saturation "
             "(serve/harness.py) and the LM inter-token p99 are WIDE-"
             "band ceilings (noise-tolerant, catch per-step/per-request "
-            "host-work leaks into the hot path); LM tokens/sec and the "
-            "spec-decode draft-acceptance rate are FLOORS (kind=min: "
-            "measured >= baseline*(1-tolerance)). Re-bank deliberate "
-            "changes with scripts/perf_gate.py --update."
+            "host-work leaks into the hot path); LM tokens/sec, the "
+            "spec-decode draft-acceptance rate and the measured "
+            "train-step MFU are FLOORS (kind=min: measured >= "
+            "baseline*(1-tolerance)). Serving-band and MFU-band trips "
+            "print their own explanation (tail attribution / cost "
+            "ledger — explain_failures). Re-bank deliberate changes "
+            "with scripts/perf_gate.py --update."
         ),
         "bench_args": BENCH_ARGS,
         "metrics": metrics,
@@ -289,11 +384,20 @@ def main() -> int:
                          "running bench.py")
     args = ap.parse_args()
 
+    events_dir = None
     if args.bench_json:
         with open(args.bench_json) as f:
             record = json.load(f)
+        # A saved record may carry its probe's events dir (bench banks
+        # it in the serving_p99 section when tracing was armed).
+        p99 = record.get("serving_p99")
+        if isinstance(p99, dict) and p99.get("events_dir"):
+            events_dir = os.path.dirname(p99["events_dir"])
     else:
-        record = run_bench()
+        import tempfile
+
+        events_dir = tempfile.mkdtemp(prefix="perf_gate_events_")
+        record = run_bench(events_dir)
 
     if args.update:
         prev = None
@@ -318,6 +422,9 @@ def main() -> int:
         print("\nPERF GATE FAILED:", file=sys.stderr)
         for f_ in failures:
             print(f"  - {f_}", file=sys.stderr)
+        explanation = explain_failures(failures, record, events_dir)
+        if explanation:
+            print("\n" + explanation, file=sys.stderr)
         return 1
     print("perf_gate: all metrics within bands")
     return 0

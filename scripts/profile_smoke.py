@@ -1,0 +1,246 @@
+"""Profile smoke (CI): on-demand device introspection on a live,
+fence-armed server (ISSUE 14, OBSERVABILITY.md "Device profiling").
+
+Boots a real ``cli serve --lm`` subprocess (its budget-0 recompile
+fence is armed by default) with the cost ledger on (``JG_COSTS=1``) and
+tracing armed, drives generation traffic through it, then — mid-traffic
+— hits ``POST /admin/profile`` and asserts the whole device-side story:
+
+  * the capture succeeds off-path (traffic keeps streaming through the
+    window) and reports a non-empty artifact dir;
+  * the artifact is LOADABLE: the Chrome-trace half parses, and its
+    step markers carry a ``jg_trace`` id that matches a trace id in the
+    host span events — the host-trace <-> device-profile join;
+  * a ``profile_capture`` event landed in the events log;
+  * ``/healthz`` carries the per-program cost ledger (flops + measured
+    MFU for the compiled programs) and the paged-pool HBM attribution;
+  * ``recompiles_post_warmup == 0`` AFTER the capture — arming
+    profiling + costs kept the one-compiled-signature contract;
+  * SIGTERM drains to exit 0 with the telemetry sealed.
+
+Usage: python scripts/profile_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None,
+                        help="work dir (default: a fresh temp dir)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the work dir for inspection")
+    args = parser.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="profile_smoke_")
+    tel_dir = os.path.join(work, "telemetry")
+    artifact = os.path.join(work, "lm_packed.msgpack")
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_mnist_bnns_tpu.infer import export_packed
+    from distributed_mnist_bnns_tpu.models.transformer import BinarizedLM
+    from distributed_mnist_bnns_tpu.obs import load_events
+    from distributed_mnist_bnns_tpu.obs.profile import summarize_capture
+    from distributed_mnist_bnns_tpu.serve.lm import client as lc
+
+    model = BinarizedLM(
+        vocab=64, max_len=64, embed_dim=32, depth=1, num_heads=2,
+        attention="xla", backend="xla",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    export_packed(model, variables, artifact)
+
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "JG_COSTS": "1"}
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+            "serve", "--lm",
+            "--artifact", artifact,
+            "--port", str(port),
+            "--slots", "2",
+            "--page-size", "8",
+            "--prefill-chunk", "8",
+            "--queue-depth", "4",
+            "--telemetry-dir", tel_dir,
+            "--trace",
+            "--interpret",
+            "--log-file", os.path.join(work, "profile_smoke.log"),
+        ],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    )
+
+    failures = []
+    try:
+        for _ in range(240):   # jax import + warmup compiles are slow
+            try:
+                if lc.healthz(base, timeout=2)[0] == 200:
+                    break
+            except OSError:
+                pass
+            if proc.poll() is not None:
+                print(f"FAIL: server died at startup (rc {proc.returncode})",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.5)
+        else:
+            print("FAIL: server never became healthy", file=sys.stderr)
+            return 1
+
+        # Continuous traffic through the capture window: repeated short
+        # generations so decode iterations keep dispatching.
+        stop = [False]
+        stream_fail = []
+
+        def traffic() -> None:
+            i = 0
+            while not stop[0]:
+                i += 1
+                try:
+                    code, _ = lc.generate(
+                        base, [1 + (i % 8), 2, 3], max_new_tokens=16,
+                        deadline_ms=60000, timeout=90,
+                    )
+                    if code != 200:
+                        stream_fail.append(f"generate rc {code}")
+                except OSError as e:
+                    if not stop[0]:
+                        stream_fail.append(f"transport: {e}")
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(1.0)
+
+        # -- the on-demand capture, mid-traffic ---------------------------
+        code, cap = _post(base, "/admin/profile", {"duration_ms": 1500})
+        if code != 200:
+            failures.append(f"/admin/profile -> {code}: {cap}")
+            cap = {}
+        if cap and not (cap.get("files", 0) > 0
+                        and cap.get("total_bytes", 0) > 0):
+            failures.append(f"capture artifact empty: {cap}")
+
+        stop[0] = True
+        t.join(timeout=90)
+        if stream_fail:
+            failures.append(
+                f"traffic failed during capture: {stream_fail[:3]}"
+            )
+
+        # -- healthz: fence + cost ledger + pool census -------------------
+        _, health_raw = lc.healthz(base, timeout=10)
+        health = json.loads(health_raw)
+        if health.get("recompiles_post_warmup") != 0:
+            failures.append(
+                "recompiles_post_warmup != 0 after the capture: "
+                f"{health.get('recompiles_post_warmup')} "
+                f"(fence_error={health.get('fence_error')})"
+            )
+        programs = health.get("programs") or {}
+        for prog in ("lm_prefill", "lm_decode"):
+            row = programs.get(prog) or {}
+            if not row.get("flops"):
+                failures.append(f"/healthz programs missing {prog}: {row}")
+        if not (programs.get("lm_decode") or {}).get("dispatches"):
+            failures.append("lm_decode has no measured dispatches")
+        pool = health.get("kv_pool") or {}
+        if not pool.get("reserved_bytes"):
+            failures.append(f"kv_pool census missing: {pool}")
+
+        # -- graceful drain ----------------------------------------------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        if rc != 0:
+            failures.append(f"SIGTERM drain exit {rc} (want 0)")
+
+        # -- events + the host<->device join ------------------------------
+        events = load_events(os.path.join(tel_dir, "events.jsonl"))
+        kinds = {e.get("kind") for e in events}
+        for kind in ("profile_capture", "program_cost", "drain"):
+            if kind not in kinds:
+                failures.append(f"missing {kind} event")
+        if cap.get("dir"):
+            try:
+                summary = summarize_capture(cap["dir"])
+                if summary["annotated_steps"] < 1:
+                    failures.append(
+                        "capture has no jg_step markers "
+                        f"({summary['events']} events)"
+                    )
+                span_traces = {
+                    e.get("trace") for e in events
+                    if e.get("kind") == "span"
+                }
+                if not any(tid in span_traces
+                           for tid in summary["trace_ids"]):
+                    failures.append(
+                        "no capture trace id joins the host span "
+                        f"events ({summary['trace_ids'][:3]})"
+                    )
+            except (OSError, ValueError, KeyError) as e:
+                failures.append(f"capture not loadable: {e}")
+
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        if args.keep:
+            print(f"work dir kept: {work}", file=sys.stderr)
+        elif args.dir is None:
+            shutil.rmtree(work, ignore_errors=True)
+
+    if failures:
+        print("PROFILE SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "ok": True,
+        "capture_bytes": cap.get("total_bytes"),
+        "programs": sorted((health.get("programs") or {})),
+    }))
+    return 0
+
+
+def _post(base: str, path: str, body: dict, timeout: float = 60.0):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        base + path, json.dumps(body).encode(),
+        {"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+if __name__ == "__main__":
+    sys.exit(main())
