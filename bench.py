@@ -289,7 +289,7 @@ SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
     "device_resident_epoch", "train_step_per_backend", "comm",
     "comm_fsdp", "lm_serve", "serving_p99", "cold_start",
-    "device_costs",
+    "device_costs", "fleet_availability",
 )
 
 
@@ -1568,6 +1568,14 @@ def main() -> None:
                         "saturation through the real serving engine "
                         "(serve/harness.py): the gateable Tail-at-Scale "
                         "number the perf gate bands (ROADMAP item 5)")
+    p.add_argument("--fleet-avail-bench", action="store_true",
+                   help="also probe fleet availability under chaos "
+                        "(serve/fleet/harness.py): a saturated "
+                        "3-replica fleet through the real router has "
+                        "one replica chaos-stalled then KILLED "
+                        "mid-window; the end-to-end success fraction "
+                        "is the perf gate's "
+                        "fleet_availability_under_chaos floor")
     p.add_argument("--device-costs-bench", action="store_true",
                    help="per-program HLO cost-ledger section "
                         "(OBSERVABILITY.md 'Device profiling'): "
@@ -2016,6 +2024,24 @@ def main() -> None:
                     p99_tel.close()
         except Exception as e:  # never let the extra kill the bench line
             result["serving_p99"] = f"failed: {e!r:.300}"
+
+    if args.fleet_avail_bench and time.monotonic() < deadline - 60:
+        # Fleet availability under chaos through the REAL router
+        # dispatch policy (serve/fleet/harness.py) — the gateable
+        # fleet number (ROADMAP items 1+5; perf gate bands it as
+        # fleet_availability_under_chaos with a 0.99 floor).
+        try:
+            _progress("fleet_availability: router failover-under-kill "
+                      "section")
+            from distributed_mnist_bnns_tpu.serve.fleet.harness import (
+                fleet_availability_section,
+            )
+
+            result["fleet_availability"] = fleet_availability_section(
+                interpret=jax.default_backend() != "tpu",
+            )
+        except Exception as e:  # never let the extra kill the bench line
+            result["fleet_availability"] = f"failed: {e!r:.300}"
 
     if args.device_costs_bench and time.monotonic() < deadline - 60:
         try:

@@ -396,6 +396,115 @@ def build_parser() -> argparse.ArgumentParser:
                     help="AOT store root (default: JG_AOT_STORE or "
                          "<repo>/.jax_aot)")
     sv.add_argument("--log-file", default="log.txt")
+    fl = sub.add_parser(
+        "fleet",
+        help="multi-replica serving fleet (SERVING.md 'Fleet'): a "
+             "deadline-aware least-loaded router over N `cli serve` "
+             "replica subprocesses with per-replica health probing + "
+             "circuit breakers, retry-on-another-replica failover, "
+             "autoscaling between --min/--max replicas off sustained "
+             "queue depth + shed rate, rolling artifact deploys with "
+             "canary gates and automatic fleet-wide rollback "
+             "(POST /admin/rollout), SIGTERM whole-fleet drain",
+    )
+    fl.add_argument("--artifact", required=True,
+                    help="packed artifact every replica serves (from "
+                         "`export` / `lm --export`)")
+    fl.add_argument("--lm", action="store_true",
+                    help="LM fleet: `cli serve --lm` replicas routed "
+                         "via POST /generate with prefix-affinity "
+                         "(requests sharing the first page-size prompt "
+                         "block land on the replica whose prefix cache "
+                         "is warm)")
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8100,
+                    help="router port (0 = ephemeral, logged)")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="initial replica count")
+    fl.add_argument("--min-replicas", type=int, default=1)
+    fl.add_argument("--max-replicas", type=int, default=4)
+    fl.add_argument("--deadline-ms", type=float, default=1000.0,
+                    help="default client deadline at the router; an "
+                         "expired deadline fails fast with NO dispatch")
+    fl.add_argument("--max-attempts", type=int, default=3,
+                    help="dispatch attempts per request (failover to "
+                         "another replica on error/shed)")
+    fl.add_argument("--probe-interval-s", type=float, default=0.25,
+                    help="replica /healthz poll cadence (ejection on "
+                         "failed/draining/fence_error)")
+    fl.add_argument("--breaker-threshold", type=int, default=3,
+                    help="per-replica router breaker: consecutive "
+                         "failures to eject")
+    fl.add_argument("--breaker-reset-s", type=float, default=1.0)
+    fl.add_argument("--boot-timeout-s", type=float, default=180.0,
+                    help="replica spawn -> healthy budget before the "
+                         "supervisor kills and respawns it")
+    fl.add_argument("--autoscale", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="scale replicas between --min/--max off "
+                         "sustained mean queue depth + shed rate "
+                         "(cheap because --aot replicas cold-start in "
+                         "~1.7s with zero compiles)")
+    fl.add_argument("--queue-high", type=float, default=4.0,
+                    help="mean replica queue depth that (sustained) "
+                         "scales up")
+    fl.add_argument("--queue-low", type=float, default=0.5,
+                    help="mean queue depth below which (sustained, "
+                         "zero sheds) the fleet scales down")
+    fl.add_argument("--sustain-s", type=float, default=1.0,
+                    help="how long an autoscale signal must hold")
+    fl.add_argument("--cooldown-s", type=float, default=3.0,
+                    help="minimum gap between autoscale decisions")
+    fl.add_argument("--drain-timeout-s", type=float, default=60.0,
+                    help="SIGTERM whole-fleet drain budget")
+    fl.add_argument("--staging-dir", default=None,
+                    help="rollout artifact staging dir (artifacts ship "
+                         "here over utils/transfer, digest-verified; "
+                         "default: <telemetry-dir>/staging)")
+    fl.add_argument("--input-shape", type=int, nargs="+",
+                    default=[28, 28, 1],
+                    help="per-example input shape (builds the rollout "
+                         "canary probe request)")
+    fl.add_argument("--page-size", type=int, default=16,
+                    help="--lm: tokens per KV page — also the "
+                         "prefix-affinity block size (must match the "
+                         "replicas')")
+    fl.add_argument("--telemetry-dir", default=None,
+                    help="fleet events here; each replica logs under "
+                         "<dir>/replica-N/ (ids are nonce-prefixed so "
+                         "the logs merge)")
+    fl.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="router span trees (fleet.request/dispatch) "
+                         "into the event log; the x-jg-trace header is "
+                         "forwarded unchanged so replica spans join "
+                         "the same trace. Default: the JG_TRACE env "
+                         "var; needs --telemetry-dir")
+    fl.add_argument("--events-max-bytes", type=int, default=None)
+    fl.add_argument("--seed", type=int, default=0)
+    fl.add_argument("--batch-size", type=int, default=None,
+                    help="replica micro-batch size (passed through)")
+    fl.add_argument("--queue-depth", type=int, default=None,
+                    help="replica admission bound (passed through)")
+    fl.add_argument("--stall-timeout-s", type=float, default=None,
+                    help="replica stall budget (passed through)")
+    fl.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="replica fault injection (passed through to "
+                         "every replica; RESILIENCE.md)")
+    fl.add_argument("--interpret", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="replica interpreter mode (passed through)")
+    fl.add_argument("--aot", action="store_true",
+                    help="replicas boot from the AOT executable store "
+                         "(zero-compile cold starts make respawn + "
+                         "autoscale cheap; build with `cli aot build`)")
+    fl.add_argument("--aot-dir", default=None)
+    fl.add_argument("--replica-arg", action="append", default=None,
+                    metavar="ARG",
+                    help="extra raw `cli serve` argv token passed to "
+                         "every replica; repeatable (e.g. "
+                         "--replica-arg=--slots --replica-arg=8)")
+    fl.add_argument("--log-file", default="log.txt")
     inf = sub.add_parser(
         "infer",
         help="serve a packed 1-bit artifact (from `export`): evaluate "
@@ -1099,6 +1208,64 @@ def main(argv=None) -> int:
         )
         log.info("lm final next-token loss: %.4f", history[-1])
         return 0
+
+    if args.cmd == "fleet":
+        # Control plane only: the fleet process never touches jax —
+        # inference happens in the replica subprocesses it spawns.
+        from .utils import setup_logging
+
+        setup_logging(args.log_file)
+        from .serve.fleet import FleetConfig, FleetServer
+
+        rflags = []
+        if args.batch_size is not None:
+            rflags += ["--batch-size", str(args.batch_size)]
+        if args.queue_depth is not None:
+            rflags += ["--queue-depth", str(args.queue_depth)]
+        if args.stall_timeout_s is not None:
+            rflags += ["--stall-timeout-s", str(args.stall_timeout_s)]
+        if args.chaos:
+            rflags += ["--chaos", args.chaos]
+        if args.interpret is not None:
+            rflags += ["--interpret" if args.interpret
+                       else "--no-interpret"]
+        if args.aot:
+            rflags += ["--aot"]
+        if args.aot_dir:
+            rflags += ["--aot-dir", args.aot_dir]
+        if args.seed:
+            rflags += ["--seed", str(args.seed)]
+        rflags += args.replica_arg or []
+        fleet = FleetServer(FleetConfig(
+            artifact=args.artifact,
+            host=args.host,
+            port=args.port,
+            replicas=args.replicas,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            lm=args.lm,
+            page_size=args.page_size,
+            input_shape=tuple(args.input_shape),
+            default_deadline_ms=args.deadline_ms,
+            max_attempts=args.max_attempts,
+            probe_interval_s=args.probe_interval_s,
+            breaker_threshold=args.breaker_threshold,
+            breaker_reset_s=args.breaker_reset_s,
+            boot_timeout_s=args.boot_timeout_s,
+            autoscale=args.autoscale,
+            queue_high=args.queue_high,
+            queue_low=args.queue_low,
+            sustain_s=args.sustain_s,
+            cooldown_s=args.cooldown_s,
+            drain_timeout_s=args.drain_timeout_s,
+            staging_dir=args.staging_dir,
+            telemetry_dir=args.telemetry_dir,
+            trace=args.trace,
+            events_max_bytes=args.events_max_bytes,
+            seed=args.seed,
+            replica_flags=rflags,
+        ))
+        return fleet.run()
 
     if args.cmd == "serve":
         from .utils import setup_logging

@@ -333,6 +333,20 @@ class CircuitBreaker:
         cb = self._on_transition
         return (lambda: cb(old, new, reason)) if cb is not None else None
 
+    def seconds_until_half_open(self) -> float:
+        """Remaining open time before the next ``allow()`` may issue a
+        half-open probe; 0.0 unless open with the timeout still
+        running. The serving layer turns this into the ``Retry-After``
+        hint on breaker-shed 503s — a client that retries sooner is
+        guaranteed another fast-fail."""
+        with self._lock:
+            if self._state != "open":
+                return 0.0
+            remaining = self.reset_timeout_s - (
+                self._clock() - self._opened_at
+            )
+            return max(remaining, 0.0)
+
     def admits(self) -> bool:
         """Read-only admission check: False only while open with the
         reset timeout still running. Unlike ``allow()`` this never

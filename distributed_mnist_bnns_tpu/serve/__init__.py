@@ -15,6 +15,12 @@ Tail-at-Scale failure modes engineered in, not hoped away:
              over a paged KV cache, streaming `/generate` endpoint
              (``cli serve --lm``; import ``serve.lm`` explicitly — it
              pulls the jax-heavy decoder, this package root stays light)
+  fleet/     multi-replica serving fleet (``cli fleet``): deadline-aware
+             least-loaded router with per-replica health + breakers and
+             prefix-affinity, replica supervisor with autoscaling off
+             sustained queue depth/shed rate, rolling deploys with
+             canary gates + automatic rollback (SERVING.md "Fleet";
+             import ``serve.fleet`` explicitly)
 
 The circuit breaker lives in ``resilience.policy.CircuitBreaker`` (so
 training restart loops can reuse it); serving chaos (``infer_slow`` /
@@ -24,13 +30,15 @@ serving", RESILIENCE.md for the fault kinds, OBSERVABILITY.md for the
 ``drain`` / ``reload`` event schema.
 """
 
-from .core import AdmissionQueue, Request, ServeEngine
+from .core import DEFAULT_TIER, TIERS, AdmissionQueue, Request, ServeEngine
 from .server import PackedInferenceServer, ServeConfig
 
 __all__ = [
     "AdmissionQueue",
+    "DEFAULT_TIER",
     "PackedInferenceServer",
     "Request",
     "ServeConfig",
     "ServeEngine",
+    "TIERS",
 ]

@@ -10,6 +10,7 @@ bodies bitwise across an artifact swap.
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional, Tuple
@@ -17,10 +18,22 @@ from typing import Any, Dict, Optional, Tuple
 from ..obs.trace import TRACE_HEADER, format_header, mint_context
 
 
-def _request(
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """The servers send fractional delta-seconds (serve/server.py);
+    a strict integer or garbage degrades gracefully."""
+    if not value:
+        return None
+    try:
+        after = float(value)
+    except ValueError:
+        return None
+    return after if after >= 0 else None
+
+
+def _request_full(
     url: str, *, data: Optional[bytes] = None, timeout: float = 30.0,
     headers: Optional[Dict[str, str]] = None,
-) -> Tuple[int, bytes]:
+) -> Tuple[int, bytes, Dict[str, str]]:
     req = urllib.request.Request(
         url, data=data,
         headers={
@@ -30,25 +43,38 @@ def _request(
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, resp.read()
+            return resp.status, resp.read(), dict(resp.headers)
     except urllib.error.HTTPError as e:
         # 4xx/5xx still carry the server's JSON body — that's the shed/
         # deadline/breaker signal callers assert on, not a client crash.
-        return e.code, e.read()
+        return e.code, e.read(), dict(e.headers or {})
+
+
+def _request(
+    url: str, *, data: Optional[bytes] = None, timeout: float = 30.0,
+    headers: Optional[Dict[str, str]] = None,
+) -> Tuple[int, bytes]:
+    status, body, _ = _request_full(
+        url, data=data, timeout=timeout, headers=headers
+    )
+    return status, body
 
 
 def predict(
     base_url: str, images: Any, *,
     deadline_ms: Optional[float] = None, timeout: float = 30.0,
-    trace: Any = None,
+    trace: Any = None, tier: Optional[str] = None,
 ) -> Tuple[int, bytes]:
     """POST /predict. ``trace``: the x-jg-trace contract's client half —
     ``True`` mints a fresh context, or pass a ``TraceContext`` /
     preformatted header string; the server adopts it and roots the
-    request's span tree under it."""
+    request's span tree under it. ``tier``: the SLO class (``interactive``
+    / ``batch``; server default interactive)."""
     body: Dict[str, Any] = {"images": images}
     if deadline_ms is not None:
         body["deadline_ms"] = deadline_ms
+    if tier is not None:
+        body["tier"] = tier
     headers = None
     if trace is not None:
         if trace is True:
@@ -59,6 +85,75 @@ def predict(
         base_url + "/predict", data=json.dumps(body).encode(),
         timeout=timeout, headers=headers,
     )
+
+
+def predict_with_retries(
+    base_url: str, images: Any, *,
+    deadline_ms: float = 2000.0,
+    max_attempts: int = 6,
+    backoff_s: float = 0.05,
+    timeout: float = 30.0,
+    trace: Any = None,
+    tier: Optional[str] = None,
+    seed: Optional[int] = 0,
+    sleep=time.sleep,
+) -> Tuple[int, bytes]:
+    """``predict`` with retry-on-503/502 inside ONE overall deadline —
+    the client half a router target expects (SERVING.md "Fleet").
+
+    A 503 shed waits the server's ``Retry-After`` hint (capped by the
+    remaining budget); 502s and transport errors back off jittered
+    exponentially (:class:`~..resilience.policy.RetryPolicy`); 200 and
+    4xx return immediately; a 504 means the budget died server-side, so
+    there is nothing left to retry with. The per-attempt body carries
+    the REMAINING deadline, never the original — a retry must not
+    promise time it no longer has."""
+    from ..resilience.policy import RetryPolicy
+
+    policy = RetryPolicy(
+        base_backoff_s=backoff_s, max_backoff_s=1.0, seed=seed
+    )
+    overall = time.monotonic() + deadline_ms / 1e3
+    last: Tuple[int, bytes] = (599, b'{"error": "no attempt made"}')
+    for attempt in range(1, max_attempts + 1):
+        remaining_ms = (overall - time.monotonic()) * 1e3
+        if remaining_ms <= 0:
+            return last
+        body: Dict[str, Any] = {
+            "images": images, "deadline_ms": remaining_ms,
+        }
+        if tier is not None:
+            body["tier"] = tier
+        headers = None
+        if trace is not None:
+            if trace is True:
+                trace = mint_context()
+            value = (trace if isinstance(trace, str)
+                     else format_header(trace))
+            headers = {TRACE_HEADER: value}
+        try:
+            status, payload, rheaders = _request_full(
+                base_url + "/predict", data=json.dumps(body).encode(),
+                timeout=min(timeout, remaining_ms / 1e3 + 1.0),
+                headers=headers,
+            )
+        except OSError as e:
+            status, payload, rheaders = (
+                -1, f'{{"error": "{type(e).__name__}"}}'.encode(), {}
+            )
+        last = (status, payload)
+        if status == 200 or (400 <= status < 500) or status == 504:
+            return last
+        if attempt >= max_attempts:
+            return last          # decided: don't sleep a dead delay
+        if status == 503:
+            delay = parse_retry_after(rheaders.get("Retry-After"))
+            if delay is None:
+                delay = policy.backoff(attempt)
+        else:   # 5xx / transport error
+            delay = policy.backoff(attempt)
+        sleep(min(delay, max(overall - time.monotonic(), 0.0)))
+    return last
 
 
 def healthz(base_url: str, timeout: float = 10.0) -> Tuple[int, bytes]:

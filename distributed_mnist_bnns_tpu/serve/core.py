@@ -9,6 +9,13 @@ The Tail-at-Scale mechanics live here, independent of the transport:
   * admission is a **bounded queue**: when it is full the request is
     rejected immediately (``shed`` event + counter) instead of growing
     an unbounded backlog that turns a brownout into a collapse;
+  * admission is **SLO-tier aware**: every request carries a ``tier``
+    (``interactive`` outranks ``batch``); the bounded queue pops the
+    highest tier first, and a full queue admits an interactive request
+    by displacing the newest queued batch request — under saturation
+    sheds hit the low tier first (``serve_shed_total`` and the ``shed``
+    event carry a ``tier`` label). The fleet router (serve/fleet)
+    reuses this machinery verbatim;
   * a **micro-batcher** coalesces queued requests up to the compiled
     batch shape (padding the remainder), so the jitted predictor only
     ever sees one batch shape — no recompiles under bursty load;
@@ -53,6 +60,13 @@ BATCH_SECONDS = "serve_batch_seconds"
 QUEUE_DEPTH = "serve_queue_depth"
 BREAKER_TRANSITIONS_TOTAL = "serve_breaker_transitions_total"
 
+# SLO tiers, highest priority first. Admission pops high tiers first
+# and, at a full queue, displaces the newest lowest-tier request to
+# admit a higher-tier one — reject-the-cheap over reject-the-urgent.
+TIERS = ("interactive", "batch")
+DEFAULT_TIER = TIERS[0]
+_TIER_RANK = {t: i for i, t in enumerate(TIERS)}
+
 
 class Request:
     """One admitted prediction request.
@@ -65,10 +79,11 @@ class Request:
 
     __slots__ = (
         "id", "images", "n", "deadline", "enqueued_at", "event",
-        "status", "log_probs", "error", "span", "_lock", "_done",
+        "status", "log_probs", "error", "span", "tier", "_lock", "_done",
     )
 
-    def __init__(self, images: np.ndarray, deadline: float):
+    def __init__(self, images: np.ndarray, deadline: float,
+                 tier: str = DEFAULT_TIER):
         # Run-scoped id (obs/trace): nonce-prefixed so ids never collide
         # across replicas nor repeat across restarts — the join key
         # between `request` events and span trees must be globally
@@ -77,6 +92,7 @@ class Request:
         self.images = images
         self.n = int(images.shape[0])
         self.deadline = deadline
+        self.tier = tier
         self.enqueued_at = time.monotonic()
         self.event = threading.Event()
         self.status: Optional[str] = None
@@ -106,12 +122,16 @@ class Request:
 
 
 class AdmissionQueue:
-    """Bounded FIFO with a blocking batch pop.
+    """Bounded, SLO-tier-aware queue with a blocking batch pop.
 
     ``try_put`` never blocks — a full queue is the caller's signal to
-    shed. ``pop_batch`` blocks for the first request (bounded by
+    shed. ``put_or_displace`` additionally lets a higher-tier request
+    displace the newest queued lower-tier one when the queue is full
+    (the displaced request is returned so the caller can resolve it as
+    shed). ``pop_batch`` blocks for the first request (bounded by
     ``timeout``), then lingers briefly to coalesce more, popping
-    requests while their examples fit ``max_examples``.
+    requests in tier-priority order (FIFO within a tier) while their
+    examples fit ``max_examples``.
     """
 
     def __init__(self, maxsize: int):
@@ -130,6 +150,42 @@ class AdmissionQueue:
             self._items.append(req)
             self._cond.notify()
             return True
+
+    def put_or_displace(
+        self, req: Request
+    ) -> "tuple[bool, Optional[Request]]":
+        """``(admitted, displaced)``. A full queue admits ``req`` by
+        evicting the NEWEST queued request of a strictly lower tier
+        (newest: it has waited least, so evicting it wastes the least
+        queue time); the victim is returned for the caller to resolve
+        as shed. No lower-tier victim -> ``(False, None)`` and the
+        caller sheds ``req`` itself."""
+        with self._cond:
+            if len(self._items) < self.maxsize:
+                self._items.append(req)
+                self._cond.notify()
+                return True, None
+            rank = _TIER_RANK.get(req.tier, 0)
+            for i in range(len(self._items) - 1, -1, -1):
+                victim = self._items[i]
+                if _TIER_RANK.get(victim.tier, 0) > rank:
+                    del self._items[i]
+                    self._items.append(req)
+                    self._cond.notify()
+                    return True, victim
+            return False, None
+
+    def _best_index_locked(self) -> int:  # holds-lock: _cond
+        """Index of the pop head: oldest request of the highest queued
+        tier (lock held, queue non-empty)."""
+        best, best_rank = 0, None
+        for i, r in enumerate(self._items):
+            rank = _TIER_RANK.get(r.tier, 0)
+            if best_rank is None or rank < best_rank:
+                best, best_rank = i, rank
+                if rank == 0:
+                    break
+        return best
 
     def wake(self) -> None:
         """Unblock a pending ``pop_batch`` (drain/stop path)."""
@@ -167,8 +223,12 @@ class AdmissionQueue:
                     self._cond.wait(remaining)
             out: List[Request] = []
             total = 0
-            while self._items and total + self._items[0].n <= max_examples:
-                req = self._items.popleft()
+            while self._items:
+                i = self._best_index_locked()
+                req = self._items[i]
+                if total + req.n > max_examples:
+                    break
+                del self._items[i]
                 out.append(req)
                 total += req.n
             if out and claim is not None:
@@ -258,38 +318,59 @@ class ServeEngine:
 
     def submit(
         self, images: np.ndarray, deadline: float,
-        ctx: Optional[TraceContext] = None,
+        ctx: Optional[TraceContext] = None, tier: str = DEFAULT_TIER,
     ):
         """Admit or shed. Returns a :class:`Request`, or a shed-reason
         string (``draining`` | ``breaker_open`` | ``queue_full``).
         ``ctx`` is an adopted ``x-jg-trace`` context (obs/trace): the
         request's root span joins the client's trace; None mints a
-        fresh trace per request."""
+        fresh trace per request. ``tier`` is the SLO class: at a full
+        queue an ``interactive`` request may displace the newest queued
+        ``batch`` one (the victim resolves as a shed, low tier first)."""
         if self.draining or self._stop.is_set():
-            return self._shed("draining", ctx=ctx)
+            return self._shed("draining", ctx=ctx, tier=tier)
         if self.fence_error is not None:
             # The fence killed the worker: queueing would strand the
             # request until its deadline. Shed immediately and visibly
             # (health() reports failed) — same contract as the LM
             # engine's engine_failed.
-            return self._shed("engine_failed", ctx=ctx)
+            return self._shed("engine_failed", ctx=ctx, tier=tier)
         if not self.breaker.admits():
-            return self._shed("breaker_open", ctx=ctx)
-        req = Request(images, deadline)
+            return self._shed("breaker_open", ctx=ctx, tier=tier)
+        req = Request(images, deadline, tier=tier)
         req.span = self.tracer.start(
             "serve.request", kind="request", ctx=ctx, fresh=True,
-            id=req.id, n=req.n,
+            id=req.id, n=req.n, tier=tier,
         )
-        if not self.queue.try_put(req):
+        admitted, victim = self.queue.put_or_displace(req)
+        if victim is not None:
+            self._displace(victim)
+        if not admitted:
             req.span.end("shed", reason="queue_full")
-            return self._shed("queue_full", spanned=True)
+            return self._shed("queue_full", spanned=True, tier=tier)
         return req
+
+    def _displace(self, victim: Request) -> None:
+        """Resolve a queue-displaced lower-tier request as an explicit
+        shed (reason ``displaced``): its waiter gets a prompt 503
+        instead of queue time it was never going to get back."""
+        if victim.finish(
+            "shed", error="displaced by a higher-tier admission"
+        ):
+            self.shed_ctr.inc(reason="displaced", tier=victim.tier)
+            self.requests_ctr.inc(status="shed")
+            victim.span.end("shed", reason="displaced")
+            if self.telemetry is not None:
+                self.telemetry.emit(
+                    "shed", reason="displaced", tier=victim.tier,
+                    id=victim.id, queue_depth=len(self.queue),
+                )
 
     def _shed(
         self, reason: str, *, ctx: Optional[TraceContext] = None,
-        spanned: bool = False,
+        spanned: bool = False, tier: str = DEFAULT_TIER,
     ) -> str:
-        self.shed_ctr.inc(reason=reason)
+        self.shed_ctr.inc(reason=reason, tier=tier)
         self.requests_ctr.inc(status="shed")
         if not spanned and self.tracer.enabled:
             # Sheds are spans too (zero-length): the slow tail's
@@ -298,10 +379,12 @@ class ServeEngine:
             self.tracer.record(
                 "serve.request", kind="request", t0=now, t1=now,
                 ctx=ctx, fresh=True, status="shed", reason=reason,
+                tier=tier,
             )
         if self.telemetry is not None:
             self.telemetry.emit(
-                "shed", reason=reason, queue_depth=len(self.queue)
+                "shed", reason=reason, tier=tier,
+                queue_depth=len(self.queue),
             )
         return reason
 

@@ -1,0 +1,56 @@
+"""serve.fleet — multi-replica serving: router, supervisor, rollouts.
+
+The fleet layer between "one hardened server" and real traffic
+(ROADMAP item 1; Dean & Barroso's Tail at Scale argues tail tolerance
+must live HERE, not in any single replica):
+
+  router.py      deadline-aware least-loaded dispatch over N replicas,
+                 per-replica health probing + circuit breakers,
+                 retry-on-another-replica inside the client deadline,
+                 x-jg-trace forwarded unchanged, prefix-affinity
+                 routing for LM fleets (rendezvous hash of the first
+                 page-size prompt block)
+  supervisor.py  replica subprocesses booted --aot from the warm store,
+                 reap + respawn with jittered backoff, autoscaling
+                 between min/max off sustained queue depth + shed rate
+  rollout.py     rolling deploys: artifact shipped over utils/transfer
+                 (digest-verified), canary reload with health + error-
+                 rate gates, automatic fleet-wide rollback on a trip
+  server.py      the `cli fleet` HTTP front end + SIGTERM drain
+  harness.py     importable 3-replica availability-under-chaos probe
+                 (the perf gate's fleet_availability_under_chaos band)
+
+None of these modules import jax — the replicas do the inference; the
+fleet process is pure control plane. See SERVING.md "Fleet",
+OBSERVABILITY.md for the fleet_dispatch / replica_health / autoscale /
+rollout event schema, and tests/test_fleet.py + scripts/fleet_smoke.py
+for the acceptance scenarios.
+"""
+
+from .router import (
+    HttpTransport,
+    Replica,
+    RouterCore,
+    affinity_key,
+)
+from .rollout import RolloutManager, stage_artifact
+from .server import FleetConfig, FleetServer
+from .supervisor import (
+    Autoscaler,
+    FleetView,
+    ReplicaSupervisor,
+)
+
+__all__ = [
+    "Autoscaler",
+    "FleetConfig",
+    "FleetServer",
+    "FleetView",
+    "HttpTransport",
+    "Replica",
+    "ReplicaSupervisor",
+    "RolloutManager",
+    "RouterCore",
+    "affinity_key",
+    "stage_artifact",
+]

@@ -68,6 +68,16 @@ def open_stream(
             parsed = json.loads(raw)
         except (ValueError, json.JSONDecodeError):
             parsed = {"error": raw.decode("utf-8", "replace")}
+        if isinstance(parsed, dict):
+            # Surface the shed backoff hint (serve/ Retry-After,
+            # fractional seconds) to the retry wrapper below.
+            from ..client import parse_retry_after
+
+            after = parse_retry_after(
+                (e.headers or {}).get("Retry-After")
+            )
+            if after is not None:
+                parsed["_retry_after"] = after
         return e.code, parsed
 
 
@@ -89,6 +99,81 @@ def generate(
     if code != 200:
         return code, [resp]
     return code, list(iter_lines(resp))
+
+
+def generate_with_retries(
+    base_url: str, prompt: Any, *,
+    max_attempts: int = 4,
+    backoff_s: float = 0.05,
+    seed: Optional[int] = 0,
+    sleep: Any = None,
+    **kw: Any,
+) -> Tuple[int, List[Dict[str, Any]]]:
+    """``generate`` with retry ONLY before the stream opens.
+
+    A 503 shed (honoring the server's fractional ``Retry-After``) and a
+    refused/failed connect both prove no tokens were produced — safe to
+    retry, including against a fleet router that will pick another
+    replica. The moment a 200 stream opens the generation is
+    NON-idempotent: a mid-stream failure surfaces as the (possibly
+    truncated) event list, never a silent re-generation with a
+    different result."""
+    import time as _time
+
+    from ...resilience.policy import RetryPolicy
+
+    sleep = sleep if sleep is not None else _time.sleep
+    policy = RetryPolicy(
+        base_backoff_s=backoff_s, max_backoff_s=1.0, seed=seed
+    )
+    last: Tuple[int, List[Dict[str, Any]]] = (
+        599, [{"error": "no attempt made"}]
+    )
+    for attempt in range(1, max_attempts + 1):
+        retry_after: Optional[float] = None
+        try:
+            code, resp = open_stream(base_url, prompt, **kw)
+        except OSError as e:
+            last = (-1, [{"error": f"transport: {type(e).__name__}"}])
+            if attempt >= max_attempts:
+                return last      # decided: don't sleep a dead delay
+            sleep(policy.backoff(attempt))
+            continue
+        if code == 200:
+            # Stream open: from here on, NEVER retry — a mid-stream
+            # death surfaces as a truncated event list (the caller can
+            # see exactly which tokens landed), not a silent
+            # re-generation that could produce different output.
+            import http.client as _http_client
+
+            events: List[Dict[str, Any]] = []
+            try:
+                for ev in iter_lines(resp):
+                    events.append(ev)
+            except (OSError, ValueError,
+                    _http_client.HTTPException) as e:
+                events.append({
+                    "error": f"stream failed: {type(e).__name__}",
+                    "truncated": True,
+                })
+            if not events or not events[-1].get("done") \
+                    and not events[-1].get("truncated"):
+                # The ndjson protocol always ends with a done event; a
+                # stream that stopped without one died mid-generation
+                # (a chunked EOF is silent at this layer).
+                events.append({
+                    "error": "stream ended without a done event",
+                    "truncated": True,
+                })
+            return code, events
+        last = (code, [resp])
+        if code != 503 or attempt >= max_attempts:
+            return last
+        if isinstance(resp, dict):
+            retry_after = resp.get("_retry_after")
+        sleep(retry_after if retry_after is not None
+              else policy.backoff(attempt))
+    return last
 
 
 def healthz(base_url: str, timeout: float = 10.0) -> Tuple[int, bytes]:

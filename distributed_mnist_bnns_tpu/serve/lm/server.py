@@ -511,7 +511,11 @@ class _LMHandler(JsonHandler):
             seed=seed, ctx=ctx,
         )
         if isinstance(req, str):       # shed reason
-            self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req})
+            # Retry-After rides every LM shed too: the retrying client
+            # half (serve/lm/client.py) and the fleet router honor it —
+            # one decode iteration is the natural turn-over hint.
+            self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req},
+                        headers={"Retry-After": "0.100"})
             return
         self._stream_reply(req, deadline)
 

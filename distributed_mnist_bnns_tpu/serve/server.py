@@ -45,6 +45,8 @@ from ..resilience.policy import CircuitBreaker
 from ..resilience.preempt import StopRequest
 from .core import (
     BREAKER_TRANSITIONS_TOTAL,
+    DEFAULT_TIER,
+    TIERS,
     AdmissionQueue,
     Request,
     ServeEngine,
@@ -62,6 +64,13 @@ _SHED_HTTP = {
     "queue_full": 503, "breaker_open": 503, "draining": 503,
     "engine_failed": 503,
 }
+
+# Retry-After hint (seconds) on non-breaker sheds: one linger window
+# plus slack — by then the queue has turned over at least one batch.
+# Fractional on purpose: the in-repo clients (and the fleet router)
+# parse floats; a strict HTTP client rounds up. Breaker sheds hint the
+# breaker's actual remaining open time instead.
+_RETRY_AFTER_S = 0.1
 
 
 @dataclass
@@ -555,17 +564,39 @@ class _Handler(JsonHandler):
             })
             return
         deadline = time.monotonic() + deadline_ms / 1e3
+        tier = body.get("tier", DEFAULT_TIER)
+        if tier not in TIERS:
+            self._reply(400, {
+                "error": f"unknown tier {tier!r} (have: "
+                         f"{', '.join(TIERS)})",
+            })
+            return
         # x-jg-trace: the client mints, this server adopts — the
         # request's span tree joins the caller's trace (obs/trace;
         # malformed headers degrade to a fresh trace, never a 4xx).
         from ..obs.trace import TRACE_HEADER, parse_header
 
         ctx = parse_header(self.headers.get(TRACE_HEADER))
-        req = engine.submit(images, deadline, ctx)
+        req = engine.submit(images, deadline, ctx, tier=tier)
         if isinstance(req, str):  # shed reason
-            self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req})
+            self._reply(_SHED_HTTP[req], {"error": "shed", "reason": req},
+                        headers=self._shed_headers(req))
             return
         self._wait_and_reply(req, deadline)
+
+    def _shed_headers(self, reason: str) -> Dict[str, str]:
+        """Retry-After for every 503: the client half (serve/client
+        retry-with-backoff, the fleet router) honors it instead of
+        guessing. Breaker sheds hint the remaining open time — retrying
+        sooner is guaranteed another fast-fail."""
+        if reason == "breaker_open":
+            after = max(
+                self.srv.breaker.seconds_until_half_open(),
+                _RETRY_AFTER_S,
+            )
+        else:
+            after = _RETRY_AFTER_S
+        return {"Retry-After": f"{after:.3f}"}
 
     def _trace_headers(self, req: Request) -> Optional[Dict[str, str]]:
         """Echo the request's trace id so an untraced-by-the-client
@@ -609,7 +640,16 @@ class _Handler(JsonHandler):
                               "id": req.id}, headers=trace_headers)
         elif status == "breaker_open":
             self._reply(503, {"error": "shed", "reason": "breaker_open",
-                              "id": req.id}, headers=trace_headers)
+                              "id": req.id},
+                        headers={**(trace_headers or {}),
+                                 **self._shed_headers("breaker_open")})
+        elif status == "shed":
+            # Queue-displaced by a higher-tier admission (core.py
+            # put_or_displace): an explicit low-tier shed, not an error.
+            self._reply(503, {"error": "shed", "reason": "displaced",
+                              "tier": req.tier, "id": req.id},
+                        headers={**(trace_headers or {}),
+                                 **self._shed_headers("displaced")})
         else:
             self._reply(502, {"error": req.error or "backend failure",
                               "id": req.id}, headers=trace_headers)

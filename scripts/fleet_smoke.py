@@ -1,0 +1,391 @@
+"""Fleet smoke (CI): the multi-replica serving fleet must hide chaos.
+
+The fleet mirror of scripts/serve_smoke.py (SERVING.md "Fleet"):
+exports a tiny artifact, banks it in a fresh AOT store, then runs a
+REAL ``cli fleet`` — 3 ``cli serve`` replica subprocesses booted
+``--aot`` with chaos stalls + backend errors scripted into every
+replica — and hammers the ROUTER with the retrying client at
+saturation while the scenario unfolds:
+
+  * replica sheds + breaker trips happen (asserted from replica event
+    logs) but NO client request fails: retry/failover absorbs them;
+  * one replica is SIGKILL'd mid-traffic — the supervisor respawns it
+    from the warm AOT store (router /healthz must show it back with
+    ``aot: hit`` and ``recompiles_post_boot == 0``), again with zero
+    failed client requests;
+  * a mid-traffic rolling reload of a byte-identical artifact promotes
+    through canary → fleet with responses BITWISE unchanged;
+  * a forced-bad-artifact rollout trips the canary gate and rolls the
+    whole fleet back (still serving 200s afterward);
+  * a client-minted ``x-jg-trace`` context is adopted by the router
+    AND the replica that served it — one trace id across both event
+    logs (the every-hop-joins-one-trace contract);
+  * SIGTERM drains the whole fleet, exit 0.
+
+Usage: python scripts/fleet_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHAOS_SPEC = (
+    "infer_slow@step=6,times=2,delay_s=0.3"   # straggler batches
+    ";infer_error@step=12,times=3"            # breaker trip + close
+)
+HAMMER_THREADS = 8
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _healthz(base: str, timeout: float = 5.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(base + "/healthz",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait(predicate, budget_s: float, interval_s: float = 0.5) -> bool:
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return True
+        except OSError:
+            pass
+        time.sleep(interval_s)
+    return False
+
+
+def _post(base: str, path: str, payload: dict, timeout: float = 300.0):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None)
+    parser.add_argument("--keep", action="store_true")
+    args = parser.parse_args(argv)
+
+    work = args.dir or tempfile.mkdtemp(prefix="fleet_smoke_")
+    os.makedirs(work, exist_ok=True)
+    tel_dir = os.path.join(work, "telemetry")
+    aot_dir = os.path.join(work, "aot")
+    artifact = os.path.join(work, "model_packed.msgpack")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    import jax
+
+    from distributed_mnist_bnns_tpu.infer import export_packed
+    from distributed_mnist_bnns_tpu.models import bnn_mlp_small
+    from distributed_mnist_bnns_tpu.obs import load_events, mint_context
+    from distributed_mnist_bnns_tpu.obs.trace import format_header
+    from distributed_mnist_bnns_tpu.serve import client as sc
+
+    model = bnn_mlp_small(backend="xla")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 28, 28, 1))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        x, train=True,
+    )
+    export_packed(model, variables, artifact)
+
+    # Warm AOT store: replicas (and respawns) boot with zero compiles.
+    build = subprocess.run(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+         "aot", "build", "--store", aot_dir, "--artifact", artifact,
+         "--batch-size", "8", "--input-shape", "28", "28", "1",
+         "--interpret"],
+        env=env, cwd=repo, capture_output=True, text=True,
+    )
+    if build.returncode != 0:
+        print(f"FAIL: aot build rc {build.returncode}:\n"
+              f"{build.stderr[-2000:]}", file=sys.stderr)
+        return 1
+
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+            "fleet",
+            "--artifact", artifact,
+            "--port", str(port),
+            "--replicas", "3",
+            "--min-replicas", "3", "--max-replicas", "3",
+            "--no-autoscale",          # membership churn is scripted here
+            "--deadline-ms", "3000",
+            "--probe-interval-s", "0.1",
+            "--breaker-reset-s", "0.5",
+            "--boot-timeout-s", "150",
+            "--batch-size", "8",
+            "--queue-depth", "4",
+            "--stall-timeout-s", "0.15",
+            "--chaos", CHAOS_SPEC,
+            "--interpret",
+            "--aot", "--aot-dir", aot_dir,
+            "--telemetry-dir", tel_dir,
+            "--trace",
+            "--replica-arg=--breaker-threshold", "--replica-arg=3",
+            "--replica-arg=--breaker-reset-s", "--replica-arg=0.4",
+            "--log-file", os.path.join(work, "fleet.log"),
+        ],
+        env=env, cwd=repo,
+    )
+
+    failures = []
+    stop_hammer = threading.Event()
+    codes = []
+    lock = threading.Lock()
+    imgs = [[[[0.1 * ((i + j) % 7)] for j in range(28)]
+             for i in range(28)]]
+
+    def hammer(tid: int) -> None:
+        while not stop_hammer.is_set():
+            try:
+                code, _ = sc.predict_with_retries(
+                    base, imgs * 2, deadline_ms=8000.0,
+                    max_attempts=10, timeout=15.0,
+                    tier="batch" if tid % 2 else "interactive",
+                )
+            except OSError as e:
+                code = -1
+                print(f"hammer[{tid}]: transport error {e}",
+                      file=sys.stderr)
+            with lock:
+                codes.append(code)
+            time.sleep(0.01)
+
+    try:
+        if not _wait(
+            lambda: _healthz(base).get("live") == 3, budget_s=180
+        ):
+            print("FAIL: fleet never reached 3 live replicas",
+                  file=sys.stderr)
+            return 1
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,), daemon=True)
+            for i in range(HAMMER_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)       # let chaos stalls/errors fire under load
+
+        # -- traced probe through router AND replica ---------------------
+        probe_ctx = mint_context()
+        code, probe_a = sc.predict(
+            base, imgs, deadline_ms=8000, timeout=15,
+            trace=format_header(probe_ctx),
+        )
+        if code != 200:
+            failures.append(f"traced probe returned {code}")
+
+        # -- kill a replica: supervisor must respawn from the AOT store --
+        rows = _healthz(base)["replicas"]
+        victim = next(r for r in rows if r["healthy"])
+        os.kill(victim["pid"], signal.SIGKILL)
+        t_kill = time.monotonic()
+
+        def respawned() -> bool:
+            h = _healthz(base)
+            ids = {r["id"] for r in h["replicas"]}
+            return h["live"] == 3 and victim["id"] not in ids
+
+        if not _wait(respawned, budget_s=150):
+            failures.append(
+                "killed replica was not respawned to 3 live"
+            )
+        else:
+            print(f"respawn took {time.monotonic() - t_kill:.1f}s "
+                  "(kill -> 3 live)", file=sys.stderr)
+            new_rows = _healthz(base)["replicas"]
+            fresh = [r for r in new_rows
+                     if r["id"] not in {x["id"] for x in rows}]
+            if not fresh:
+                failures.append("no fresh replica row after respawn")
+            else:
+                if fresh[0].get("aot") != "hit":
+                    failures.append(
+                        f"respawned replica booted aot={fresh[0].get('aot')!r}"
+                        " (want 'hit' — the warm-store respawn contract)"
+                    )
+                if fresh[0].get("recompiles_post_boot") != 0:
+                    failures.append(
+                        "respawned replica recompiles_post_boot = "
+                        f"{fresh[0].get('recompiles_post_boot')} (want 0)"
+                    )
+
+        # Let the respawned replica's scripted chaos burst exhaust
+        # under the hammer traffic (a fresh process replays the chaos
+        # spec from batch 0) before gating a rollout on its error rate.
+        time.sleep(3.0)
+
+        # -- rolling reload, byte-identical artifact ---------------------
+        artifact2 = os.path.join(work, "model_packed_v2.msgpack")
+        shutil.copyfile(artifact, artifact2)
+        code, before = sc.predict(base, imgs, deadline_ms=8000,
+                                  timeout=15)
+        rc, result = _post(base, "/admin/rollout",
+                           {"artifact": artifact2})
+        if rc != 200 or result.get("status") != "promoted":
+            failures.append(f"rolling reload failed: {rc} {result}")
+        code2, after = sc.predict(base, imgs, deadline_ms=8000,
+                                  timeout=15)
+        if code == code2 == 200:
+            if before != after:
+                failures.append(
+                    "responses not bitwise identical across the "
+                    "rolling reload"
+                )
+        else:
+            failures.append(
+                f"reload probes failed: {code}/{code2}"
+            )
+
+        # -- forced-bad-artifact rollout must roll back ------------------
+        bad = os.path.join(work, "bad.msgpack")
+        with open(bad, "wb") as f:
+            f.write(os.urandom(512))
+        rc, result = _post(base, "/admin/rollout", {"artifact": bad})
+        if rc != 200 or result.get("status") != "rolled_back":
+            failures.append(
+                f"bad artifact did not roll back: {rc} {result}"
+            )
+        code, _ = sc.predict(base, imgs, deadline_ms=8000, timeout=15)
+        if code != 200:
+            failures.append(
+                f"fleet not serving after rollback (got {code})"
+            )
+
+        stop_hammer.set()
+        for t in threads:
+            t.join(timeout=30)
+        if any(t.is_alive() for t in threads):
+            failures.append("hammer thread hung")
+
+        by_code = {c: codes.count(c) for c in sorted(set(codes))}
+        bad_final = {c: n for c, n in by_code.items() if c != 200}
+        if bad_final:
+            failures.append(
+                "client requests failed beyond the retry window: "
+                f"{bad_final} (of {len(codes)})"
+            )
+        if not by_code.get(200):
+            failures.append(f"no request ever succeeded: {by_code}")
+
+        # -- SIGTERM: the whole fleet drains, exit 0 ---------------------
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = proc.wait()
+            failures.append("fleet did not drain within 120s of SIGTERM")
+        if rc != 0:
+            failures.append(f"fleet exited {rc} after SIGTERM (want 0)")
+    finally:
+        stop_hammer.set()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # -- event-log assertions ------------------------------------------------
+    fleet_events = load_events(os.path.join(tel_dir, "events.jsonl"))
+    kinds = {e["kind"] for e in fleet_events}
+    for kind in ("fleet_dispatch", "replica_health", "replica_spawn",
+                 "replica_exit", "rollout", "drain"):
+        if kind not in kinds:
+            failures.append(f"fleet event log is missing {kind!r}")
+    roll_phases = [e["phase"] for e in fleet_events
+                   if e["kind"] == "rollout"]
+    for phase in ("ship", "canary_ok", "complete", "trip",
+                  "rolled_back"):
+        if phase not in roll_phases:
+            failures.append(f"rollout log is missing phase {phase!r}")
+    exits = [e for e in fleet_events if e["kind"] == "replica_exit"
+             and e.get("cause") == "died"]
+    if not exits:
+        failures.append("no replica_exit(died) event for the kill")
+
+    # replica logs: chaos fired, sheds + breaker cycle happened SOMEWHERE
+    # in the fleet (each replica runs the same scripted chaos)
+    replica_events = []
+    for name in sorted(os.listdir(tel_dir)):
+        path = os.path.join(tel_dir, name, "events.jsonl")
+        if name.startswith("replica-") and os.path.exists(path):
+            replica_events.extend(load_events(path))
+    rkinds = {e["kind"] for e in replica_events}
+    for kind in ("fault_injected", "shed", "breaker_open",
+                 "breaker_close"):
+        if kind not in rkinds:
+            failures.append(f"replica logs are missing {kind!r}")
+    sheds = [e for e in replica_events if e["kind"] == "shed"]
+    if not any(e.get("tier") for e in sheds):
+        failures.append("replica sheds carry no tier label")
+
+    # one trace id across router and replica: the probe's minted
+    # context must appear in BOTH span logs
+    fleet_spans = [e for e in fleet_events if e["kind"] == "span"]
+    replica_spans = [e for e in replica_events if e["kind"] == "span"]
+    if not any(s.get("trace") == probe_ctx.trace_id
+               for s in fleet_spans):
+        failures.append(
+            "probe trace id missing from the ROUTER span log"
+        )
+    if not any(s.get("trace") == probe_ctx.trace_id
+               for s in replica_spans):
+        failures.append(
+            "probe trace id missing from every REPLICA span log — "
+            "the router must forward x-jg-trace unchanged"
+        )
+
+    summary = {
+        "responses_by_code": by_code,
+        "fleet_events": {k: sum(1 for e in fleet_events
+                                if e["kind"] == k)
+                         for k in sorted(kinds)},
+        "rollout_phases": roll_phases,
+        "replica_event_kinds": sorted(rkinds),
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=2, default=str))
+    for failure in failures:
+        print(f"FAIL: {failure}", file=sys.stderr)
+    if not args.keep and args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,12 @@ BENCH_ARGS = [
     "--model", "bnn-mlp-small", "--batch-size", "256",
     "--comm-bench", "--comm-batch-size", "256", "--comm-steps", "5",
     "--serve-p99-bench",
+    # Fleet availability under chaos (ISSUE 15; ROADMAP item 1's named
+    # follow-through): a saturated 3-replica in-process fleet through
+    # the REAL router has one replica stalled then killed mid-window —
+    # the success fraction is a floor, and a trip prints the section's
+    # per-replica breaker/health transition log (explain_failures).
+    "--fleet-avail-bench",
     # Per-program cost ledger (ISSUE 14; ROADMAP item 5's MFU slice):
     # cost-analysis flops are exact for a fixed model/batch/jax, the
     # measured-MFU floor is wide-band (OBSERVABILITY.md "Device
@@ -127,6 +133,14 @@ METRIC_PATHS = {
         "lm_serve.packed_1bit.streams_8.p99_intertoken_ms", "max"),
     "lm_spec_acceptance_rate": (
         "lm_serve.spec.acceptance_rate", "min"),
+    # Fleet availability under chaos (ISSUE 15): success fraction of
+    # saturating client requests against a 3-replica fleet while one
+    # replica is chaos-stalled then killed mid-window — retry/failover
+    # must keep this >= 0.99 (the acceptance floor). Banked at 1.0 with
+    # a 0.01 tolerance rather than --update-measured: the floor IS the
+    # contract, not a noise band.
+    "fleet_availability_under_chaos": (
+        "fleet_availability.availability", "min"),
     # Per-program cost ledger (ISSUE 14): XLA's cost-model flops for
     # the train step are a pure function of (model, batch, jax
     # version) — gated EXACTLY like the wire bytes; a drift means the
@@ -177,6 +191,7 @@ MIN_TOLERANCES = {
     "lm_tokens_per_sec_1stream": 0.75,
     "lm_spec_acceptance_rate": 0.1,
     "train_step_mfu_measured": 0.75,
+    "fleet_availability_under_chaos": 0.01,
 }
 
 # Serving-latency bands whose trips the gate EXPLAINS with `cli
@@ -188,6 +203,9 @@ SERVING_BANDS = (
 )
 # MFU/cost bands whose trips print the per-program cost ledger.
 MFU_BANDS = ("train_step_mfu_measured", "train_step_cost_flops")
+# Fleet bands whose trips print the availability probe's per-replica
+# health/breaker transition log (which replica flapped, when, why).
+FLEET_BANDS = ("fleet_availability_under_chaos",)
 
 # bench reports "below measurement floor" instead of a number when a
 # variant ran faster than it can time honestly — never a regression.
@@ -314,6 +332,25 @@ def explain_failures(
             "MFU/cost band tripped — per-program cost ledger:\n"
             + json.dumps(section, indent=1, sort_keys=True)
         )
+    if failed_names & set(FLEET_BANDS):
+        section = record.get("fleet_availability")
+        if isinstance(section, dict):
+            parts.append(
+                "fleet availability band tripped — per-replica "
+                "health/breaker transitions over the probe window "
+                f"(killed {section.get('killed_replica')} at "
+                f"{section.get('killed_at_s')}s, outcomes "
+                f"{section.get('outcomes')}):\n"
+                + json.dumps(
+                    section.get("replica_transitions"),
+                    indent=1, sort_keys=True,
+                )
+            )
+        else:
+            parts.append(
+                "fleet availability band tripped and the probe section "
+                f"is missing/failed: {section!r}"
+            )
     return "\n\n".join(parts)
 
 
@@ -363,11 +400,15 @@ def bank(record: dict, prev: dict | None = None) -> dict:
             "(serve/harness.py) and the LM inter-token p99 are WIDE-"
             "band ceilings (noise-tolerant, catch per-step/per-request "
             "host-work leaks into the hot path); LM tokens/sec, the "
-            "spec-decode draft-acceptance rate and the measured "
-            "train-step MFU are FLOORS (kind=min: measured >= "
-            "baseline*(1-tolerance)). Serving-band and MFU-band trips "
-            "print their own explanation (tail attribution / cost "
-            "ledger — explain_failures). Re-bank deliberate changes "
+            "spec-decode draft-acceptance rate, the measured "
+            "train-step MFU and the fleet availability-under-chaos "
+            "(serve/fleet/harness.py: 3 replicas, one chaos-stalled "
+            "then killed mid-saturation, success fraction through the "
+            "real router) are FLOORS (kind=min: measured >= "
+            "baseline*(1-tolerance)). Serving-band, MFU-band and "
+            "fleet-band trips print their own explanation (tail "
+            "attribution / cost ledger / per-replica transition log — "
+            "explain_failures). Re-bank deliberate changes "
             "with scripts/perf_gate.py --update."
         ),
         "bench_args": BENCH_ARGS,

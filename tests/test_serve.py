@@ -154,6 +154,9 @@ def test_roundtrip_health_metrics(artifact, tmp_path):
         assert code == 400 and b"input shape" in body
         # a junk deadline is a 400 too, never a handler crash
         assert sc.predict(base, _imgs(1), deadline_ms="fast")[0] == 400
+        # SLO tiers: unknown tier is a 400, a valid one serves
+        assert sc.predict(base, _imgs(1), tier="junk")[0] == 400
+        assert sc.predict(base, _imgs(1), tier="batch")[0] == 200
         assert sc.predict(base, _imgs(1))[0] == 200  # still serving
     finally:
         srv.request_stop("test over")
