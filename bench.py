@@ -2037,8 +2037,19 @@ def main() -> None:
                 fleet_availability_section,
             )
 
-            result["fleet_availability"] = fleet_availability_section(
+            section = fleet_availability_section(
                 interpret=jax.default_backend() != "tpu",
+            )
+            result["fleet_availability"] = section
+            _progress(
+                "fleet_availability: %s over %s requests (%d control-"
+                "plane decisions, %d slo_alerts captured — a perf-gate "
+                "trip prints the timeline)" % (
+                    section.get("availability"),
+                    section.get("requests_total"),
+                    len(section.get("decisions") or []),
+                    len(section.get("slo_alerts") or []),
+                )
             )
         except Exception as e:  # never let the extra kill the bench line
             result["fleet_availability"] = f"failed: {e!r:.300}"

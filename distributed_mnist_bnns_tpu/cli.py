@@ -405,11 +405,23 @@ def build_parser() -> argparse.ArgumentParser:
              "autoscaling between --min/--max replicas off sustained "
              "queue depth + shed rate, rolling artifact deploys with "
              "canary gates and automatic fleet-wide rollback "
-             "(POST /admin/rollout), SIGTERM whole-fleet drain",
+             "(POST /admin/rollout), SIGTERM whole-fleet drain. "
+             "`fleet explain DIR` replays a fleet telemetry dir's "
+             "control-plane decision timeline instead of serving",
     )
-    fl.add_argument("--artifact", required=True,
+    fl.add_argument("action", nargs="*", default=[],
+                    metavar="explain DIR",
+                    help="'explain DIR': render the control-plane "
+                         "decision audit timeline (autoscaler scale/"
+                         "holds, breaker transitions, ejections, "
+                         "respawns, rollout gates) joined against SLO "
+                         "alert open/close from DIR's event log "
+                         "(OBSERVABILITY.md 'Fleet observability'); "
+                         "with no action, run the fleet server")
+    fl.add_argument("--artifact", default=None,
                     help="packed artifact every replica serves (from "
-                         "`export` / `lm --export`)")
+                         "`export` / `lm --export`); required unless "
+                         "running `fleet explain`")
     fl.add_argument("--lm", action="store_true",
                     help="LM fleet: `cli serve --lm` replicas routed "
                          "via POST /generate with prefix-affinity "
@@ -455,6 +467,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="how long an autoscale signal must hold")
     fl.add_argument("--cooldown-s", type=float, default=3.0,
                     help="minimum gap between autoscale decisions")
+    fl.add_argument("--scrape-interval-s", type=float, default=1.0,
+                    help="replica /metrics scrape cadence feeding the "
+                         "fleet-merged GET /metrics (counters sum, "
+                         "gauges fan out per replica, histograms merge "
+                         "le-exactly; OBSERVABILITY.md 'Fleet "
+                         "observability'); 0 disables scraping")
+    fl.add_argument("--slo", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="multiwindow burn-rate SLO alerting over "
+                         "routed availability + request p99 (+ LM "
+                         "inter-token p99): slo_alert events, "
+                         "slo_burn_rate/slo_budget_remaining gauges, "
+                         "open alerts in /healthz")
+    fl.add_argument("--slo-fast-window-s", type=float, default=60.0,
+                    help="SLO fast burn window (alerts open when fast "
+                         "AND slow burns exceed thresholds, close when "
+                         "the fast window drains)")
+    fl.add_argument("--slo-slow-window-s", type=float, default=300.0,
+                    help="SLO slow burn window")
     fl.add_argument("--drain-timeout-s", type=float, default=60.0,
                     help="SIGTERM whole-fleet drain budget")
     fl.add_argument("--staging-dir", default=None,
@@ -504,6 +535,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="extra raw `cli serve` argv token passed to "
                          "every replica; repeatable (e.g. "
                          "--replica-arg=--slots --replica-arg=8)")
+    fl.add_argument("--json", action="store_true",
+                    help="`fleet explain`: emit the decision timeline "
+                         "as JSON rows instead of the table")
     fl.add_argument("--log-file", default="log.txt")
     inf = sub.add_parser(
         "infer",
@@ -567,6 +601,11 @@ def build_parser() -> argparse.ArgumentParser:
     tm.add_argument("log",
                     help="path to an events.jsonl, or the telemetry "
                          "directory containing one")
+    tm.add_argument("--fleet", action="store_true",
+                    help="treat LOG as a fleet telemetry directory: "
+                         "summarize the router log plus every "
+                         "replica's subdirectory log into one combined "
+                         "report (rotated segments spanned per log)")
     tm.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
                          "of a table")
@@ -576,11 +615,18 @@ def build_parser() -> argparse.ArgumentParser:
              "'Tracing'): render the p99 tail-attribution report "
              "(where did the slow requests' time go — queue vs prefill "
              "vs decode vs stall), and/or export Chrome-trace-event "
-             "JSON loadable in Perfetto / chrome://tracing",
+             "JSON loadable in Perfetto / chrome://tracing. Multiple "
+             "logs (router dir + replica dirs) are STITCHED across the "
+             "x-jg-trace hop: replica request trees re-parent under "
+             "their router dispatch spans, so tail attribution splits "
+             "router queueing from replica queue/infer time",
     )
-    tc.add_argument("log",
-                    help="path to an events.jsonl, or the telemetry "
-                         "directory containing one")
+    tc.add_argument("log", nargs="+",
+                    help="path(s) to events.jsonl files or telemetry "
+                         "directories; pass the router dir plus its "
+                         "replica dirs to join span trees across "
+                         "processes (dir basenames must be the replica "
+                         "ids, as `cli fleet --telemetry-dir` lays out)")
     tc.add_argument("--export", default=None, metavar="OUT",
                     help="write the Chrome-trace-event JSON here "
                          "('-' = stdout); open in https://ui.perfetto.dev")
@@ -1038,6 +1084,24 @@ def main(argv=None) -> int:
         from .obs import render_table, summarize
         from .obs.telemetry import EVENTS_FILE
 
+        if args.fleet:
+            from .obs import render_fleet_table, summarize_fleet
+
+            root = args.log
+            if os.path.isfile(root):
+                root = os.path.dirname(root) or "."
+            try:
+                fleet_summary = summarize_fleet(root)
+            except FileNotFoundError:
+                print(
+                    f"no fleet event log under {root} (expected "
+                    f"{EVENTS_FILE} plus replica subdirectories)",
+                    file=sys.stderr,
+                )
+                return 2
+            print(json.dumps(fleet_summary) if args.json
+                  else render_fleet_table(fleet_summary))
+            return 0
         path = args.log
         if os.path.isdir(path):
             path = os.path.join(path, EVENTS_FILE)
@@ -1058,18 +1122,51 @@ def main(argv=None) -> int:
         from .obs.trace import (
             load_spans,
             render_attribution,
+            stitch_spans,
             tail_attribution,
             to_chrome_trace,
         )
 
-        path = args.log
-        if os.path.isdir(path):
-            path = os.path.join(path, EVENTS_FILE)
-        try:
-            spans = load_spans(path)
-        except FileNotFoundError:
-            print(f"no event log at {path}", file=sys.stderr)
-            return 2
+        groups = {}
+        for given in args.log:
+            path = given
+            if os.path.isdir(path):
+                path = os.path.join(path, EVENTS_FILE)
+                name = os.path.basename(os.path.abspath(given))
+            else:
+                name = os.path.basename(
+                    os.path.dirname(os.path.abspath(path))
+                ) or given
+            try:
+                loaded = load_spans(path)
+            except FileNotFoundError:
+                print(f"no event log at {path}", file=sys.stderr)
+                return 2
+            groups[name] = (path, loaded)
+        if len(groups) > 1:
+            # Multi-directory fleet mode: stitch replica request trees
+            # under their router dispatch spans (time-shifted to the
+            # router's clock lane) before attributing the tail.
+            stitched = stitch_spans(
+                {name: spans for name, (_, spans) in groups.items()}
+            )
+            spans = stitched["spans"]
+            path = " + ".join(sorted(groups))
+            print(
+                f"stitched {stitched['joined']}/"
+                f"{stitched['replica_roots']} replica request tree(s) "
+                f"across {len(groups)} log(s)",
+                file=sys.stderr,
+            )
+            if stitched["unjoined"]:
+                print(
+                    f"  {len(stitched['unjoined'])} replica root(s) "
+                    "had no matching dispatch span (untraced router, "
+                    "or dir names not matching replica ids)",
+                    file=sys.stderr,
+                )
+        else:
+            (path, spans), = groups.values()
         if not spans:
             print(
                 f"no span events in {path} — was the run traced? "
@@ -1078,11 +1175,24 @@ def main(argv=None) -> int:
             )
             return 2
         if args.export:
-            chrome = to_chrome_trace(
-                spans, process_name=os.path.basename(
-                    os.path.dirname(os.path.abspath(path))
-                ),
-            )
+            if len(groups) > 1:
+                # One pid lane per process, stitched clock preserved.
+                chrome = {"traceEvents": [], "displayTimeUnit": "ms"}
+                for pid, name in enumerate(sorted(groups)):
+                    rows = [
+                        s for s in spans
+                        if (s.get("attrs") or {}).get("process") == name
+                    ]
+                    sub = to_chrome_trace(
+                        rows, pid=pid, process_name=name,
+                    )
+                    chrome["traceEvents"] += sub["traceEvents"]
+            else:
+                chrome = to_chrome_trace(
+                    spans, process_name=os.path.basename(
+                        os.path.dirname(os.path.abspath(path))
+                    ),
+                )
             if args.export == "-":
                 print(json.dumps(chrome))
                 return 0          # stdout is the export, no report
@@ -1210,6 +1320,43 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "fleet":
+        if args.action:
+            # `fleet explain DIR` — render the control-plane decision
+            # timeline (autoscaler, breakers, rollouts, SLO alerts)
+            # out of a fleet telemetry dir, no server needed.
+            if args.action[0] != "explain" or len(args.action) != 2:
+                parser.error(
+                    "fleet: unknown action %r (only `fleet explain "
+                    "DIR` is supported)" % " ".join(args.action)
+                )
+            import json
+            import os
+
+            from .obs import decision_timeline, read_events, \
+                render_decision_timeline
+            from .obs.telemetry import EVENTS_FILE
+
+            path = args.action[1]
+            if os.path.isdir(path):
+                path = os.path.join(path, EVENTS_FILE)
+            try:
+                events = list(read_events(path))
+            except FileNotFoundError:
+                print(f"no event log at {path}", file=sys.stderr)
+                return 2
+            rows = decision_timeline(events)
+            if args.json:
+                print(json.dumps(rows))
+            else:
+                print(render_decision_timeline(
+                    rows, title=f"fleet decision timeline: {path}",
+                ))
+            return 0
+        if not args.artifact:
+            parser.error(
+                "fleet: --artifact is required to serve "
+                "(or use `fleet explain DIR`)"
+            )
         # Control plane only: the fleet process never touches jax —
         # inference happens in the replica subprocesses it spawns.
         from .utils import setup_logging
@@ -1262,6 +1409,10 @@ def main(argv=None) -> int:
             telemetry_dir=args.telemetry_dir,
             trace=args.trace,
             events_max_bytes=args.events_max_bytes,
+            scrape_interval_s=args.scrape_interval_s,
+            slo=args.slo,
+            slo_fast_window_s=args.slo_fast_window_s,
+            slo_slow_window_s=args.slo_slow_window_s,
             seed=args.seed,
             replica_flags=rflags,
         ))

@@ -20,8 +20,20 @@ schema and how to read a run.
   trace      per-request span trees + x-jg-trace propagation, run-scoped
              request ids, Perfetto export and p99 tail attribution
              (the `trace` CLI)
+  aggregate  fleet-wide registry-snapshot merging (counters sum,
+             gauges fan out per replica, histograms merge le-exactly)
+             behind the fleet /metrics + /healthz rollup
+  slo        declarative SLOs with multiwindow burn-rate alerting
+             (slo_alert events, slo_burn_rate/slo_budget_remaining)
 """
 
+from .aggregate import (
+    FleetMetricsStore,
+    FleetMetricsView,
+    FleetSnapshot,
+    healthz_rollup,
+    merge_snapshots,
+)
 from .costs import CostLedger, extract_costs, get_ledger
 from .events import (
     EventLog,
@@ -60,7 +72,15 @@ from .registry import (
     default_registry,
     render_prometheus,
 )
-from .summary import render_table, summarize
+from .slo import SLOMonitor, SLOSpec, default_fleet_slos
+from .summary import (
+    decision_timeline,
+    render_decision_timeline,
+    render_fleet_table,
+    render_table,
+    summarize,
+    summarize_fleet,
+)
 from .telemetry import Telemetry, peak_for_default_device
 from .trace import (
     TRACE_HEADER,
@@ -71,6 +91,7 @@ from .trace import (
     mint_context,
     next_request_id,
     parse_header,
+    stitch_spans,
     tail_attribution,
     to_chrome_trace,
 )
@@ -80,6 +101,9 @@ __all__ = [
     "Counter",
     "DEFAULT_TIME_BUCKETS",
     "EventLog",
+    "FleetMetricsStore",
+    "FleetMetricsView",
+    "FleetSnapshot",
     "Gauge",
     "Heartbeat",
     "Histogram",
@@ -89,12 +113,16 @@ __all__ = [
     "ProfileManager",
     "RecompileTracker",
     "SCHEMA_VERSION",
+    "SLOMonitor",
+    "SLOSpec",
     "TRACE_HEADER",
     "Telemetry",
     "TraceContext",
     "Tracer",
     "chip_peak",
     "chip_peak_bf16",
+    "decision_timeline",
+    "default_fleet_slos",
     "default_registry",
     "dense_macs_per_example",
     "device_memory_stats",
@@ -105,9 +133,11 @@ __all__ = [
     "get_profiler",
     "get_tracker",
     "git_rev",
+    "healthz_rollup",
     "jaxpr_macs_per_example",
     "load_events",
     "load_spans",
+    "merge_snapshots",
     "mfu",
     "mint_context",
     "next_request_id",
@@ -116,10 +146,14 @@ __all__ = [
     "read_events",
     "read_heartbeats",
     "render_capture_summary",
+    "render_decision_timeline",
+    "render_fleet_table",
     "render_prometheus",
     "render_table",
+    "stitch_spans",
     "summarize",
     "summarize_capture",
+    "summarize_fleet",
     "tail_attribution",
     "to_chrome_trace",
     "train_step_flops",

@@ -76,6 +76,18 @@ Event kinds (schema v1):
                  (obs/profile): artifact dir, file count, total bytes,
                  wall duration — /admin/profile and `cli train
                  --profile-steps` both emit it
+  decision       one control-plane decision with the inputs that drove
+                 it (serve/fleet/): actor=router|supervisor|rollout|
+                 operator, action (scale_up/hold/eject/readmit/
+                 breaker_open/gate_trip/rollback/...), optional replica
+                 id, and an ``inputs`` dict (queue depth, shed/error
+                 rates, thresholds, cooldown state) — the audit trail
+                 `cli fleet explain DIR` renders as a timeline
+  slo_alert      a multiwindow burn-rate alert transitioned (obs/slo):
+                 slo name, state=open|close, signal, objective,
+                 burn_fast/burn_slow, window sizes, events_fast,
+                 budget_remaining, severity — joined into the decision
+                 timeline (OBSERVABILITY.md "Fleet observability")
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's

@@ -546,6 +546,119 @@ def tail_attribution(
     return report
 
 
+def stitch_spans(
+    groups: Dict[str, List[Dict[str, Any]]], *,
+    source_attr: str = "process",
+) -> Dict[str, Any]:
+    """Join span logs from N fleet process dirs into one tree per hop
+    chain — the multi-directory half of the ``x-jg-trace`` contract.
+
+    ``groups`` maps a process name (the telemetry dir basename: the
+    router dir plus one dir per replica rid) to its loaded spans. The
+    router's ``fleet.dispatch`` span carries ``attrs.replica`` — the
+    rid it dispatched to — and the replica's ``serve.request`` root
+    shares the forwarded trace id, so the join key is
+    ``(trace_id, replica)``: each replica-side request root is
+    re-parented UNDER its dispatch span (overriding whatever parent
+    the wire context gave it — with a traced client the replica root
+    natively parents to the CLIENT's span and is a sibling of the
+    router's ``fleet.request``, which is correct causality but useless
+    for attribution) and demoted from ``span_kind="request"`` to
+    ``"replica_request"`` so :func:`tail_attribution` keeps exactly one
+    root per request and the breakdown splits router self time
+    (``request`` + ``dispatch`` = router queueing/hop) from replica
+    time (``queue``/``assemble``/``infer``/``respond`` +
+    ``replica_request`` = replica-side unattributed).
+
+    Span clocks are per-process monotonic, so each joined replica
+    subtree is time-shifted to start at its dispatch span's ``t0_ms``
+    — after stitching all spans share the ROUTER's clock lane (exact
+    within a process, aligned-at-dispatch across the hop).
+
+    Retries: a trace with N dispatch attempts to the same replica
+    consumes dispatches in ``t0_ms`` order against that replica's
+    request roots in ``t0_ms`` order. Every input span is copied (the
+    caller's lists are never mutated) and tagged with
+    ``attrs[source_attr] = <group name>``.
+
+    Returns ``{"spans", "joined", "replica_roots", "unjoined"}``.
+    """
+    tagged: Dict[str, List[Dict[str, Any]]] = {}
+    for gname, spans in groups.items():
+        rows = []
+        for s in spans:
+            c = dict(s)
+            c["attrs"] = {**(s.get("attrs") or {}), source_attr: gname}
+            rows.append(c)
+        tagged[gname] = rows
+
+    # (trace, replica rid) -> dispatch spans, oldest first
+    disp_idx: Dict[tuple, List[Dict[str, Any]]] = {}
+    router_groups = set()
+    for gname, rows in tagged.items():
+        for s in rows:
+            if s.get("span_kind") == "dispatch":
+                router_groups.add(gname)
+                rep = (s.get("attrs") or {}).get("replica")
+                disp_idx.setdefault((s.get("trace"), rep), []).append(s)
+    for lst in disp_idx.values():
+        lst.sort(key=lambda s: float(s.get("t0_ms") or 0.0))
+
+    joined = 0
+    replica_roots = 0
+    unjoined: List[str] = []
+    for gname, rows in tagged.items():
+        if gname in router_groups:
+            continue
+        kids_idx = children_index(rows)
+        roots = sorted(
+            (s for s in rows if s.get("span_kind") == "request"),
+            key=lambda s: float(s.get("t0_ms") or 0.0),
+        )
+        for root in roots:
+            replica_roots += 1
+            lst = disp_idx.get((root.get("trace"), gname))
+            if not lst:
+                # dir name != rid: fall back to the trace id alone when
+                # it is unambiguous (exactly one unconsumed dispatch)
+                cands = [
+                    (key, l) for key, l in disp_idx.items()
+                    if key[0] == root.get("trace") and l
+                    and key[1] not in tagged
+                ]
+                lst = cands[0][1] if len(cands) == 1 else None
+            if not lst:
+                unjoined.append(root.get("span"))
+                continue
+            dispatch = lst.pop(0)
+            offset = (float(dispatch.get("t0_ms") or 0.0)
+                      - float(root.get("t0_ms") or 0.0))
+            stack, seen = [root], set()
+            while stack:
+                s = stack.pop()
+                if id(s) in seen:
+                    continue
+                seen.add(id(s))
+                s["t0_ms"] = round(
+                    float(s.get("t0_ms") or 0.0) + offset, 3
+                )
+                stack.extend(
+                    kids_idx.get((s.get("trace"), s.get("span")), ())
+                )
+            root["parent"] = dispatch.get("span")
+            root["span_kind"] = "replica_request"
+            joined += 1
+
+    all_spans = [s for rows in tagged.values() for s in rows]
+    all_spans.sort(key=lambda s: float(s.get("t0_ms") or 0.0))
+    return {
+        "spans": all_spans,
+        "joined": joined,
+        "replica_roots": replica_roots,
+        "unjoined": unjoined,
+    }
+
+
 def span_kind_totals(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Per-kind span counts + total duration — the fallback report for
     logs with no request roots (a traced TRAINING run: step/checkpoint/

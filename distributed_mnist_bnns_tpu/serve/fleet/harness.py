@@ -115,6 +115,28 @@ class EngineReplicaTransport:
         raise NotImplementedError("classifier fleet probe only")
 
 
+class _CaptureTelemetry:
+    """Just enough of the Telemetry facade for the chaos probe: a live
+    registry (the router's counters need one) plus an in-memory event
+    list — so the SLO monitor's ``slo_alert``s and the control plane's
+    ``decision``s land in the returned section instead of a log dir."""
+
+    def __init__(self):
+        from ...obs import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        with self._lock:
+            self.events.append({"kind": kind, **fields})
+
+    def of_kind(self, kind: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [e for e in self.events if e["kind"] == kind]
+
+
 def _make_engine(
     predict_fn, *, batch_size: int, chaos: Any = None,
 ) -> ServeEngine:
@@ -147,6 +169,7 @@ def fleet_availability_section(
     3-replica fleet through the real router, chaos-stall then KILL one
     replica mid-window, report the end-to-end success fraction plus the
     per-replica transition log a tripped band prints."""
+    from ...obs import SLOMonitor, default_fleet_slos
     from ...resilience.chaos import ChaosController, reset_fire_counts
     from ..harness import make_tiny_packed_predictor
 
@@ -154,11 +177,22 @@ def fleet_availability_section(
         batch_size, interpret=interpret, seed=seed
     )
     reset_fire_counts()
+    capture = telemetry if telemetry is not None else _CaptureTelemetry()
+    slo = SLOMonitor(
+        default_fleet_slos(
+            request_p99_ms=deadline_ms,
+            fast_window_s=max(duration_s / 6.0, 0.1),
+            slow_window_s=max(duration_s / 2.0, 0.3),
+        ),
+        registry=getattr(capture, "registry", None),
+        emit=capture.emit,
+    )
     router = RouterCore(
-        telemetry=telemetry,
+        telemetry=capture,
         breaker_threshold=2,
         breaker_reset_s=0.5,
         max_attempts=replicas,
+        slo=slo,
     )
     transports: List[EngineReplicaTransport] = []
     for i in range(replicas):
@@ -168,7 +202,7 @@ def fleet_availability_section(
         if i == 0:
             chaos = ChaosController.from_config(
                 "infer_slow@step=3,times=2,delay_s=0.2",
-                seed=seed, telemetry=telemetry,
+                seed=seed, telemetry=capture,
             )
         engine = _make_engine(
             predict_fn, batch_size=batch_size, chaos=chaos,
@@ -219,11 +253,13 @@ def fleet_availability_section(
         t.join(timeout=duration_s + deadline_ms / 1e3 + 30.0)
     wall = time.monotonic() - t0
     router.stop_prober()
+    slo.evaluate()      # final pass so a still-open burn is visible
     for transport in transports[1:]:
         transport.engine.begin_drain()
         transport.engine.drain(timeout=5.0)
         transport.engine.stop()
     reset_fire_counts()
+    events_of = getattr(capture, "of_kind", lambda kind: [])
     return {
         "replicas": replicas,
         "n_threads": n_threads,
@@ -240,5 +276,8 @@ def fleet_availability_section(
                 router.replicas() or [], key=lambda r: r.seq
             )
         },
+        "slo": slo.summary(),
+        "slo_alerts": events_of("slo_alert"),
+        "decisions": events_of("decision"),
         "interpret_mode": interpret,
     }

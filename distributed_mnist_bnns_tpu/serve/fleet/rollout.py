@@ -142,6 +142,14 @@ class RolloutManager:
         if self.telemetry is not None:
             self.telemetry.emit("rollout", phase=phase, **fields)
 
+    def _decision(self, action: str, **fields: Any) -> None:
+        """Control-plane decision audit record (OBSERVABILITY.md): gate
+        verdicts and rollbacks carry the thresholds and observations
+        that produced them, for ``cli fleet explain``."""
+        if self.telemetry is not None:
+            self.telemetry.emit("decision", actor="rollout",
+                                action=action, **fields)
+
     # -- gates ---------------------------------------------------------------
 
     def _default_probe(self, replica: Replica) -> Tuple[int, str]:
@@ -183,9 +191,11 @@ class RolloutManager:
             )
         return True, "reloaded"
 
-    def _gate(self, replica: Replica, artifact: str) -> None:
+    def _gate(self, replica: Replica, artifact: str) -> Dict[str, Any]:
         """Reload ``replica`` to ``artifact`` and hold it to the
-        promotion gate; raises :class:`RolloutTrip` on any failure."""
+        promotion gate; raises :class:`RolloutTrip` on any failure.
+        Returns the gate observations (probe counts / error rate) for
+        the decision audit record."""
         ok, detail = self._reload_one(replica, artifact)
         if not ok:
             raise RolloutTrip(detail)
@@ -242,6 +252,12 @@ class RolloutManager:
                 f"{self.error_rate_limit:.2f} over {samples} probe(s) "
                 f"({details[:3]})"
             )
+        return {
+            "probes": samples,
+            "probe_errors": errors,
+            "error_rate": round(rate, 4),
+            "error_rate_limit": self.error_rate_limit,
+        }
 
     # -- the state machine ---------------------------------------------------
 
@@ -285,11 +301,21 @@ class RolloutManager:
         promoted: List[Replica] = []
         for i, replica in enumerate(replicas):
             try:
-                self._gate(replica, artifact)
+                gate = self._gate(replica, artifact)
             except RolloutTrip as trip:
                 self._emit(
                     "trip", replica=replica.rid, reason=str(trip),
                     canary=(i == 0),
+                )
+                self._decision(
+                    "gate_trip", replica=replica.rid,
+                    inputs={
+                        "reason": str(trip),
+                        "canary": i == 0,
+                        "artifact": artifact,
+                        "error_rate_limit": self.error_rate_limit,
+                        "probe_n": self.probe_n,
+                    },
                 )
                 log.error(
                     "rollout of %s tripped at %s (%s) — rolling the "
@@ -314,6 +340,15 @@ class RolloutManager:
                     tripped=replica.rid, reason=str(trip),
                     rolled=rolled,
                 )
+                self._decision(
+                    "rollback",
+                    inputs={
+                        "tripped": replica.rid,
+                        "reason": str(trip),
+                        "rolled": rolled,
+                        "artifact": prev,
+                    },
+                )
                 return {
                     "status": "rolled_back",
                     "tripped": replica.rid,
@@ -325,6 +360,10 @@ class RolloutManager:
             self._emit(
                 "canary_ok" if i == 0 else "promoted",
                 replica=replica.rid, artifact=artifact,
+            )
+            self._decision(
+                "gate_pass", replica=replica.rid,
+                inputs={"canary": i == 0, "artifact": artifact, **gate},
             )
         self.current_artifact = artifact
         if self.supervisor is not None:

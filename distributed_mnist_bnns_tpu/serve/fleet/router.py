@@ -52,6 +52,7 @@ import time
 import urllib.parse
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from ...obs.aggregate import FleetMetricsStore
 from ...obs.trace import (
     NULL_TRACER,
     TRACE_HEADER,
@@ -325,6 +326,7 @@ class RouterCore:
         breaker_reset_s: float = 1.0,
         page_size: int = 16,
         max_attempts: int = 3,
+        slo: Any = None,
     ):
         self.telemetry = telemetry
         self.tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
@@ -334,11 +336,15 @@ class RouterCore:
         self.breaker_reset_s = float(breaker_reset_s)
         self.page_size = int(page_size)
         self.max_attempts = int(max_attempts)
+        self.slo = slo               # obs.slo.SLOMonitor (optional)
+        self.metrics_store = FleetMetricsStore(clock=clock)
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
         self._seq = 0
         self._prober: Optional[threading.Thread] = None
+        self._scraper: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._stop_scrape = threading.Event()
         reg = telemetry.registry if telemetry is not None else None
         if reg is None:
             from ...obs import default_registry
@@ -392,6 +398,7 @@ class RouterCore:
         with self._lock:
             replica = self._replicas.pop(rid, None)
         self._gauges()
+        self.metrics_store.discard(rid)
         if replica is not None:
             log.info("router: replica %s removed", rid)
         return replica
@@ -416,10 +423,28 @@ class RouterCore:
                     "replica_health", replica=rid, breaker=new,
                     breaker_from=old, reason=reason,
                 )
+            self._decision(
+                f"breaker_{new}", replica=rid,
+                inputs={
+                    "from": old,
+                    "reason": reason,
+                    "failure_threshold": self.breaker_threshold,
+                    "reset_timeout_s": self.breaker_reset_s,
+                },
+            )
             replica = self.get_replica(rid)
             if replica is not None:
                 replica.note_transition(f"breaker_{new}", reason)
         return on_transition
+
+    def _decision(self, action: str, **fields: Any) -> None:
+        """The control-plane audit record: WHAT the router decided and
+        the inputs that drove it (OBSERVABILITY.md `decision` kind —
+        `cli fleet explain` renders the timeline)."""
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "decision", actor="router", action=action, **fields
+            )
 
     # -- health probing ------------------------------------------------------
 
@@ -463,6 +488,16 @@ class RouterCore:
                 "replica_health", replica=replica.rid,
                 healthy=healthy, reason=reason,
             )
+        self._decision(
+            "readmit" if healthy else "eject", replica=replica.rid,
+            inputs={
+                "reason": reason,
+                "probe_status": replica.health.get("status"),
+                "queue_depth": replica.health.get("queue_depth"),
+                "breaker": replica.breaker.state,
+                "inflight": replica.inflight,
+            },
+        )
         log.warning(
             "router: replica %s %s (%s)", replica.rid,
             "healthy" if healthy else "EJECTED", reason,
@@ -474,6 +509,11 @@ class RouterCore:
         def run() -> None:
             while not self._stop.wait(interval_s):
                 self.probe_replicas()
+                # Burn rates re-evaluate on the probe cadence: the same
+                # clock tick that can change membership can open/close
+                # an slo_alert (obs/slo.py).
+                if self.slo is not None:
+                    self.slo.evaluate()
 
         self._prober = threading.Thread(
             target=run, name="fleet-prober", daemon=True
@@ -485,6 +525,56 @@ class RouterCore:
         if self._prober is not None:
             self._prober.join(timeout=5.0)
             self._prober = None
+
+    # -- metrics scraping ----------------------------------------------------
+
+    def scrape_replicas(self) -> None:
+        """One scrape pass: pull every registered replica's ``/metrics``
+        (the registry-snapshot JSON) into :attr:`metrics_store`, which
+        the fleet-merged ``/metrics`` endpoint folds with the router's
+        own registry (obs/aggregate.py). The `/healthz` half reuses the
+        probe plumbing — the prober already banks each replica's latest
+        health body on ``replica.health``."""
+        for replica in self.replicas():
+            try:
+                status, body, _ = replica.transport.request(
+                    "GET", "/metrics", None, {}, self.probe_timeout_s
+                )
+                snapshot = json.loads(body) if status == 200 else None
+            except (OSError, ValueError,
+                    http.client.HTTPException) as e:
+                self.metrics_store.update(
+                    replica.rid,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                continue
+            if not isinstance(snapshot, dict):
+                self.metrics_store.update(
+                    replica.rid, error=f"http_{status}"
+                )
+                continue
+            self.metrics_store.update(
+                replica.rid, snapshot=snapshot,
+                healthz=dict(replica.health),
+            )
+
+    def start_scraper(self, interval_s: float = 1.0) -> None:
+        self._stop_scrape.clear()
+
+        def run() -> None:
+            while not self._stop_scrape.wait(interval_s):
+                self.scrape_replicas()
+
+        self._scraper = threading.Thread(
+            target=run, name="fleet-scraper", daemon=True
+        )
+        self._scraper.start()
+
+    def stop_scraper(self) -> None:
+        self._stop_scrape.set()
+        if self._scraper is not None:
+            self._scraper.join(timeout=5.0)
+            self._scraper = None
 
     # -- dispatch ------------------------------------------------------------
 
@@ -592,7 +682,7 @@ class RouterCore:
             replica._enter()
             try:
                 with self.tracer.start(
-                    "fleet.dispatch", kind="dispatch",
+                    "fleet.dispatch", kind="dispatch", parent=root,
                     replica=replica.rid, attempt=attempts,
                 ):
                     status, rbody, rheaders = replica.transport.request(
@@ -762,11 +852,16 @@ class RouterCore:
     ) -> None:
         self.requests_ctr.inc(status=status)
         root.end(status, replica=replica, attempts=attempts)
+        ms = round((self._clock() - t0) * 1e3, 3)
+        if self.slo is not None:
+            # 4xx is a client error, not fleet unavailability — the
+            # Google availability convention (5xx/timeouts burn budget).
+            ok = status == "ok" or status.startswith("http_4")
+            self.slo.observe_request(ok, latency_ms=ms)
         if self.telemetry is not None:
             self.telemetry.emit(
                 "fleet_dispatch", status=status, replica=replica,
-                attempts=attempts, tier=tier,
-                ms=round((self._clock() - t0) * 1e3, 3),
+                attempts=attempts, tier=tier, ms=ms,
             )
 
     # -- introspection -------------------------------------------------------

@@ -88,6 +88,10 @@ class FleetConfig:
     queue_low: float = 0.5           # ... and to shrink below
     sustain_s: float = 1.0           # signal hold before acting
     cooldown_s: float = 3.0          # between autoscale decisions
+    scrape_interval_s: float = 1.0   # replica /metrics scrape cadence
+    slo: bool = True                 # burn-rate SLO alerting
+    slo_fast_window_s: float = 60.0  # burn-rate fast/slow windows —
+    slo_slow_window_s: float = 300.0 # smokes shrink these to seconds
     drain_timeout_s: float = 60.0
     staging_dir: Optional[str] = None   # rollout ship target (default:
                                      # <telemetry_dir>/staging)
@@ -106,12 +110,21 @@ class FleetServer:
 
     def __init__(self, config: FleetConfig):
         self.config = config
-        from ...obs import Telemetry
+        from ...obs import SLOMonitor, Telemetry, default_fleet_slos
 
         self.telemetry = Telemetry(
             config.telemetry_dir, heartbeat=False, trace=config.trace,
             events_max_bytes=config.events_max_bytes,
         )
+        self.slo = SLOMonitor(
+            default_fleet_slos(
+                request_p99_ms=config.default_deadline_ms * 1.5,
+                fast_window_s=config.slo_fast_window_s,
+                slow_window_s=config.slo_slow_window_s,
+            ),
+            registry=self.telemetry.registry,
+            emit=self.telemetry.emit,
+        ) if config.slo else None
         self.router = RouterCore(
             telemetry=self.telemetry,
             probe_timeout_s=2.0,
@@ -119,6 +132,7 @@ class FleetServer:
             breaker_reset_s=config.breaker_reset_s,
             page_size=config.page_size,
             max_attempts=config.max_attempts,
+            slo=self.slo,
         )
         self.view = FleetView(
             min_replicas=config.min_replicas,
@@ -212,6 +226,8 @@ class FleetServer:
         self._http_thread.start()
         self.supervisor.start()
         self.router.start_prober(cfg.probe_interval_s)
+        if cfg.scrape_interval_s > 0:
+            self.router.start_scraper(cfg.scrape_interval_s)
         self.telemetry.manifest(config={
             "artifact": cfg.artifact,
             "engine": "fleet",
@@ -231,8 +247,12 @@ class FleetServer:
         return host, port
 
     def health(self) -> Dict[str, Any]:
+        from ...obs import healthz_rollup
+
         snap = self.router.snapshot()
-        return {
+        store = self.router.metrics_store
+        rollup = healthz_rollup(snap["replicas"], store.healthz())
+        out = {
             "status": "draining" if self.draining else "ok",
             "engine": "fleet",
             "target_replicas": self.view.target,
@@ -241,7 +261,17 @@ class FleetServer:
             "artifact": self.rollout.current_artifact,
             "uptime_s": round(time.time() - self._started_at, 3),
             **snap,
+            "fleet": {
+                "replicas_total": rollup["replicas_total"],
+                "replicas_healthy": rollup["replicas_healthy"],
+                "status": rollup["status"],
+                **store.status(),
+            },
+            "replica_health": rollup["replicas"],
         }
+        if self.slo is not None:
+            out["slo_open_alerts"] = self.slo.open_alerts()
+        return out
 
     def request_stop(self, reason: str = "stop requested") -> None:
         self.stop_request.request(reason)
@@ -249,6 +279,7 @@ class FleetServer:
     def drain_and_stop(self) -> Dict[str, Any]:
         t0 = time.monotonic()
         self.draining = True        # front end replies 503 draining
+        self.router.stop_scraper()
         self.router.stop_prober()
         rcs = self.supervisor.drain_all(
             timeout=self.config.drain_timeout_s
@@ -315,7 +346,12 @@ class _FleetHandler(JsonHandler):
         if self.path == "/healthz":
             self._reply(200, self.srv.health())
         elif self.path == "/metrics":
-            self._reply_metrics(self.srv.telemetry.registry)
+            from ...obs import FleetMetricsView
+
+            self._reply_metrics(FleetMetricsView(
+                self.srv.telemetry.registry,
+                self.srv.router.metrics_store,
+            ))
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -505,5 +541,12 @@ class _FleetHandler(JsonHandler):
         self.srv.telemetry.emit(
             "autoscale", direction="manual",
             target_from=previous, target_to=clamped,
+        )
+        self.srv.telemetry.emit(
+            "decision", actor="operator", action="manual_scale",
+            inputs={"requested": target, "target_to": clamped,
+                    "target_from": previous,
+                    "min_replicas": view.min_replicas,
+                    "max_replicas": view.max_replicas},
         )
         self._reply(200, {"target": clamped, "previous": previous})

@@ -105,6 +105,11 @@ class Autoscaler:
         self._over_since: Optional[float] = None
         self._under_since: Optional[float] = None
         self._last_change = -float("inf")
+        #: The audit record of the most recent ``observe`` call: the
+        #: inputs, thresholds and cooldown/sustain state that drove the
+        #: outcome. A cooldown hold used to be an invisible ``None`` —
+        #: the decision event the supervisor emits is built from this.
+        self.last_decision: Optional[Dict[str, Any]] = None
 
     def observe(
         self,
@@ -128,24 +133,65 @@ class Autoscaler:
             (self._under_since if self._under_since is not None else now)
             if idle else None
         )
-        if now - self._last_change < self.cooldown_s:
+        cooldown_remaining = max(
+            0.0, self.cooldown_s - (now - self._last_change)
+        )
+        decision: Dict[str, Any] = {
+            "queue_depth": round(queue_depth, 3),
+            "shed_rate": round(shed_rate, 3),
+            "queue_high": self.queue_high,
+            "queue_low": self.queue_low,
+            "sustain_s": self.sustain_s,
+            "cooldown_s": self.cooldown_s,
+            "cooldown_remaining_s": round(cooldown_remaining, 3),
+            "over_for_s": (round(now - self._over_since, 3)
+                           if self._over_since is not None else None),
+            "under_for_s": (round(now - self._under_since, 3)
+                            if self._under_since is not None else None),
+            "target": view.target,
+            "min_replicas": view.min_replicas,
+            "max_replicas": view.max_replicas,
+        }
+        self.last_decision = decision
+        if cooldown_remaining > 0:
+            decision["action"] = "hold"
+            decision["reason"] = (
+                "cooldown" if (overloaded or idle) else "steady"
+            )
             return None
         if (
             self._over_since is not None
             and now - self._over_since >= self.sustain_s
-            and view.target < view.max_replicas
         ):
-            self._last_change = now
-            self._over_since = None
-            return view.target + 1
+            if view.target < view.max_replicas:
+                self._last_change = now
+                self._over_since = None
+                decision["action"] = "scale_up"
+                decision["reason"] = (
+                    "queue_high" if queue_depth >= self.queue_high
+                    else "sheds"
+                )
+                return view.target + 1
+            decision["action"] = "hold"
+            decision["reason"] = "at_max"
+            return None
         if (
             self._under_since is not None
             and now - self._under_since >= self.sustain_s
-            and view.target > view.min_replicas
         ):
-            self._last_change = now
-            self._under_since = None
-            return view.target - 1
+            if view.target > view.min_replicas:
+                self._last_change = now
+                self._under_since = None
+                decision["action"] = "scale_down"
+                decision["reason"] = "idle"
+                return view.target - 1
+            decision["action"] = "hold"
+            decision["reason"] = "at_min"
+            return None
+        decision["action"] = "hold"
+        decision["reason"] = (
+            "sustaining" if (overloaded or idle) else "steady"
+        )
         return None
 
 
@@ -211,6 +257,7 @@ class ReplicaSupervisor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.draining = False
+        self._last_hold_key: Optional[tuple] = None
         reg = telemetry.registry if telemetry is not None else None
         if reg is None:
             from ...obs import default_registry
@@ -235,6 +282,14 @@ class ReplicaSupervisor:
     def _emit(self, kind: str, **fields: Any) -> None:
         if self.telemetry is not None:
             self.telemetry.emit(kind, **fields)
+
+    def _decision(self, action: str, **fields: Any) -> None:
+        """Control-plane decision audit record (OBSERVABILITY.md):
+        every scale/hold/respawn/retire lands in the event log with the
+        inputs that drove it, so ``cli fleet explain`` can replay why
+        the fleet did what it did."""
+        self._emit("decision", actor="supervisor", action=action,
+                   **fields)
 
     def spawn_replica(self) -> ReplicaMember:
         """Launch one replica process; it joins the router only after
@@ -272,6 +327,10 @@ class ReplicaSupervisor:
             pass
         self._emit("replica_exit", replica=member.rid, cause="retired",
                    pid=member.proc.pid)
+        self._decision(
+            "retire", replica=member.rid,
+            inputs={"seq": member.seq, "target": self.view.target},
+        )
         log.info("supervisor: retiring %s (scale-down)", member.rid)
 
     # -- boot gate -----------------------------------------------------------
@@ -350,6 +409,16 @@ class ReplicaSupervisor:
             "replica_exit", replica=member.rid, cause="died", rc=rc,
             pid=member.proc.pid, respawn_backoff_s=round(delay, 3),
         )
+        self._decision(
+            "respawn", replica=member.rid,
+            inputs={
+                "rc": rc,
+                "pid": member.proc.pid,
+                "backoff_s": round(delay, 3),
+                "consecutive_respawns": self._consecutive_respawns,
+                "target": self.view.target,
+            },
+        )
         log.warning(
             "supervisor: %s died (rc %s) — respawning after %.2fs",
             member.rid, rc, delay,
@@ -387,18 +456,36 @@ class ReplicaSupervisor:
             self.view, queue_depth=signals["queue_depth"],
             shed_rate=signals["shed_rate"], now=now,
         )
+        inputs = dict(getattr(self.autoscaler, "last_decision", None)
+                      or {})
         if new_target is None:
+            # A hold is a decision too — but only the pressure-driven
+            # ones are worth auditing (cooldown suppressing a wanted
+            # change, sustain still accumulating, bounds clamping), and
+            # only on transition, not every 250 ms tick.
+            reason = inputs.get("reason")
+            key = (inputs.get("action"), reason)
+            if reason in (None, "steady"):
+                self._last_hold_key = None
+            elif key != self._last_hold_key:
+                self._last_hold_key = key
+                self._decision("hold", inputs=inputs)
             return
         new_target = self.view.clamp(new_target)
         if new_target == self.view.target:
             return
         direction = "up" if new_target > self.view.target else "down"
+        self._last_hold_key = None
         self.autoscale_ctr.inc(direction=direction)
         self._emit(
             "autoscale", direction=direction,
             target_from=self.view.target, target_to=new_target,
             queue_depth=round(signals["queue_depth"], 3),
             shed_rate=round(signals["shed_rate"], 3),
+        )
+        self._decision(
+            f"scale_{direction}",
+            inputs={**inputs, "target_to": new_target},
         )
         log.warning(
             "autoscale %s: target %d -> %d (queue_depth %.2f, "

@@ -20,7 +20,17 @@ saturation while the scenario unfolds:
   * a client-minted ``x-jg-trace`` context is adopted by the router
     AND the replica that served it — one trace id across both event
     logs (the every-hop-joins-one-trace contract);
-  * SIGTERM drains the whole fleet, exit 0.
+  * the fleet-merged ``/metrics`` reconciles EXACTLY with the sum of
+    the replicas' own ``/metrics`` counters once traffic quiesces;
+  * every supervisor respawn and router breaker transition left a
+    ``decision`` audit event, and `cli trace` over the router dir plus
+    the replica dirs stitches at least one joined request tree;
+  * SIGTERM drains the whole fleet, exit 0;
+  * phase two (ISSUE 16): a min fleet (1 replica, second process) with
+    1 s/3 s SLO windows — SIGKILLing the sole replica must OPEN the
+    availability ``slo_alert`` (every request 503s until the respawn)
+    and the respawn must CLOSE it, all visible in ``/healthz``'s
+    ``slo_open_alerts`` and the event log.
 
 Usage: python scripts/fleet_smoke.py [--dir DIR] [--keep]
 """
@@ -30,6 +40,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import shutil
 import signal
 import socket
@@ -72,6 +83,22 @@ def _wait(predicate, budget_s: float, interval_s: float = 0.5) -> bool:
             pass
         time.sleep(interval_s)
     return False
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _counter_series(snapshot: dict, name: str) -> dict:
+    """{sorted-label-key: value} for one counter in a /metrics body."""
+    metric = snapshot.get(name) or {}
+    return {
+        tuple(sorted((s.get("labels") or {}).items())): s["value"]
+        for s in metric.get("series") or []
+    }
 
 
 def _post(base: str, path: str, payload: dict, timeout: float = 300.0):
@@ -304,6 +331,32 @@ def main(argv=None) -> int:
         if not by_code.get(200):
             failures.append(f"no request ever succeeded: {by_code}")
 
+        # -- fleet /metrics reconciles with the replicas' /metrics -------
+        # Traffic just quiesced; within a couple of scrape intervals the
+        # fleet-merged serve_requests_total (obs/aggregate.py sums
+        # scraped replica snapshots — the router's own counters live
+        # under fleet_* names) must EXACTLY equal the sum of the
+        # replicas' live counters, per label set.
+        def reconciled() -> bool:
+            rows_now = _healthz(base)["replicas"]
+            fleet_snap = _get_json(base + "/metrics")
+            expected: dict = {}
+            for r in rows_now:
+                rep_snap = _get_json(r["url"] + "/metrics")
+                for key, v in _counter_series(
+                    rep_snap, "serve_requests_total"
+                ).items():
+                    expected[key] = expected.get(key, 0.0) + v
+            return bool(expected) and _counter_series(
+                fleet_snap, "serve_requests_total"
+            ) == expected
+
+        if not _wait(reconciled, budget_s=20, interval_s=1.0):
+            failures.append(
+                "fleet /metrics serve_requests_total never reconciled "
+                "with the sum of the replicas' own /metrics counters"
+            )
+
         # -- SIGTERM: the whole fleet drains, exit 0 ---------------------
         proc.send_signal(signal.SIGTERM)
         try:
@@ -338,6 +391,58 @@ def main(argv=None) -> int:
     if not exits:
         failures.append("no replica_exit(died) event for the kill")
 
+    # -- control-plane decision audit (ISSUE 16) -----------------------------
+    # Every supervisor respawn and every router breaker transition must
+    # have left a `decision` event carrying its inputs.
+    decisions = [e for e in fleet_events if e["kind"] == "decision"]
+    respawns = [e for e in decisions if e.get("action") == "respawn"]
+    if len(respawns) < len(exits):
+        failures.append(
+            f"{len(exits)} replica death(s) but only {len(respawns)} "
+            "supervisor respawn decision event(s)"
+        )
+    if respawns and "rc" not in (respawns[0].get("inputs") or {}):
+        failures.append("respawn decision events carry no inputs.rc")
+    breaker_transitions = [
+        e for e in fleet_events
+        if e["kind"] == "replica_health" and e.get("breaker")
+    ]
+    breaker_decisions = [
+        e for e in decisions
+        if str(e.get("action", "")).startswith("breaker_")
+    ]
+    if len(breaker_decisions) != len(breaker_transitions):
+        failures.append(
+            f"{len(breaker_transitions)} breaker transition(s) but "
+            f"{len(breaker_decisions)} breaker decision event(s) — "
+            "the audit trail must be 1:1"
+        )
+
+    # -- multi-dir trace join: `cli trace ROUTER_DIR REPLICA_DIR...` ---------
+    replica_dirs = [
+        os.path.join(tel_dir, name)
+        for name in sorted(os.listdir(tel_dir))
+        if name.startswith("replica-")
+        and os.path.exists(os.path.join(tel_dir, name, "events.jsonl"))
+    ]
+    tr = subprocess.run(
+        [sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+         "trace", tel_dir] + replica_dirs,
+        env=env, cwd=repo, capture_output=True, text=True,
+    )
+    if tr.returncode != 0:
+        failures.append(
+            f"cli trace over router+replica dirs exited "
+            f"{tr.returncode}:\n{tr.stderr[-1500:]}"
+        )
+    else:
+        m = re.search(r"stitched (\d+)/(\d+)", tr.stderr)
+        if not m or int(m.group(1)) < 1:
+            failures.append(
+                "cli trace stitched no replica request tree across "
+                f"the fleet dirs (stderr: {tr.stderr[-500:]!r})"
+            )
+
     # replica logs: chaos fired, sheds + breaker cycle happened SOMEWHERE
     # in the fleet (each replica runs the same scripted chaos)
     replica_events = []
@@ -370,6 +475,123 @@ def main(argv=None) -> int:
             "the router must forward x-jg-trace unchanged"
         )
 
+    # -- phase two: SLO burn-rate alerting on a min fleet (ISSUE 16) ---------
+    # One replica, 1 s/3 s SLO windows: SIGKILL the sole replica so
+    # failover has nowhere to go — every request 503s, the availability
+    # burn saturates both windows and the alert OPENS; the supervisor's
+    # respawn restores traffic and the fast window drains — CLOSE.
+    tel2 = os.path.join(work, "telemetry_slo")
+    port2 = _free_port()
+    base2 = f"http://127.0.0.1:{port2}"
+    proc2 = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+            "fleet",
+            "--artifact", artifact,
+            "--port", str(port2),
+            "--replicas", "1",
+            "--min-replicas", "1", "--max-replicas", "1",
+            "--no-autoscale",
+            "--deadline-ms", "3000",
+            "--probe-interval-s", "0.1",
+            "--breaker-reset-s", "0.3",
+            "--boot-timeout-s", "150",
+            "--batch-size", "8",
+            "--queue-depth", "8",
+            "--stall-timeout-s", "0.15",
+            "--slo-fast-window-s", "1.0",
+            "--slo-slow-window-s", "3.0",
+            "--scrape-interval-s", "0.5",
+            "--interpret",
+            "--aot", "--aot-dir", aot_dir,
+            "--telemetry-dir", tel2,
+            "--log-file", os.path.join(work, "fleet_slo.log"),
+        ],
+        env=env, cwd=repo,
+    )
+    stop2 = threading.Event()
+
+    def hammer_slo() -> None:
+        while not stop2.is_set():
+            try:
+                sc.predict_with_retries(
+                    base2, imgs, deadline_ms=3000.0,
+                    max_attempts=2, timeout=10.0,
+                )
+            except OSError:
+                pass
+            time.sleep(0.02)
+
+    slo_alerts = []
+    try:
+        if not _wait(
+            lambda: _healthz(base2).get("live") == 1, budget_s=180
+        ):
+            failures.append("SLO fleet never reached 1 live replica")
+        else:
+            threads2 = [
+                threading.Thread(target=hammer_slo, daemon=True)
+                for _ in range(4)
+            ]
+            for t in threads2:
+                t.start()
+            time.sleep(1.5)       # a good-traffic baseline first
+            victim2 = _healthz(base2)["replicas"][0]
+            os.kill(victim2["pid"], signal.SIGKILL)
+            if not _wait(
+                lambda: "availability" in _healthz(base2).get(
+                    "slo_open_alerts", []
+                ),
+                budget_s=60, interval_s=0.2,
+            ):
+                failures.append(
+                    "killing the sole replica never OPENED the "
+                    "availability slo_alert"
+                )
+            elif not _wait(
+                lambda: "availability" not in _healthz(base2).get(
+                    "slo_open_alerts", []
+                ),
+                budget_s=90, interval_s=0.2,
+            ):
+                failures.append(
+                    "the availability slo_alert never CLOSED after "
+                    "the respawn restored traffic"
+                )
+            stop2.set()
+            for t in threads2:
+                t.join(timeout=30)
+        proc2.send_signal(signal.SIGTERM)
+        try:
+            rc2 = proc2.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+            rc2 = proc2.wait()
+            failures.append("SLO fleet did not drain after SIGTERM")
+        if rc2 != 0:
+            failures.append(f"SLO fleet exited {rc2} (want 0)")
+    finally:
+        stop2.set()
+        if proc2.poll() is None:
+            proc2.kill()
+            proc2.wait()
+
+    slo_events = load_events(os.path.join(tel2, "events.jsonl"))
+    slo_alerts = [e for e in slo_events if e["kind"] == "slo_alert"
+                  and e.get("slo") == "availability"]
+    states = [e.get("state") for e in slo_alerts]
+    if "open" not in states or "close" not in states:
+        failures.append(
+            "SLO fleet event log is missing the availability "
+            f"slo_alert open/close pair (got states {states})"
+        )
+    if not any(e.get("action") == "respawn" for e in slo_events
+               if e["kind"] == "decision"):
+        failures.append(
+            "SLO fleet event log has no supervisor respawn decision "
+            "for the kill"
+        )
+
     summary = {
         "responses_by_code": by_code,
         "fleet_events": {k: sum(1 for e in fleet_events
@@ -377,6 +599,10 @@ def main(argv=None) -> int:
                          for k in sorted(kinds)},
         "rollout_phases": roll_phases,
         "replica_event_kinds": sorted(rkinds),
+        "decision_actions": sorted({
+            str(e.get("action")) for e in decisions
+        }),
+        "slo_alert_states": states,
         "ok": not failures,
     }
     print(json.dumps(summary, indent=2, default=str))

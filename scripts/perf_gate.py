@@ -346,6 +346,37 @@ def explain_failures(
                     indent=1, sort_keys=True,
                 )
             )
+            # The control-plane audit trail: every router/SLO decision
+            # the probe captured, rendered as the same timeline
+            # `cli fleet explain` prints — the trip explains itself.
+            try:
+                sys.path.insert(0, REPO)
+                from distributed_mnist_bnns_tpu.obs import (
+                    decision_timeline,
+                    render_decision_timeline,
+                )
+
+                events = list(section.get("decisions") or [])
+                events += list(section.get("slo_alerts") or [])
+                rows = decision_timeline(events)
+                if rows:
+                    parts.append(render_decision_timeline(
+                        rows,
+                        title="probe decision timeline "
+                              "(router ejections, breaker trips, "
+                              "SLO alerts)",
+                    ))
+                slo = section.get("slo")
+                if slo:
+                    parts.append(
+                        "probe SLO summary:\n"
+                        + json.dumps(slo, indent=1, sort_keys=True)
+                    )
+            except ImportError as e:
+                parts.append(
+                    "decision timeline unavailable "
+                    f"({type(e).__name__}: {e})"
+                )
         else:
             parts.append(
                 "fleet availability band tripped and the probe section "
