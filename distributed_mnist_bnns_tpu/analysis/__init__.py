@@ -1,17 +1,21 @@
 """Static analysis + runtime sanitizers for JAX footguns.
 
-Three halves (ANALYSIS.md is the user-facing catalog):
+Four halves (ANALYSIS.md is the user-facing catalog):
 
 * ``analysis.lint`` — an AST linter with repo-tailored rules: the JAX
   pack (JG001-JG006: host syncs inside traced functions, PRNG-key
   hygiene, jit-boundary hygiene, python control flow on tracers, silent
   broad excepts, direct ``jax.shard_map`` use bypassing the version
-  shim) and the concurrency pack (JG007-JG011,
+  shim), the concurrency pack (JG007-JG011,
   ``analysis/concurrency/``: lock discipline, check-then-act TOCTOU,
   blocking calls / user callbacks under a held lock, ``Condition.wait``
-  without a predicate loop). Run it via
-  ``python -m distributed_mnist_bnns_tpu.cli lint``; CI fails on any
-  unsuppressed finding.
+  without a predicate loop), and the SPMD pack (JG012-JG016:
+  collectives under data-dependent control flow, unbound axis names,
+  cross-branch collective-order mismatches, donation use-after-donate,
+  shard_map spec-arity mismatches) plus the event-schema contracts
+  (JG017/JG018 against ``obs/events.py``'s ``EVENT_KINDS`` registry).
+  Run it via ``python -m distributed_mnist_bnns_tpu.cli lint``; CI
+  fails on any unsuppressed finding.
 
 * ``analysis.guards`` — opt-in runtime ``Sanitizer``: a recompile fence
   (obs/recompile counts over budget become hard errors), a transfer
@@ -24,6 +28,14 @@ Three halves (ANALYSIS.md is the user-facing catalog):
   actual executions, and a seeded cooperative scheduler that replays
   adversarial interleavings deterministically (the race-regression
   harness in tests/test_concurrency.py).
+
+* ``analysis.spmd`` — the SPMD pack's runtime half: a per-simulated-
+  process collective-schedule recorder (eager execution with stubbed
+  ``jax.lax`` collectives, so ``lax.cond`` takes only the concrete
+  branch) and a lockstep checker that hard-errors with the first
+  divergent index when any two processes' schedules differ. Wired into
+  ``cli lint --spmd`` and the CI ``spmd-lockstep`` job; the gate the
+  multi-host runtime (ROADMAP item 1) must pass.
 """
 
 from .guards import (
@@ -41,17 +53,31 @@ from .sched import (
     TraceRecorder,
     watch_attrs,
 )
+from .spmd import (
+    CollectiveOp,
+    LockstepError,
+    check_lockstep,
+    record_schedule,
+    run_lockstep,
+    verify_shipped,
+)
 
 __all__ = [
+    "CollectiveOp",
     "CoopScheduler",
     "DeadlockError",
     "InstrumentedCondition",
     "InstrumentedLock",
+    "LockstepError",
     "NaNFenceError",
     "RecompileFenceError",
     "Sanitizer",
     "SanitizerConfig",
     "SanitizerError",
     "TraceRecorder",
+    "check_lockstep",
+    "record_schedule",
+    "run_lockstep",
+    "verify_shipped",
     "watch_attrs",
 ]

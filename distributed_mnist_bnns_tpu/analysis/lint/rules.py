@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .core import Finding, LintModule, dotted_name, last_segment
 
@@ -522,6 +522,636 @@ def check_shard_map_compat(module: LintModule) -> List[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# SPMD pack (JG012-JG016) — collective-divergence hazards in shard_map /
+# jit bodies. The bug class: a collective executed by some processes but
+# not others does not error on a multi-host fleet, it hangs it.
+# analysis/spmd.py is the runtime half (per-process schedule recording +
+# the lockstep checker); these rules catch the same shapes statically.
+# --------------------------------------------------------------------------
+
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "all_to_all", "ppermute", "pshuffle",
+}
+
+
+def _is_collective(node: ast.AST) -> bool:
+    """A ``jax.lax.<collective>`` / ``lax.<collective>`` call. Bare names
+    are accepted only for the unambiguous ops (``psum``/``all_gather``/
+    ``all_to_all``/``ppermute``) — short names like ``pmax`` are too easy
+    to collide with user helpers."""
+    if not isinstance(node, ast.Call):
+        return False
+    seg = last_segment(node.func)
+    if seg not in _COLLECTIVES:
+        return False
+    dn = dotted_name(node.func) or ""
+    if dn.endswith(f"lax.{seg}"):
+        return True
+    return dn == seg and seg in (
+        "psum", "all_gather", "all_to_all", "ppermute",
+    )
+
+
+def _axis_expr(call: ast.Call) -> Optional[ast.AST]:
+    """The axis-name argument of a collective call: second positional,
+    or the ``axis_name`` keyword."""
+    if len(call.args) > 1:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    return None
+
+
+def _axis_repr(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return "?"
+    try:
+        return ast.unparse(node)
+    except (ValueError, RecursionError):  # pragma: no cover
+        return "?"
+
+
+def _resolve_str(module: LintModule, node: Optional[ast.AST]) -> Optional[str]:
+    """Resolve an axis expression to a concrete string when statically
+    evident: a string literal, or a Name bound to one — via a simple
+    assignment in an enclosing scope, or as a parameter whose default is
+    a string literal (the repo's ``axis: str = \"data\"`` builder
+    idiom). Anything else is unknown (None)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if not isinstance(node, ast.Name):
+        return None
+    for scope in module.enclosing_scopes(node):
+        value = module.scope_assigns.get(scope, {}).get(node.id)
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            return value.value
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = scope.args.args
+            defaults = scope.args.defaults
+            by_param = dict(
+                zip([a.arg for a in params][len(params) - len(defaults):],
+                    defaults)
+            )
+            for a, d in zip(scope.args.kwonlyargs, scope.args.kw_defaults):
+                if d is not None:
+                    by_param.setdefault(a.arg, d)
+            d = by_param.get(node.id)
+            if isinstance(d, ast.Constant) and isinstance(d.value, str):
+                return d.value
+    return None
+
+
+def _body_nodes(fn: ast.AST) -> List[ast.AST]:
+    if isinstance(fn, ast.Lambda):
+        return list(ast.walk(fn.body))
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return [n for stmt in fn.body for n in ast.walk(stmt)]
+    return []
+
+
+def _collective_sequence(
+    module: LintModule, fn: Optional[ast.AST], depth: int = 1
+) -> List[ast.Call]:
+    """Lexically-ordered collective calls inside ``fn``, following
+    same-module function calls one hop (the wrapper-call machinery JG001
+    relies on) so a body that delegates to a helper still shows its
+    collective schedule."""
+    if fn is None:
+        return []
+    out: List[ast.Call] = []
+    for n in _body_nodes(fn):
+        if _is_collective(n):
+            out.append(n)
+        elif isinstance(n, ast.Call) and depth > 0:
+            inner = module.resolve_callable(n.func)
+            if inner is not None and inner is not fn:
+                out.extend(_collective_sequence(module, inner, depth - 1))
+    return out
+
+
+def _sequence_sig(module: LintModule, calls: List[ast.Call]) -> List[tuple]:
+    return [
+        (last_segment(c.func), _axis_repr(_axis_expr(c))) for c in calls
+    ]
+
+
+_LAX_COND_NAMES = {"jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch"}
+
+
+def _is_lax_cond(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func) or ""
+    return dn in _LAX_COND_NAMES or (
+        last_segment(node.func) in ("cond", "switch")
+        and dn.endswith((".cond", ".switch"))
+        and "lax" in dn
+    )
+
+
+def _mentions_process_index(test: ast.AST) -> bool:
+    return any(
+        isinstance(n, (ast.Name, ast.Attribute))
+        and last_segment(n) in ("process_index", "host_id")
+        for n in ast.walk(test)
+    )
+
+
+def _branch_sequences(
+    module: LintModule, node: ast.Call
+) -> Optional[List[List[ast.Call]]]:
+    """Per-branch collective sequences of a lax.cond/switch call, or
+    None when any branch fails to resolve (an imported callable, a
+    partial, ...) — unknown bodies must stay un-flagged."""
+    if last_segment(node.func) == "cond":
+        branch_exprs = node.args[1:3]
+    else:  # switch(index, branches_sequence, *operands)
+        seq = node.args[1] if len(node.args) > 1 else None
+        if not isinstance(seq, (ast.Tuple, ast.List)):
+            return None
+        branch_exprs = list(seq.elts)
+    if len(branch_exprs) < 2:
+        return None
+    seqs = []
+    for arg in branch_exprs:
+        fn = module.resolve_callable(arg)
+        if fn is None:
+            return None
+        seqs.append(_collective_sequence(module, fn))
+    return seqs
+
+
+def check_collective_divergence(module: LintModule) -> List[Finding]:
+    """JG012: a collective reachable from only one side of data-dependent
+    control flow — a Python ``if``/``while`` on traced values (or on
+    ``process_index()``) inside a traced function, or exactly one branch
+    of a ``lax.cond``/``switch``. On one host this is wasted or wrong
+    work; on a multi-host fleet the processes that skip the collective
+    leave the others blocked in it forever."""
+    out: List[Finding] = []
+    for fn in module.traced:
+        if isinstance(fn, ast.Lambda):
+            continue
+        params = {a.arg for a in fn.args.args}
+        params |= {a.arg for a in fn.args.kwonlyargs}
+        for n in _body_nodes(fn):
+            if not isinstance(n, (ast.If, ast.While)):
+                continue
+            data_dep = bool(_tracer_names_in_test(n.test, params)) or (
+                _mentions_process_index(n.test)
+            )
+            if not data_dep:
+                continue
+            branch_colls = [
+                [c for stmt in part for c in ast.walk(stmt)
+                 if _is_collective(c)]
+                for part in (n.body, n.orelse)
+            ]
+            have = [bc for bc in branch_colls if bc]
+            if len(have) == 1 and not all(branch_colls):
+                for c in have[0]:
+                    op = last_segment(c.func)
+                    out.append(
+                        _finding(
+                            module, "JG012", c,
+                            f"collective `{op}` under a data-dependent "
+                            "`if`/`while` with no matching collective on "
+                            "the other path — processes that skip it "
+                            "leave the rest hung in the collective "
+                            "(multi-host deadlock)",
+                        )
+                    )
+    for node in ast.walk(module.tree):
+        if not _is_lax_cond(node):
+            continue
+        seqs = _branch_sequences(module, node)
+        if seqs is None:
+            continue
+        nonempty = [s for s in seqs if s]
+        if len(seqs) >= 2 and len(nonempty) >= 1 and len(nonempty) < len(seqs):
+            ops = {last_segment(c.func) for s in nonempty for c in s}
+            out.append(
+                _finding(
+                    module, "JG012", node,
+                    f"collective(s) {sorted(ops)} in one branch of "
+                    "lax.cond/switch but not the other(s) — if devices "
+                    "disagree on the predicate, the branch without the "
+                    "collective deadlocks the branch with it; hoist the "
+                    "collective out of the conditional",
+                )
+            )
+    return out
+
+
+def check_collective_order(module: LintModule) -> List[Finding]:
+    """JG014: branches of the same conditional issue *different*
+    collective sequences (both non-empty). Cross-branch order/op
+    mismatches compile, but any predicate disagreement across the fleet
+    pairs mismatched collectives — undefined results or a hang."""
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if _is_lax_cond(node):
+            seqs = _branch_sequences(module, node) or []
+        elif isinstance(node, ast.If) and module.is_traced(node):
+            fn = module.nearest_def(node)
+            params = (
+                {a.arg for a in fn.args.args}
+                | {a.arg for a in fn.args.kwonlyargs}
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else set()
+            )
+            if not (
+                _tracer_names_in_test(node.test, params)
+                or _mentions_process_index(node.test)
+            ):
+                continue
+            seqs = [
+                [c for stmt in part for c in ast.walk(stmt)
+                 if _is_collective(c)]
+                for part in (node.body, node.orelse)
+            ]
+        else:
+            continue
+        nonempty = [s for s in seqs if s]
+        if len(nonempty) < 2:
+            continue  # one-sided is JG012's finding
+        sigs = [_sequence_sig(module, s) for s in nonempty]
+        if any(sig != sigs[0] for sig in sigs[1:]):
+            out.append(
+                _finding(
+                    module, "JG014", node,
+                    "branches of the same conditional issue different "
+                    f"collective sequences ({' vs '.join(str(s) for s in sigs)})"
+                    " — divergent schedules deadlock or mis-pair when "
+                    "devices disagree on the predicate",
+                )
+            )
+    return out
+
+
+def _spec_axis_exprs(call: ast.Call) -> Tuple[set, set, bool]:
+    """(literal axis strings, symbolic axis Name ids, any_specs_seen)
+    from a shard_map call's in_specs/out_specs ``P(...)`` arguments."""
+    literals: set = set()
+    names: set = set()
+    seen = False
+    for kw in call.keywords:
+        if kw.arg not in ("in_specs", "out_specs"):
+            continue
+        for n in ast.walk(kw.value):
+            if isinstance(n, ast.Call) and last_segment(n.func) in (
+                "P", "PartitionSpec",
+            ):
+                seen = True
+                for a in n.args:
+                    for leaf in ast.walk(a):
+                        if isinstance(leaf, ast.Constant) and isinstance(
+                            leaf.value, str
+                        ):
+                            literals.add(leaf.value)
+                        elif isinstance(leaf, ast.Name):
+                            names.add(leaf.id)
+    return literals, names, seen
+
+
+def check_axis_name_validity(module: LintModule) -> List[Finding]:
+    """JG013: a collective inside a shard_map body names an axis that
+    the enclosing shard_map's specs never bind. Only flagged when both
+    sides resolve to concrete strings — symbolic matches (the same
+    ``axis`` variable on both sides) and unresolvable names are
+    trusted."""
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and last_segment(node.func) == "shard_map"
+            and node.args
+        ):
+            continue
+        literals, sym_names, seen = _spec_axis_exprs(node)
+        if not seen or not (literals or sym_names):
+            continue  # no axis evidence: nothing to check against
+        declared = set(literals)
+        unresolved_decl = False
+        for nm_id in sym_names:
+            nm_node = next(
+                (
+                    n for kw in node.keywords
+                    if kw.arg in ("in_specs", "out_specs")
+                    for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Name) and n.id == nm_id
+                ),
+                None,
+            )
+            val = _resolve_str(module, nm_node)
+            if val is None:
+                unresolved_decl = True
+            else:
+                declared.add(val)
+        body = module.resolve_callable(node.args[0])
+        for c in _collective_sequence(module, body):
+            ax = _axis_expr(c)
+            if ax is None:
+                continue
+            if isinstance(ax, ast.Name) and ax.id in sym_names:
+                continue  # symbolically the same expression as the spec
+            val = _resolve_str(module, ax)
+            if val is None or val in declared or unresolved_decl:
+                continue
+            op = last_segment(c.func)
+            out.append(
+                _finding(
+                    module, "JG013", c,
+                    f"collective `{op}` over axis {val!r} but the "
+                    "enclosing shard_map's specs only bind "
+                    f"{sorted(declared) or sorted(sym_names)} — an "
+                    "unbound axis name fails at trace time (or silently "
+                    "no-ops under vmapped reuse)",
+                )
+            )
+    return out
+
+
+def _donated_argnums(call: ast.Call) -> List[int]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return [
+                n.value for n in ast.walk(kw.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, int)
+            ]
+    return []
+
+
+def check_donation_use(module: LintModule) -> List[Finding]:
+    """JG015: an argument donated to a jitted call is read again after
+    the call with no rebinding in between. Donated buffers are freed
+    (aliased into the outputs); depending on backend/jaxlib the read
+    returns garbage, raises, or — the PR 8 AOT shape — double-frees."""
+    out: List[Finding] = []
+    donate_calls: List[Tuple[ast.Call, List[int]]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if last_segment(node.func) == "jit":
+            continue  # the jit() wrapper itself, not a step call
+        donated: List[int] = []
+        if isinstance(node.func, ast.Name):
+            for scope in module.enclosing_scopes(node):
+                value = module.scope_assigns.get(scope, {}).get(node.func.id)
+                if value is not None:
+                    if isinstance(value, ast.Call) and (
+                        last_segment(value.func) == "jit"
+                    ):
+                        donated = _donated_argnums(value)
+                    break
+        elif isinstance(node.func, ast.Call) and (
+            last_segment(node.func.func) == "jit"
+        ):
+            donated = _donated_argnums(node.func)
+        if donated:
+            donate_calls.append((node, donated))
+    for call, donated in donate_calls:
+        scope = module.enclosing_scope(call)
+        scope_nodes = (
+            [n for stmt in scope.body for n in ast.walk(stmt)]
+            if hasattr(scope, "body") and isinstance(scope.body, list)
+            else list(ast.walk(scope))
+        )
+        rebind_lines: Dict[str, List[int]] = {}
+        for n in scope_nodes:
+            for nm, lineno in _assigned_names(n):
+                rebind_lines.setdefault(nm, []).append(lineno)
+        for idx in donated:
+            if idx >= len(call.args) or not isinstance(
+                call.args[idx], ast.Name
+            ):
+                continue
+            nm = call.args[idx].id
+            for n in scope_nodes:
+                if (
+                    isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id == nm
+                    and n.lineno > call.lineno
+                    and module.enclosing_scope(n) is scope
+                    and not any(
+                        call.lineno <= b <= n.lineno
+                        for b in rebind_lines.get(nm, [])
+                    )
+                ):
+                    out.append(
+                        _finding(
+                            module, "JG015", n,
+                            f"{nm!r} read after being donated to the "
+                            f"jitted call at line {call.lineno} "
+                            "(donate_argnums) — the buffer was freed "
+                            "into the outputs; rebind the result or "
+                            "drop the donation (the PR 8 double-free)",
+                        )
+                    )
+                    break  # first use is enough per call/arg
+    return out
+
+
+def check_spec_arity(module: LintModule) -> List[Finding]:
+    """JG016: shard_map in_specs/out_specs tuple arity vs the wrapped
+    function's signature. Checked only when the specs are literal
+    tuples/lists and the body resolves — pytree-valued specs are out of
+    static reach and stay silent."""
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and last_segment(node.func) == "shard_map"
+            and node.args
+        ):
+            continue
+        fn = module.resolve_callable(node.args[0])
+        if fn is None or not isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        if fn.args.vararg is not None:
+            continue
+        n_params = len(fn.args.args)
+        n_required = n_params - len(fn.args.defaults)
+        kw = {k.arg: k.value for k in node.keywords}
+        in_specs = kw.get("in_specs")
+        if isinstance(in_specs, (ast.Tuple, ast.List)):
+            n_specs = len(in_specs.elts)
+            if n_specs > n_params or n_specs < n_required:
+                out.append(
+                    _finding(
+                        module, "JG016", in_specs,
+                        f"in_specs has {n_specs} entries but the wrapped "
+                        f"function takes {n_params} positional "
+                        "argument(s) — shard_map zips them; the "
+                        "mismatch fails at call time with a pytree "
+                        "structure error",
+                    )
+                )
+        out_specs = kw.get("out_specs")
+        if isinstance(out_specs, (ast.Tuple, ast.List)) and isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            ret_lens = {
+                len(n.value.elts)
+                for n in _body_nodes(fn)
+                if isinstance(n, ast.Return)
+                and isinstance(n.value, ast.Tuple)
+            }
+            explicit_returns = [
+                n for n in _body_nodes(fn)
+                if isinstance(n, ast.Return) and n.value is not None
+            ]
+            if (
+                len(ret_lens) == 1
+                and len(explicit_returns) == sum(
+                    1 for n in _body_nodes(fn)
+                    if isinstance(n, ast.Return)
+                    and isinstance(n.value, ast.Tuple)
+                )
+            ):
+                (ret_len,) = ret_lens
+                if ret_len != len(out_specs.elts):
+                    out.append(
+                        _finding(
+                            module, "JG016", out_specs,
+                            f"out_specs has {len(out_specs.elts)} entries "
+                            f"but the wrapped function returns "
+                            f"{ret_len}-tuples — the mismatch fails at "
+                            "trace time with a pytree structure error",
+                        )
+                    )
+    return out
+
+
+# --------------------------------------------------------------------------
+# Event-schema contracts (JG017/JG018) — emit() call sites checked
+# against obs/events.py's EVENT_KINDS registry and envelope fields.
+# --------------------------------------------------------------------------
+
+_events_registry_cache: Optional[Tuple[Optional[dict], Tuple[str, ...]]] = None
+
+
+def _event_registry() -> Tuple[Optional[dict], Tuple[str, ...]]:
+    """(EVENT_KINDS dict, ENVELOPE_FIELDS tuple) parsed out of the
+    package's own obs/events.py with ``ast.literal_eval`` — the linter
+    stays import-free (no jax, no package import). Returns (None,
+    fallback-envelope) when the module can't be read, in which case
+    JG017 stays silent rather than flagging everything unknown."""
+    global _events_registry_cache
+    if _events_registry_cache is not None:
+        return _events_registry_cache
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir, os.pardir, "obs", "events.py",
+    )
+    kinds: Optional[dict] = None
+    envelope: Tuple[str, ...] = ("v", "kind", "ts")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            names = {t.id for t in targets if isinstance(t, ast.Name)}
+            if "EVENT_KINDS" in names and node.value is not None:
+                kinds = ast.literal_eval(node.value)
+            elif "ENVELOPE_FIELDS" in names and node.value is not None:
+                envelope = tuple(ast.literal_eval(node.value))
+    except (OSError, SyntaxError, ValueError):
+        kinds = None
+    _events_registry_cache = (kinds, envelope)
+    return _events_registry_cache
+
+
+def check_event_kinds(module: LintModule) -> List[Finding]:
+    """JG017: an ``emit("<kind>", ...)`` call site whose kind literal is
+    missing from obs/events.py's EVENT_KINDS registry. Readers
+    (``summarize``, ``cli trace``, SLO monitors) key on kind strings —
+    an unregistered kind is invisible to all of them and to the
+    OBSERVABILITY.md contract."""
+    if module.is_test_file():
+        return []
+    kinds, _ = _event_registry()
+    if not kinds:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        kind = node.args[0].value
+        if kind not in kinds:
+            out.append(
+                _finding(
+                    module, "JG017", node,
+                    f"emit of unregistered event kind {kind!r} — add it "
+                    "to obs/events.py EVENT_KINDS (and the "
+                    "OBSERVABILITY.md event table) or use a registered "
+                    "kind; unregistered kinds are invisible to every "
+                    "reader",
+                )
+            )
+    return out
+
+
+def check_event_envelope(module: LintModule) -> List[Finding]:
+    """JG018: an ``emit()`` payload key that collides with the event
+    envelope (``v``/``kind``/``ts``) — as an explicit keyword or inside
+    a ``**{...}`` literal. The collision silently clobbers the
+    envelope's field; it shipped twice (PR 4 ``reload``, PR 6 ``cli
+    export``) before the payloads were nested."""
+    _, envelope = _event_registry()
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+        ):
+            continue
+        for kw in node.keywords:
+            if kw.arg in envelope:
+                out.append(
+                    _finding(
+                        module, "JG018", kw.value,
+                        f"emit payload key {kw.arg!r} collides with the "
+                        "event envelope — it would clobber the "
+                        f"record's own {kw.arg!r} field; nest it "
+                        "(e.g. under `info`) or rename it",
+                    )
+                )
+            elif kw.arg is None and isinstance(kw.value, ast.Dict):
+                for k in kw.value.keys:
+                    if isinstance(k, ast.Constant) and k.value in envelope:
+                        out.append(
+                            _finding(
+                                module, "JG018", k,
+                                f"emit **payload key {k.value!r} collides "
+                                "with the event envelope — nest or "
+                                "rename it",
+                            )
+                        )
+    return out
+
+
 from ..concurrency.rules import (  # noqa: E402 — after Rule is defined
     check_blocking_in_lock,
     check_callback_in_lock,
@@ -598,6 +1228,54 @@ RULES: Dict[str, Rule] = {
             "JG011", "wait-needs-predicate",
             "untimed Condition.wait() outside a while-predicate loop",
             check_wait_predicate,
+        ),
+        # SPMD pack (this module, above): collective-divergence hazards
+        # — the multi-host hang class. analysis/spmd.py is the runtime
+        # half (lockstep schedule checker).
+        Rule(
+            "JG012", "collective-divergence",
+            "collective reachable from only one branch of "
+            "data-dependent control flow (python if/while on traced "
+            "values, or lax.cond/switch) — the multi-host hang",
+            check_collective_divergence,
+        ),
+        Rule(
+            "JG013", "collective-axis-validity",
+            "collective names an axis the enclosing shard_map's "
+            "in_specs/out_specs never bind",
+            check_axis_name_validity,
+        ),
+        Rule(
+            "JG014", "collective-order-consistency",
+            "branches of the same conditional issue different "
+            "collective sequences",
+            check_collective_order,
+        ),
+        Rule(
+            "JG015", "donation-use-after-donate",
+            "argument listed in donate_argnums read again after the "
+            "jitted call without rebinding (freed-buffer read)",
+            check_donation_use,
+        ),
+        Rule(
+            "JG016", "shard-map-spec-arity",
+            "in_specs/out_specs tuple arity mismatched against the "
+            "wrapped function's signature / return tuples",
+            check_spec_arity,
+        ),
+        # Event-schema contracts (this module, above): emit() call
+        # sites vs obs/events.py's EVENT_KINDS registry + envelope.
+        Rule(
+            "JG017", "event-kind-registry",
+            "emit() of an event kind missing from obs/events.py's "
+            "EVENT_KINDS registry",
+            check_event_kinds,
+        ),
+        Rule(
+            "JG018", "event-envelope-collision",
+            "emit() payload key colliding with the event envelope "
+            "(v/kind/ts)",
+            check_event_envelope,
         ),
     ]
 }

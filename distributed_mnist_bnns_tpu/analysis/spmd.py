@@ -1,0 +1,469 @@
+"""Collective-schedule recording and lockstep checking — the runtime
+half of the SPMD pack (JG012–JG016 are the static half, in
+analysis/lint/rules.py).
+
+The multi-host bug class this guards: a collective executed by some
+processes but not others does not error, it **hangs the fleet** — every
+participating process blocks in the collective waiting for peers that
+never arrive. The source paper's hand-rolled DDP failed exactly this
+way (silently, between home machines); ROADMAP item 1's
+``jax.distributed`` runtime must not be able to.
+
+How it works, and why eagerly
+-----------------------------
+Tracing can't catch the bug: ``lax.cond`` traces BOTH branches, so a
+collective hidden in one branch shows up in every process's jaxpr and
+the schedules look identical even when execution would diverge. Instead
+the recorder runs the program **eagerly, once per simulated process**,
+under ``jax.disable_jit()`` with every ``jax.lax`` collective (and
+``axis_index``) monkeypatched to a shape-correct local stub that logs
+``(op, axis, shape, dtype)`` before returning. Under ``disable_jit`` a
+``lax.cond`` with a concrete predicate executes only the taken branch
+— so per-process predicate divergence yields divergent recorded
+schedules, which is precisely the hang condition on real hardware.
+
+The stubs simulate a world of ``world`` processes from the local shard
+alone (``psum`` scales by ``world``, ``all_gather`` stacks ``world``
+local copies, ``all_to_all`` re-blocks locally, ``axis_index`` returns
+the simulated pid). Downstream shapes are exact; values are only
+world-plausible — good enough, because the checker compares
+**schedules**, not numerics (ops/test_compress.py owns the numerics
+against its NumPy oracle).
+
+Entry points
+------------
+``record_schedule(fn, *args, world=, pid=)`` → ``[CollectiveOp, ...]``
+``check_lockstep(schedules)`` → raises :class:`LockstepError` with the
+first divergent index when any two processes' schedules differ.
+``run_lockstep(build, world)`` — record every pid and check.
+``verify_shipped(worlds=(2, 4, 8))`` — the CI ``spmd-lockstep`` job's
+body: the compressed-DP exchange, the compressed-FSDP exchange, and
+the elastic remesh fold/regrow programs, in lockstep at every world.
+``cli lint --spmd`` wraps it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CollectiveOp",
+    "LockstepError",
+    "record_schedule",
+    "check_lockstep",
+    "run_lockstep",
+    "verify_shipped",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One recorded collective: position in the program's schedule plus
+    the identity that must match across processes for the op to pair."""
+
+    index: int
+    op: str
+    axis: Optional[str]
+    shape: Tuple[int, ...]
+    dtype: str
+
+    def key(self) -> Tuple:
+        """What lockstep compares: everything except ``index`` (which
+        is implied by position)."""
+        return (self.op, self.axis, self.shape, self.dtype)
+
+    def __str__(self) -> str:
+        return (
+            f"#{self.index} {self.op}(axis={self.axis!r}, "
+            f"shape={self.shape}, {self.dtype})"
+        )
+
+
+class LockstepError(RuntimeError):
+    """Two simulated processes disagreed on the collective schedule.
+
+    ``divergence_index`` is the first schedule position where any
+    process differs from process 0 (length mismatches divergence at the
+    shorter schedule's end); ``schedules`` holds every process's full
+    recording for the report."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        divergence_index: int,
+        schedules: Sequence[Sequence[CollectiveOp]],
+    ) -> None:
+        super().__init__(message)
+        self.divergence_index = divergence_index
+        self.schedules = [list(s) for s in schedules]
+
+
+def _first_divergence(
+    schedules: Sequence[Sequence[CollectiveOp]],
+) -> Optional[int]:
+    """Index of the first position where any process differs from
+    process 0, or None when all schedules agree."""
+    base = schedules[0]
+    for other in schedules[1:]:
+        upto = min(len(base), len(other))
+        for i in range(upto):
+            if base[i].key() != other[i].key():
+                return i
+        if len(base) != len(other):
+            return upto
+    return None
+
+
+def _divergence_report(
+    schedules: Sequence[Sequence[CollectiveOp]], idx: int
+) -> str:
+    lines = [
+        f"collective schedules diverge at index {idx} "
+        f"(world {len(schedules)}):"
+    ]
+    for pid, sched in enumerate(schedules):
+        if idx < len(sched):
+            entry = str(sched[idx])
+        else:
+            entry = f"<no collective — schedule ends at {len(sched)}>"
+        lines.append(f"  process {pid}: {entry}")
+    lo = max(0, idx - 2)
+    ctx = schedules[0][lo:idx]
+    if ctx:
+        lines.append("  last agreed ops: " + "; ".join(str(c) for c in ctx))
+    lines.append(
+        "  on real multi-host hardware the processes still issuing "
+        "collectives would hang waiting for the ones that stopped."
+    )
+    return "\n".join(lines)
+
+
+def check_lockstep(schedules: Sequence[Sequence[CollectiveOp]]) -> None:
+    """Hard-error with the first divergent index when any two
+    processes' schedules differ; no-op when they all agree."""
+    if len(schedules) < 2:
+        return
+    idx = _first_divergence(schedules)
+    if idx is not None:
+        raise LockstepError(
+            _divergence_report(schedules, idx),
+            divergence_index=idx,
+            schedules=schedules,
+        )
+
+
+# --------------------------------------------------------------------------
+# The per-process simulator
+# --------------------------------------------------------------------------
+
+_COLLECTIVE_STUBS = (
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "all_to_all", "ppermute",
+)
+
+
+def _first_leaf(value: Any):
+    import jax
+
+    leaves = jax.tree.leaves(value)
+    return leaves[0] if leaves else None
+
+
+@contextlib.contextmanager
+def _simulated_process(
+    schedule: List[CollectiveOp], *, world: int, pid: int
+) -> Iterator[None]:
+    """Run the body eagerly as simulated process ``pid`` of ``world``:
+    ``jax.lax`` collectives are replaced by recording, shape-correct
+    local stubs; ``axis_index`` returns ``pid``; everything runs under
+    ``jax.disable_jit()`` so ``lax.cond`` takes only the concrete
+    branch (the property the whole checker rests on)."""
+    import jax
+    import jax.numpy as jnp
+
+    def record(op: str, axis: Any, value: Any) -> None:
+        leaf = _first_leaf(value)
+        schedule.append(
+            CollectiveOp(
+                index=len(schedule),
+                op=op,
+                axis=None if axis is None else str(axis),
+                shape=tuple(getattr(leaf, "shape", ())),
+                dtype=str(getattr(leaf, "dtype", "?")),
+            )
+        )
+
+    def psum(x, axis_name, **kw):
+        record("psum", axis_name, x)
+        return jax.tree.map(lambda v: v * world, x)
+
+    def pmean(x, axis_name, **kw):
+        record("pmean", axis_name, x)
+        return x
+
+    def pmax(x, axis_name, **kw):
+        record("pmax", axis_name, x)
+        return x
+
+    def pmin(x, axis_name, **kw):
+        record("pmin", axis_name, x)
+        return x
+
+    def psum_scatter(x, axis_name, *, scatter_dimension=0, tiled=False, **kw):
+        record("psum_scatter", axis_name, x)
+        return jax.tree.map(
+            lambda v: jnp.split(v * world, world, axis=scatter_dimension)[pid],
+            x,
+        )
+
+    def all_gather(x, axis_name, *, axis=0, tiled=False, **kw):
+        record("all_gather", axis_name, x)
+        if tiled:
+            return jax.tree.map(
+                lambda v: jnp.concatenate([v] * world, axis=axis), x
+            )
+        return jax.tree.map(lambda v: jnp.stack([v] * world, axis=axis), x)
+
+    def all_to_all(x, axis_name, split_axis, concat_axis, **kw):
+        record("all_to_all", axis_name, x)
+        return jax.tree.map(
+            lambda v: jnp.concatenate(
+                jnp.split(v, world, axis=split_axis), axis=concat_axis
+            ),
+            x,
+        )
+
+    def ppermute(x, axis_name, perm, **kw):
+        record("ppermute", axis_name, x)
+        return x
+
+    def axis_index(axis_name):
+        return jnp.int32(pid)
+
+    stubs: Dict[str, Callable] = {
+        "psum": psum, "pmean": pmean, "pmax": pmax, "pmin": pmin,
+        "psum_scatter": psum_scatter, "all_gather": all_gather,
+        "all_to_all": all_to_all, "ppermute": ppermute,
+        "axis_index": axis_index,
+    }
+    saved_lax = {name: getattr(jax.lax, name) for name in stubs}
+    saved_pi = jax.process_index
+    saved_pc = jax.process_count
+    try:
+        for name, stub in stubs.items():
+            setattr(jax.lax, name, stub)
+        jax.process_index = lambda backend=None: pid
+        jax.process_count = lambda backend=None: world
+        with jax.disable_jit():
+            yield
+    finally:
+        for name, original in saved_lax.items():
+            setattr(jax.lax, name, original)
+        jax.process_index = saved_pi
+        jax.process_count = saved_pc
+
+
+def record_schedule(
+    fn: Callable, *args: Any, world: int, pid: int, **kwargs: Any
+) -> List[CollectiveOp]:
+    """Run ``fn(*args, **kwargs)`` as simulated process ``pid`` of
+    ``world`` and return its ordered collective schedule."""
+    schedule: List[CollectiveOp] = []
+    with _simulated_process(schedule, world=world, pid=pid):
+        fn(*args, **kwargs)
+    return schedule
+
+
+def run_lockstep(
+    build: Callable[[int, int], Tuple[Callable, Tuple]],
+    world: int,
+) -> List[List[CollectiveOp]]:
+    """Record every simulated process's schedule and lockstep-check
+    them. ``build(pid, world)`` returns ``(fn, args)`` — it runs
+    OUTSIDE the simulator (host-side setup: seeding per-process data,
+    slicing per-process state views), ``fn(*args)`` runs inside.
+    Returns the per-process schedules; raises :class:`LockstepError`
+    on the first divergence."""
+    schedules = []
+    for pid in range(world):
+        fn, args = build(pid, world)
+        schedules.append(record_schedule(fn, *args, world=world, pid=pid))
+    check_lockstep(schedules)
+    return schedules
+
+
+# --------------------------------------------------------------------------
+# The shipped collective programs (the CI spmd-lockstep job's matrix)
+# --------------------------------------------------------------------------
+
+_AXIS = "data"
+_N_PARAMS = 1000     # two-leaf pytree, deliberately not bucket-aligned
+_BUCKET = 64         # padded = world*nb*64 = 1024 at world 2/4/8
+_CHUNKS = 2
+
+
+def _demo_params():
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    return {
+        "w": jnp.asarray(rng.standard_normal((30, 30)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((100,)), jnp.float32),
+    }
+
+
+def _demo_grads(pid: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(1234 + pid)
+    return {
+        "w": jnp.asarray(rng.standard_normal((30, 30)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((100,)), jnp.float32),
+    }
+
+
+def _local_view(state: Any, world: int, pid: int) -> Any:
+    """The shard_map-local view of exchange state: every leaf carrying
+    the leading ``world`` axis is sliced to this process's row (kept as
+    a leading axis of 1, exactly what the in-specs produce)."""
+    import jax
+
+    def slice_leaf(leaf):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == world:
+            return leaf[pid:pid + 1]
+        return leaf
+
+    return jax.tree.map(slice_leaf, state)
+
+
+def _dp_program(world: int):
+    """The compressed-DP exchange: ``sign_compress`` (two-phase 1-bit
+    all-reduce with double error feedback) as each process runs it
+    inside the shard_map step."""
+    from ..train.optim import sign_compress
+
+    tx = sign_compress(
+        mode="sign_ef", world=world, axis_name=_AXIS,
+        bucket_size=_BUCKET, chunks=_CHUNKS,
+    )
+    state = tx.init(_demo_params())
+
+    def build(pid: int, w: int):
+        return tx.update, (_demo_grads(pid), _local_view(state, w, pid))
+
+    return build
+
+
+def _fsdp_program(world: int):
+    """The compressed-FSDP/ZeRO exchange: ``sign_compress_fsdp`` with a
+    sharded adam inner — reduce-scatter, owner update, compressed
+    all-gather of the delta."""
+    import optax
+
+    from ..train.optim import sign_compress_fsdp
+
+    params = _demo_params()
+    tx = sign_compress_fsdp(
+        optax.adam(1e-3), mode="sign_ef", world=world, axis_name=_AXIS,
+        bucket_size=_BUCKET, chunks=_CHUNKS,
+    )
+    state = tx.init(params)
+
+    def build(pid: int, w: int):
+        return (
+            tx.update,
+            (_demo_grads(pid), _local_view(state, w, pid), params),
+        )
+
+    return build
+
+
+def _remesh_program(world: int):
+    """The elastic remesh program: FSDP exchange state initialized at a
+    DIFFERENT origin world, re-placed onto ``world`` by
+    ``parallel.remesh.remesh_compress_state`` (fold when shrinking,
+    regrow when growing), then one exchange step at the new world —
+    the post-remesh step every elastic resize immediately runs."""
+    import optax
+
+    from ..ops.comm_compress import make_plan, tree_size
+    from ..parallel.remesh import remesh_compress_state
+    from ..train.optim import sign_compress_fsdp
+
+    origin = 8 if world in (2, 4) else 4
+    params = _demo_params()
+    tx_origin = sign_compress_fsdp(
+        optax.adam(1e-3), mode="sign_ef", world=origin, axis_name=_AXIS,
+        bucket_size=_BUCKET, chunks=_CHUNKS,
+    )
+    origin_state = tx_origin.init(params)
+    plan = make_plan(
+        tree_size(params), world=world, mode="sign_ef",
+        bucket_size=_BUCKET, chunks=_CHUNKS, layout="fsdp",
+    )
+    remeshed, replaced = remesh_compress_state(origin_state, plan)
+    if replaced == 0:
+        raise RuntimeError(
+            f"remesh {origin}->{world} replaced no state nodes — the "
+            "lockstep program is not exercising the fold/regrow path"
+        )
+    tx = sign_compress_fsdp(
+        optax.adam(1e-3), mode="sign_ef", world=world, axis_name=_AXIS,
+        bucket_size=_BUCKET, chunks=_CHUNKS,
+    )
+
+    def build(pid: int, w: int):
+        return (
+            tx.update,
+            (_demo_grads(pid), _local_view(remeshed, w, pid), params),
+        )
+
+    return build
+
+
+SHIPPED_PROGRAMS: Dict[str, Callable[[int], Callable]] = {
+    "dp_exchange": _dp_program,
+    "fsdp_exchange": _fsdp_program,
+    "remesh_fold_regrow": _remesh_program,
+}
+
+
+def verify_shipped(
+    worlds: Sequence[int] = (2, 4, 8),
+    programs: Optional[Sequence[str]] = None,
+) -> List[Dict[str, Any]]:
+    """Lockstep-check every shipped collective program at every world.
+
+    Returns one report row per (program, world):
+    ``{"program", "world", "n_collectives", "ok": True}``. Raises
+    :class:`LockstepError` (with the offending program named in the
+    message) on the first divergence — this is the CI ``spmd-lockstep``
+    job's body and the gate ROADMAP item 1's multi-host PR must pass.
+    """
+    names = list(programs) if programs is not None else list(SHIPPED_PROGRAMS)
+    report: List[Dict[str, Any]] = []
+    for name in names:
+        factory = SHIPPED_PROGRAMS[name]
+        for world in worlds:
+            try:
+                schedules = run_lockstep(factory(world), world)
+            except LockstepError as e:
+                raise LockstepError(
+                    f"program {name!r} at world {world}:\n{e}",
+                    divergence_index=e.divergence_index,
+                    schedules=e.schedules,
+                ) from None
+            report.append(
+                {
+                    "program": name,
+                    "world": world,
+                    "n_collectives": len(schedules[0]),
+                    "ok": True,
+                }
+            )
+    return report

@@ -654,8 +654,11 @@ def build_parser() -> argparse.ArgumentParser:
     ln = sub.add_parser(
         "lint",
         help="run the repo linter (JAX footguns JG001-JG006 + "
-             "concurrency JG007-JG011, ANALYSIS.md) over the package "
-             "(or given paths); exit 1 on any unsuppressed finding",
+             "concurrency JG007-JG011 + SPMD/collective + event-schema "
+             "JG012-JG018, ANALYSIS.md) over the package (or given "
+             "paths); exit 1 on any unsuppressed finding; --spmd adds "
+             "the runtime lockstep check of the shipped collective "
+             "programs",
     )
     ln.add_argument("paths", nargs="*", default=None,
                     help="files/directories to lint (default: the "
@@ -679,6 +682,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append a TODO suppression comment to every "
                          "unsuppressed finding line (backlog burndown; "
                          "reasons still have to be written by hand)")
+    ln.add_argument("--spmd", action="store_true",
+                    help="also run the SPMD lockstep checker "
+                         "(analysis/spmd.py): record each shipped "
+                         "collective program's per-process schedule at "
+                         "every --spmd-world and fail with the first "
+                         "divergent index if any two disagree (the CI "
+                         "spmd-lockstep job)")
+    ln.add_argument("--spmd-world", action="append", type=int,
+                    default=None, metavar="N",
+                    help="world size(s) for --spmd (repeatable; "
+                         "default 2 4 8)")
     ao = sub.add_parser(
         "aot",
         help="ahead-of-time executable store (aot/, PERF.md 'Cold "
@@ -1070,7 +1084,25 @@ def main(argv=None) -> int:
             print(format_human(
                 findings, show_suppressed=args.show_suppressed
             ))
-        return 1 if any(not f.suppressed for f in findings) else 0
+        rc = 1 if any(not f.suppressed for f in findings) else 0
+        if args.spmd:
+            # The runtime half: jax imports only behind the flag so the
+            # static path stays backend-free.
+            from .analysis.spmd import LockstepError, verify_shipped
+
+            worlds = tuple(args.spmd_world or (2, 4, 8))
+            try:
+                report = verify_shipped(worlds=worlds)
+            except LockstepError as e:
+                print(f"spmd-lockstep: FAIL\n{e}", file=sys.stderr)
+                return 1
+            for row in report:
+                print(
+                    f"spmd-lockstep: {row['program']} world "
+                    f"{row['world']}: {row['n_collectives']} "
+                    "collectives in lockstep"
+                )
+        return rc
 
     if args.cmd == "aot":
         return _cmd_aot(args)

@@ -6,88 +6,16 @@ The first record of a run is the ``run_manifest`` — config, device/mesh
 topology, jax version and git rev — so a log file is self-describing:
 any later reader knows exactly what produced the numbers that follow.
 
-Event kinds (schema v1):
-  run_manifest   config, devices, mesh, versions, git rev  (exactly once)
-  step           step index, latency, examples/sec, mfu, loss/acc
-  epoch          per-epoch aggregates + device memory stats
-  eval           test metrics
-  checkpoint     epoch, path, best flag
-  bench          a bench.py section result (same envelope as training)
-  infer          packed-serving run summary
-  error          exception type/message before a crash propagates
-  heartbeat      liveness records (written per process by obs/heartbeat)
-  fault_injected a resilience/chaos fault fired (kind, point, step/epoch)
-  graceful_stop  preemption honored at a step boundary (mid-epoch
-                 checkpoint state, reason)
-  resume         a run restored checkpoint state before training
-                 (epoch/step/data position, digest_verified flag)
-  rollback       restore skipped corrupt generation(s) (resilience)
-  restart        the retry loop rebuilt the trainer (cause, attempt,
-                 backoff, world_size/mesh_shape — resilience/policy)
-  membership_change  the elastic supervisor noted a data-parallel
-                 membership change (event=lost|restored,
-                 world_from/world_to, step — resilience/elastic)
-  remesh         the elastic loop rebuilt the mesh at a new world and
-                 re-placed state from the newest verified checkpoint
-                 generation (direction=shrink|grow, world_from/
-                 world_to, event, step — resilience/elastic)
-  comm_compress  the run's 1-bit gradient-exchange plan (mode, layout=
-                 dp|fsdp, buckets, per-phase rs/ag wire bytes/step vs
-                 fp32 — PERF.md)
-  metrics        final registry snapshot (counters/gauges/histograms)
-                 emitted once at run close, just before run_end
-  request        one served prediction request's final status (serve/)
-  shed           admission rejected a request (queue_full |
-                 breaker_open | draining — serve/)
-  breaker_open   the serving circuit breaker tripped open
-  breaker_close  it closed again after successful half-open probes
-  drain          SIGTERM graceful drain completed (flush stats, serve/)
-  reload         hot artifact swap on the running server (serve/)
-  export         cli export wrote a packed artifact (path, size info)
-  lm_admit       a generation request took a batch slot (serve/lm/ —
-                 prompt/pages/prefill stats, the iteration it joined at)
-  lm_evict       a generation request left its slot or died queued
-                 (status, tokens emitted, pages freed)
-  lm_decode      periodic decode-iteration snapshot (active streams,
-                 iteration latency, page occupancy, recompile count)
-  lm_decode_error a decode dispatch failed and was retried (serve/lm/)
-  lm_prefix_hit  admission found a cached prompt prefix: forked its
-                 pages COW and prefilled only the suffix (serve/lm/,
-                 SERVING.md "Prefix caching")
-  lm_spec_round  periodic speculative-decode round snapshot (spec_k,
-                 drafts accepted/rejected, cumulative acceptance rate)
-  aot_hit        a boot installed a stored AOT executable — no trace,
-                 no compile (aot/, PERF.md "Cold start")
-  aot_miss       the AOT store had no entry; normal compile + re-bank
-  aot_bank       an executable was serialized into the AOT store
-  aot_fallback   a corrupt/incompatible AOT entry was quarantined and
-                 the boot fell back to online compile (reason field)
-  span           one completed tracing span (obs/trace): trace/span/
-                 parent ids, name, span_kind, monotonic t0_ms/dur_ms,
-                 status, tid, attrs — the per-request span trees
-                 `cli trace` folds into Perfetto exports and tail
-                 attribution (OBSERVABILITY.md "Tracing")
-  program_cost   one compiled program's HLO cost row (obs/costs):
-                 flops, bytes accessed, argument/output/temp/peak HBM,
-                 source=online|aot_hit|aot_miss — the per-program cost
-                 ledger behind measured MFU (OBSERVABILITY.md "Device
-                 profiling")
-  profile_capture  an on-demand jax.profiler capture completed
-                 (obs/profile): artifact dir, file count, total bytes,
-                 wall duration — /admin/profile and `cli train
-                 --profile-steps` both emit it
-  decision       one control-plane decision with the inputs that drove
-                 it (serve/fleet/): actor=router|supervisor|rollout|
-                 operator, action (scale_up/hold/eject/readmit/
-                 breaker_open/gate_trip/rollback/...), optional replica
-                 id, and an ``inputs`` dict (queue depth, shed/error
-                 rates, thresholds, cooldown state) — the audit trail
-                 `cli fleet explain DIR` renders as a timeline
-  slo_alert      a multiwindow burn-rate alert transitioned (obs/slo):
-                 slo name, state=open|close, signal, objective,
-                 burn_fast/burn_slow, window sizes, events_fast,
-                 budget_remaining, severity — joined into the decision
-                 timeline (OBSERVABILITY.md "Fleet observability")
+Event kinds (schema v1) form a closed registry: ``EVENT_KINDS`` below
+is the single source of truth — one entry per kind with a one-line
+description. OBSERVABILITY.md's event table mirrors it row for row
+(``scripts/check_event_docs.py`` fails CI on drift), and the linter's
+event-schema contract rules enforce call sites against it: JG017 flags
+an ``emit()`` with a kind literal missing from the registry, JG018
+flags payload keys that would collide with the envelope fields
+(``ENVELOPE_FIELDS``) — the bug class that shipped twice (the PR 4
+``reload`` payload and the PR 6 ``cli export`` payload both carried a
+``kind`` key that silently clobbered the envelope's, now nested).
 
 Writes happen only on the primary host (process_index 0) unless
 ``primary_only=False`` — the multi-host analogue of the reference's
@@ -121,6 +49,67 @@ from ..utils.logging_utils import is_primary_host
 
 SCHEMA_VERSION = 1
 MANIFEST_KIND = "run_manifest"
+
+#: Envelope fields every record carries; a payload key with one of these
+#: names would silently clobber the envelope (the shipped PR 4 / PR 6
+#: collision bug) — JG018 flags such call sites statically.
+ENVELOPE_FIELDS = ("v", "kind", "ts")
+
+#: The canonical kind registry (schema v1): every event kind any writer
+#: emits, with a one-line description. Kept as a plain dict literal so
+#: the linter (analysis/lint, JG017) and scripts/check_event_docs.py can
+#: read it with ``ast.literal_eval`` — no jax, no package import.
+#: OBSERVABILITY.md's event table mirrors this registry row for row.
+EVENT_KINDS: Dict[str, str] = {
+    "run_manifest": "config, devices, mesh, versions, git rev (once)",
+    "step": "step index, latency, examples/sec, mfu, loss/acc",
+    "epoch": "per-epoch aggregates + device memory stats",
+    "eval": "test metrics",
+    "checkpoint": "epoch, path, best flag",
+    "bench": "a bench.py section result (same envelope as training)",
+    "infer": "packed-serving run summary",
+    "error": "exception type/message before a crash propagates",
+    "heartbeat": "liveness records (written per process, obs/heartbeat)",
+    "fault_injected": "a resilience/chaos fault fired (kind, point, step)",
+    "graceful_stop": "preemption honored at a step boundary",
+    "resume": "a run restored checkpoint state before training",
+    "rollback": "restore skipped corrupt generation(s) (resilience)",
+    "restart": "the retry loop rebuilt the trainer (cause, attempt)",
+    "membership_change": "elastic data-parallel membership change",
+    "remesh": "elastic mesh rebuild + state re-placement at a new world",
+    "comm_compress": "the run's 1-bit gradient-exchange plan (PERF.md)",
+    "metrics": "final registry snapshot at run close, before run_end",
+    "run_end": "run outcome summary — the log's closing record",
+    "sanitizer_trip": "a runtime fence (recompile/transfer/nan) fired",
+    "request": "one served prediction request's final status (serve/)",
+    "shed": "admission rejected a request (serve/)",
+    "breaker_open": "the serving circuit breaker tripped open",
+    "breaker_close": "it closed again after half-open probes",
+    "drain": "SIGTERM graceful drain completed (serve/)",
+    "reload": "hot artifact swap on the running server (serve/)",
+    "export": "cli export wrote a packed artifact (path, size info)",
+    "lm_admit": "a generation request took a batch slot (serve/lm/)",
+    "lm_evict": "a generation request left its slot or died queued",
+    "lm_decode": "periodic decode-iteration snapshot (serve/lm/)",
+    "lm_decode_error": "a decode dispatch failed and was retried",
+    "lm_prefix_hit": "admission forked a cached prompt prefix COW",
+    "lm_spec_round": "periodic speculative-decode round snapshot",
+    "aot_hit": "a boot installed a stored AOT executable (no compile)",
+    "aot_miss": "AOT store had no entry; online compile + re-bank",
+    "aot_bank": "an executable was serialized into the AOT store",
+    "aot_fallback": "corrupt/incompatible AOT entry quarantined",
+    "span": "one completed tracing span (obs/trace, `cli trace`)",
+    "program_cost": "one compiled program's HLO cost row (obs/costs)",
+    "profile_capture": "an on-demand jax.profiler capture completed",
+    "fleet_dispatch": "router routed (or failed) one fleet request",
+    "replica_health": "a replica health probe changed state (fleet)",
+    "replica_spawn": "the supervisor started a replica process",
+    "replica_exit": "a replica process exited (cause, respawn plan)",
+    "autoscale": "the supervisor changed the replica target (fleet)",
+    "rollout": "one rolling-deploy phase (ship/start/trip/...)",
+    "decision": "one control-plane decision with its inputs (fleet)",
+    "slo_alert": "a multiwindow burn-rate alert transitioned (obs/slo)",
+}
 
 
 def utc_now(epoch_s: Optional[float] = None) -> str:

@@ -41,6 +41,17 @@ All functions are pure and shard_map-friendly: with ``axis_name=None``
 (world 1) the collectives drop out and the pipeline degenerates to
 local compress/decompress — the single-process form the NumPy oracle
 tests check bit-for-bit.
+
+SPMD lockstep contract: the collective schedule here — ``2 * chunks``
+``all_to_all`` + ``2 * chunks`` ``all_gather`` calls per exchange, in
+plan order — depends only on the :class:`CommPlan` (static at trace
+time) and NEVER on gradient values or the process index. Every
+``if axis_name is not None`` guard branches on a host-static, so all
+processes take the same path; data-dependent branching around a
+collective is the multi-host hang the linter's JG012/JG014 flag and
+``analysis/spmd.py``'s lockstep checker (CI ``spmd-lockstep``,
+``cli lint --spmd``) verifies against at world 2/4/8. Keep any future
+collective on the unconditional path or mirrored across branches.
 """
 
 from __future__ import annotations

@@ -39,7 +39,13 @@ re-folded state onto the new mesh on the first dispatch.
 
 Proven by tests/test_elastic.py: NumPy oracles for both fold rules, and
 bitwise equality of the post-shrink trajectory against a fresh world-N
-run resumed from the same checkpoint generation.
+run resumed from the same checkpoint generation. The step that runs
+immediately AFTER a remesh is additionally lockstep-checked: the
+``remesh_fold_regrow`` program in ``analysis/spmd.py`` re-places
+exchange state across worlds (8→2, 8→4, 4→8) and verifies every
+process of the new world issues the identical collective schedule (CI
+``spmd-lockstep`` — a fold that desynced one process's schedule would
+hang a real multi-host fleet at the first post-resize exchange).
 """
 
 from __future__ import annotations

@@ -15,6 +15,16 @@ optimizer class, losing its state, utils.py:120-126 — same semantics).
 ``asgd`` (torch ASGD) is provided as SGD + Polyak tail averaging: the
 transform keeps a running parameter average in its state (the torch
 optimizer's ``ax`` buffer) while stepping as plain SGD.
+
+SPMD lockstep contract (``sign_compress`` / ``sign_compress_fsdp``):
+both transforms' ``update`` issue a fixed collective schedule derived
+from the :class:`~..ops.comm_compress.CommPlan` alone — never from
+gradient values or ``axis_index`` — so every process in the mesh runs
+the identical (op, axis, shape) sequence. ``analysis/spmd.py`` records
+and lockstep-checks exactly these programs (plus the post-remesh step)
+at world 2/4/8 in CI's ``spmd-lockstep`` job; a value-dependent branch
+around an exchange call would hang a real multi-host fleet and is what
+lint rules JG012/JG014 exist to catch.
 """
 
 from __future__ import annotations

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Event-schema doc-drift check: obs/events.py's EVENT_KINDS registry
+must mirror OBSERVABILITY.md's event table row for row.
+
+Both sides are parsed without importing the package (AST literal on the
+Python side, the markdown table on the doc side), so the check runs in
+any environment — it is a step of the CI lint job, and
+tests/test_analysis.py runs it in-process as a tier-1 test. Exit 0 when
+the sets match, 1 with a both-directions diff otherwise.
+
+The registry itself is enforced at emit() call sites by the linter's
+JG017 (unknown kind) and JG018 (envelope collision) — see ANALYSIS.md
+"SPMD pack & event-schema contracts".
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVENTS_PY = os.path.join(
+    REPO, "distributed_mnist_bnns_tpu", "obs", "events.py"
+)
+OBS_MD = os.path.join(REPO, "OBSERVABILITY.md")
+
+# A table row whose first cell is a single backticked kind name.
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+
+
+def registry_kinds(path: str = EVENTS_PY) -> Set[str]:
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if any(
+            isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+            for t in targets
+        ):
+            return set(ast.literal_eval(node.value))
+    raise SystemExit(f"no EVENT_KINDS literal found in {path}")
+
+
+def documented_kinds(path: str = OBS_MD) -> Set[str]:
+    """Rows of the event table specifically — the table whose header's
+    first column is `kind` (OBSERVABILITY.md also carries a metrics
+    table, which is out of contract)."""
+    kinds = set()
+    in_event_table = False
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            stripped = line.strip()
+            if re.match(r"^\|\s*kind\s*\|", stripped):
+                in_event_table = True
+                continue
+            if not in_event_table:
+                continue
+            if not stripped.startswith("|"):
+                in_event_table = False
+                continue
+            m = _ROW_RE.match(stripped)
+            if m:
+                kinds.add(m.group(1))
+    return kinds
+
+
+def diff() -> Tuple[Set[str], Set[str]]:
+    """(registered but undocumented, documented but unregistered)."""
+    reg = registry_kinds()
+    doc = documented_kinds()
+    return reg - doc, doc - reg
+
+
+def main() -> int:
+    undocumented, unregistered = diff()
+    if not undocumented and not unregistered:
+        n = len(registry_kinds())
+        print(f"event docs in sync: {n} kinds")
+        return 0
+    if undocumented:
+        print(
+            "kinds in obs/events.py EVENT_KINDS with no OBSERVABILITY.md "
+            f"event-table row: {sorted(undocumented)}",
+            file=sys.stderr,
+        )
+    if unregistered:
+        print(
+            "OBSERVABILITY.md event-table rows with no EVENT_KINDS "
+            f"entry: {sorted(unregistered)}",
+            file=sys.stderr,
+        )
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -394,6 +394,299 @@ def test_jg006_negative_shim_import():
 
 
 # --------------------------------------------------------------------------
+# SPMD pack (JG012-JG016) — collective-divergence hazards
+# --------------------------------------------------------------------------
+
+
+def test_jg012_flags_collective_in_one_cond_branch():
+    src = (
+        "import jax\n"
+        "def step(x, flag):\n"
+        "    return jax.lax.cond(\n"
+        "        flag,\n"
+        "        lambda v: jax.lax.psum(v, 'data'),\n"
+        "        lambda v: v,\n"
+        "        x,\n"
+        "    )\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG012")) == 1
+
+
+def test_jg012_negative_collective_in_both_branches():
+    src = (
+        "import jax\n"
+        "def step(x, flag):\n"
+        "    return jax.lax.cond(\n"
+        "        flag,\n"
+        "        lambda v: jax.lax.psum(v, 'data'),\n"
+        "        lambda v: jax.lax.psum(2.0 * v, 'data'),\n"
+        "        x,\n"
+        "    )\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG012")
+
+
+def test_jg012_flags_python_if_on_traced_value():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x, flag):\n"
+        "    if flag:\n"
+        "        x = jax.lax.psum(x, 'data')\n"
+        "    return x\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG012")) == 1
+
+
+def test_jg012_negative_host_static_axis_guard():
+    # The ops/comm_compress idiom: `if axis_name is not None:` is a
+    # Python-level static, identical on every process.
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x, axis_name=None):\n"
+        "    if axis_name is not None:\n"
+        "        x = jax.lax.psum(x, axis_name)\n"
+        "    return x\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG012")
+
+
+def test_jg012_flags_process_index_branch():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        x = jax.lax.psum(x, 'data')\n"
+        "    return x\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG012")) == 1
+
+
+def test_jg013_flags_unbound_axis_name():
+    src = (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def body(x):\n"
+        "    return jax.lax.psum(x, 'model')\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('data'),),\n"
+        "                     out_specs=P('data'))\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG013")) == 1
+
+
+def test_jg013_negative_symbolic_and_bound_axes():
+    src = (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def build(mesh, axis='data'):\n"
+        "    def body(x):\n"
+        "        return jax.lax.psum(x, axis)\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P(axis),),\n"
+        "                     out_specs=P(axis))\n"
+        "def build2(mesh):\n"
+        "    def body(x):\n"
+        "        return jax.lax.psum(x, 'data')\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('data'),),\n"
+        "                     out_specs=P('data'))\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG013")
+
+
+def test_jg014_flags_differing_branch_sequences():
+    src = (
+        "import jax\n"
+        "def a(v):\n"
+        "    return jax.lax.psum(v, 'data')\n"
+        "def b(v):\n"
+        "    return jax.lax.all_gather(v, 'data')\n"
+        "def step(x, flag):\n"
+        "    return jax.lax.cond(flag, a, b, x)\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG014")) == 1
+
+
+def test_jg014_flags_switch_with_unequal_counts():
+    src = (
+        "import jax\n"
+        "def a(v):\n"
+        "    return jax.lax.psum(v, 'data')\n"
+        "def b(v):\n"
+        "    return jax.lax.psum(jax.lax.psum(v, 'data'), 'data')\n"
+        "def step(x, i):\n"
+        "    return jax.lax.switch(i, [a, b], x)\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG014")) == 1
+
+
+def test_jg014_negative_matching_sequences():
+    src = (
+        "import jax\n"
+        "def a(v):\n"
+        "    return jax.lax.psum(v, 'data')\n"
+        "def b(v):\n"
+        "    return jax.lax.psum(v * 2.0, 'data')\n"
+        "def step(x, flag):\n"
+        "    return jax.lax.cond(flag, a, b, x)\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG014")
+
+
+def test_jg015_flags_pr8_donation_double_free_shape():
+    # The regression shape from the AOT PR: params donated into the
+    # jitted step, then the STALE name fed to an eval call.
+    src = (
+        "import jax\n"
+        "def run(train_step, eval_loss, params, batch):\n"
+        "    step = jax.jit(train_step, donate_argnums=(0,))\n"
+        "    new_params = step(params, batch)\n"
+        "    loss = eval_loss(params, batch)\n"
+        "    return new_params, loss\n"
+    )
+    found = active(run_source(src, "lib.py"), "JG015")
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_jg015_negative_rebind_at_call():
+    src = (
+        "import jax\n"
+        "def run(train_step, eval_loss, params, batch):\n"
+        "    step = jax.jit(train_step, donate_argnums=(0,))\n"
+        "    params = step(params, batch)\n"
+        "    loss = eval_loss(params, batch)\n"
+        "    return params, loss\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG015")
+
+
+def test_jg016_flags_in_specs_arity_mismatch():
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def body(x, y):\n"
+        "    return x + y\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh,\n"
+        "                     in_specs=(P('data'), P('data'), P('data')),\n"
+        "                     out_specs=P('data'))\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG016")) == 1
+
+
+def test_jg016_flags_out_specs_vs_return_tuple():
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def body(x):\n"
+        "    return x, x\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('data'),),\n"
+        "                     out_specs=(P('data'), P('data'), None))\n"
+    )
+    assert len(active(run_source(src, "lib.py"), "JG016")) == 1
+
+
+def test_jg016_negative_matching_arity_and_defaults():
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def body(x, y, scale=1.0):\n"
+        "    return x + scale * y, x\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh,\n"
+        "                     in_specs=(P('data'), P('data')),\n"
+        "                     out_specs=(P('data'), P('data')))\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG016")
+
+
+# --------------------------------------------------------------------------
+# Event-schema contracts (JG017/JG018) + doc-drift
+# --------------------------------------------------------------------------
+
+
+def test_jg017_flags_unknown_kind_and_allows_registered():
+    bad = "def f(tel):\n    tel.emit('totally_unknown_kind', loss=1.0)\n"
+    good = "def f(tel):\n    tel.emit('step', loss=1.0)\n"
+    assert len(active(run_source(bad, "lib.py"), "JG017")) == 1
+    assert not active(run_source(good, "lib.py"), "JG017")
+
+
+def test_jg017_exempts_test_files():
+    bad = "def f(tel):\n    tel.emit('totally_unknown_kind', loss=1.0)\n"
+    assert not active(run_source(bad, "test_lib.py"), "JG017")
+
+
+def test_jg018_flags_envelope_collision():
+    # The shape that shipped twice (PR 4 `reload`, PR 6 `cli export`):
+    # a payload key clobbering the envelope's own `kind`/`ts`.
+    src = (
+        "def f(tel, record):\n"
+        "    tel.emit('reload', kind=record['kind'])\n"
+        "    tel.emit('export', **{'ts': 1.0, 'n': 2})\n"
+        "    tel.emit('step', loss=1.0)\n"
+    )
+    found = active(run_source(src, "lib.py"), "JG018")
+    assert len(found) == 2
+
+
+def test_event_registry_matches_observability_md():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_event_docs",
+        os.path.join(
+            os.path.dirname(PKG_DIR), "scripts", "check_event_docs.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    undocumented, unregistered = mod.diff()
+    assert not undocumented, (
+        f"EVENT_KINDS entries missing an OBSERVABILITY.md row: "
+        f"{sorted(undocumented)}"
+    )
+    assert not unregistered, (
+        f"OBSERVABILITY.md rows missing an EVENT_KINDS entry: "
+        f"{sorted(unregistered)}"
+    )
+
+
+def test_event_registry_covers_every_emitted_literal_kind():
+    # Every literal-kind emit() call site in the package must name a
+    # registered kind — the package-wide JG017 sweep, asserted directly
+    # so the contract holds even with lint suppressions in play.
+    import ast as ast_mod
+
+    from distributed_mnist_bnns_tpu.obs.events import EVENT_KINDS
+
+    unknown = []
+    for root, _dirs, files in os.walk(PKG_DIR):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, encoding="utf-8") as f:
+                tree = ast_mod.parse(f.read())
+            for node in ast_mod.walk(tree):
+                if (
+                    isinstance(node, ast_mod.Call)
+                    and isinstance(node.func, ast_mod.Attribute)
+                    and node.func.attr == "emit"
+                    and node.args
+                    and isinstance(node.args[0], ast_mod.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value not in EVENT_KINDS
+                ):
+                    unknown.append((path, node.lineno, node.args[0].value))
+    assert not unknown, f"unregistered emit kinds: {unknown}"
+
+
+# --------------------------------------------------------------------------
 # suppression comments
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,149 @@
+"""analysis/spmd.py — the SPMD pack's runtime half: per-process
+collective-schedule recording, the lockstep checker, and the shipped
+collective programs (compressed DP, compressed FSDP, elastic remesh)
+holding lockstep at world 2/4/8 — plus the seeded divergence mutant
+(a collective moved inside one ``lax.cond`` branch) the checker MUST
+catch with a first-divergence report."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_mnist_bnns_tpu.analysis.spmd import (
+    CollectiveOp,
+    LockstepError,
+    check_lockstep,
+    record_schedule,
+    run_lockstep,
+    verify_shipped,
+)
+
+# --------------------------------------------------------------------------
+# recorder mechanics
+# --------------------------------------------------------------------------
+
+
+def test_recorder_captures_ordered_schedule_and_restores_lax():
+    real_psum = jax.lax.psum
+
+    def prog(x):
+        y = jax.lax.psum(x, "data")
+        z = jax.lax.all_gather(y, "data", axis=0)
+        return jax.lax.all_to_all(z, "data", split_axis=0, concat_axis=0)
+
+    sched = record_schedule(prog, jnp.ones((4, 8)), world=4, pid=1)
+    assert [c.op for c in sched] == ["psum", "all_gather", "all_to_all"]
+    assert [c.index for c in sched] == [0, 1, 2]
+    assert sched[0].axis == "data" and sched[0].shape == (4, 8)
+    assert sched[1].shape == (4, 8)      # input shape, pre-gather
+    # the patch context restored the real collectives
+    assert jax.lax.psum is real_psum
+
+
+def test_recorder_stubs_are_shape_correct_and_pid_aware():
+    def prog(x):
+        i = jax.lax.axis_index("data")
+        g = jax.lax.all_gather(x, "data", axis=0)
+        t = jax.lax.all_gather(x, "data", axis=0, tiled=True)
+        s = jax.lax.psum(x, "data")
+        return i, g, t, s
+
+    out = {}
+
+    def wrapper(x):
+        out["i"], out["g"], out["t"], out["s"] = prog(x)
+
+    record_schedule(wrapper, jnp.ones((3, 2)), world=4, pid=2)
+    assert int(out["i"]) == 2
+    assert out["g"].shape == (4, 3, 2)   # stacked world axis
+    assert out["t"].shape == (12, 2)     # tiled concat
+    assert float(out["s"][0, 0]) == 4.0  # psum scales by world
+
+
+def test_lockstep_passes_on_identical_schedules():
+    def prog(x):
+        return jax.lax.psum(x, "data")
+
+    scheds = [
+        record_schedule(prog, jnp.ones(4), world=2, pid=p) for p in range(2)
+    ]
+    check_lockstep(scheds)  # does not raise
+
+
+def test_lockstep_flags_mismatched_op_identity():
+    a = [CollectiveOp(0, "psum", "data", (4,), "float32")]
+    b = [CollectiveOp(0, "all_gather", "data", (4,), "float32")]
+    with pytest.raises(LockstepError) as e:
+        check_lockstep([a, b])
+    assert e.value.divergence_index == 0
+    assert "psum" in str(e.value) and "all_gather" in str(e.value)
+
+
+def test_lockstep_flags_length_mismatch_at_shorter_end():
+    base = [
+        CollectiveOp(0, "psum", "data", (4,), "float32"),
+        CollectiveOp(1, "all_gather", "data", (4,), "float32"),
+    ]
+    with pytest.raises(LockstepError) as e:
+        check_lockstep([base, base[:1]])
+    assert e.value.divergence_index == 1
+    assert "schedule ends at 1" in str(e.value)
+
+
+# --------------------------------------------------------------------------
+# the seeded divergence mutant — the shape the checker exists to catch
+# --------------------------------------------------------------------------
+
+
+def _mutant_step(x):
+    """The compressed exchange's psum moved inside one lax.cond branch,
+    predicated on the (per-process!) local gradient sign."""
+    return jax.lax.cond(
+        jnp.sum(x) > 0,
+        lambda v: jax.lax.psum(v, "data"),
+        lambda v: v,
+        x,
+    )
+
+
+def test_mutant_cond_divergence_is_caught_with_first_index():
+    def build(pid, world):
+        # process 0 sees positive data, everyone else negative: the
+        # predicate diverges across the simulated fleet.
+        x = jnp.full((4,), 1.0 if pid == 0 else -1.0)
+        return _mutant_step, (x,)
+
+    with pytest.raises(LockstepError) as e:
+        run_lockstep(build, world=4)
+    assert e.value.divergence_index == 0
+    msg = str(e.value)
+    assert "process 0" in msg and "psum" in msg
+    assert "no collective" in msg  # the silent side of the hang
+    assert len(e.value.schedules) == 4
+
+
+def test_mutant_passes_when_predicate_agrees():
+    # Same program, uniform data: lax.cond takes the same branch on
+    # every process — the checker must not cry wolf.
+    def build(pid, world):
+        return _mutant_step, (jnp.full((4,), 1.0),)
+
+    scheds = run_lockstep(build, world=4)
+    assert all(len(s) == 1 and s[0].op == "psum" for s in scheds)
+
+
+# --------------------------------------------------------------------------
+# shipped collective programs in lockstep at world 2/4/8
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize(
+    "program", ["dp_exchange", "fsdp_exchange", "remesh_fold_regrow"]
+)
+def test_shipped_program_holds_lockstep(program, world):
+    (row,) = verify_shipped(worlds=(world,), programs=(program,))
+    assert row["ok"] and row["world"] == world
+    # the 1-bit exchange issues its collectives chunk by chunk: two
+    # phases x two tensors (planes + scales) x two chunks
+    assert row["n_collectives"] == 8
