@@ -288,7 +288,7 @@ def _utc_now(epoch_s: float | None = None) -> str:
 SECTION_MERGE_KEYS = (
     "serving", "lm_flash", "crossover", "stretch_xnor_resnet18_cifar",
     "device_resident_epoch", "train_step_per_backend", "comm",
-    "comm_fsdp", "lm_serve", "serving_p99", "cold_start",
+    "comm_fsdp", "comm_hier", "lm_serve", "serving_p99", "cold_start",
     "device_costs", "fleet_availability",
 )
 
@@ -735,6 +735,105 @@ def _bench_comm_fsdp(args, deadline):
             )
             if comp["wire_bytes_per_step"] else None
         )
+    return out
+
+
+def _bench_comm_hier(args, deadline):
+    """Two-level hierarchical exchange section (--comm-bench; PERF.md
+    "Hierarchical comms"): the DP world factored into (hosts x local)
+    — fp32 ring reduce within a host's 'local' mesh axis, 1-bit
+    sign_ef exchange over the inter-host axis only. Reports the
+    two-level analytic wire model (intra fp32 ring bytes vs inter 1-bit
+    bytes, both derived from the real packed sizes like the flat
+    sections) plus measured step time and the post-warmup compile
+    count. The gated headline: inter-host bytes as a fraction of the
+    flat fp32 ring at the SAME total world (<= 1/8 by the multi-host
+    acceptance band — the slow-link traffic the hierarchy exists to
+    minimize)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_mnist_bnns_tpu.obs import get_tracker
+    from distributed_mnist_bnns_tpu.train import TrainConfig, Trainer
+
+    n = jax.device_count()
+    hosts = 2
+    out = {
+        "devices": n,
+        "hosts": hosts,
+        "model": args.model,
+        "batch_size": args.comm_batch_size,
+        "backend": args.backend,
+        "device_kind": str(jax.devices()[0].device_kind),
+    }
+    if n < 4 or n % hosts:
+        out["note"] = (
+            f"{n} devices cannot factor into (hosts={hosts} x local>1): "
+            "no hierarchical exchange to measure"
+        )
+        return out
+    bs = -(-args.comm_batch_size // n) * n
+    if args.model.startswith("xnor-resnet"):
+        input_shape = (32, 32, 3)
+    else:
+        input_shape = (28, 28, 1)
+    key = jax.random.PRNGKey(0)
+    images = np.asarray(jax.random.normal(
+        key, (bs, *input_shape), jnp.float32
+    ))
+    labels = np.asarray(jax.random.randint(key, (bs,), 0, 10))
+    if time.monotonic() > deadline:
+        out["hier"] = "skipped (bench deadline)"
+        return out
+    tracker = get_tracker()
+    trainer = Trainer(
+        TrainConfig(
+            model=args.model, batch_size=bs, optimizer="adam",
+            learning_rate=0.01, backend=args.backend, seed=0,
+            data_parallel="auto", grad_compress="sign_ef",
+            dp_hosts=hosts,
+        ),
+        input_shape=input_shape,
+    )
+    # warm separately so the compile count covers ONLY the post-warmup
+    # steps (the gated zero-compile contract, as in the fsdp section)
+    for _ in range(max(1, args.warmup)):
+        trainer.state, m = trainer.train_step(
+            trainer.state, images, labels, trainer.rng
+        )
+    float(m["loss"])
+    c0 = tracker.count
+    dt, loss = _bench_train_step(
+        trainer, images, labels, min(args.steps, args.comm_steps),
+        args.warmup, args.reps, deadline,
+    )
+    compiles_post_warmup = tracker.count - c0
+    h = trainer.hier_plan
+    row = {
+        "hosts": h.hosts,
+        "local": h.local,
+        "n_params": h.inter.n_params,
+        "intra_bytes_per_step": h.intra_bytes_per_step,
+        "inter_bytes_per_step": h.inter_bytes_per_step,
+        "inter_bytes_rs": h.inter.wire_bytes_rs,
+        "inter_bytes_ag": h.inter.wire_bytes_ag,
+        "flat_fp32_bytes_per_step": h.flat_fp32_bytes_per_step,
+        "inter_ratio_vs_flat_fp32": (
+            round(h.inter_ratio_vs_flat_fp32, 5)
+            if h.inter_ratio_vs_flat_fp32 is not None else None
+        ),
+        "compiles_post_warmup": compiles_post_warmup,
+    }
+    if dt is None:
+        row["step_time_ms"] = "below measurement floor"
+    else:
+        row.update(
+            step_time_ms=round(dt * 1e3, 3),
+            images_per_sec=round(bs / dt, 1),
+            loss_finite=math.isfinite(loss),
+        )
+    out["hier"] = row
     return out
 
 
@@ -2072,6 +2171,11 @@ def main() -> None:
             result["comm_fsdp"] = _bench_comm_fsdp(args, deadline)
         except Exception as e:  # never let the extra kill the bench line
             result["comm_fsdp"] = f"failed: {e!r:.300}"
+        try:
+            _progress("comm_hier: hierarchical two-level exchange section")
+            result["comm_hier"] = _bench_comm_hier(args, deadline)
+        except Exception as e:  # never let the extra kill the bench line
+            result["comm_hier"] = f"failed: {e!r:.300}"
 
     if args.cold_start_bench and time.monotonic() < deadline - 60:
         try:

@@ -54,8 +54,18 @@ __all__ = [
     "record_schedule",
     "check_lockstep",
     "run_lockstep",
+    "run_lockstep_mesh",
     "verify_shipped",
 ]
+
+# int worlds simulate a 1-D mesh (the original flat checker); dict
+# worlds ({axis_name: size}) simulate a multi-axis mesh — collectives
+# then resolve their group size from the NAMED axis they run over
+# (tuple axis names multiply member sizes), which is what the two-level
+# hierarchical exchange needs: a pmean over 'local' must not scale by
+# the 'data' axis's size and vice versa.
+World = Any   # int | Dict[str, int]
+Pid = Any     # int | Dict[str, int]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,15 +184,47 @@ def _first_leaf(value: Any):
 
 @contextlib.contextmanager
 def _simulated_process(
-    schedule: List[CollectiveOp], *, world: int, pid: int
+    schedule: List[CollectiveOp], *, world: World, pid: Pid
 ) -> Iterator[None]:
     """Run the body eagerly as simulated process ``pid`` of ``world``:
     ``jax.lax`` collectives are replaced by recording, shape-correct
     local stubs; ``axis_index`` returns ``pid``; everything runs under
     ``jax.disable_jit()`` so ``lax.cond`` takes only the concrete
-    branch (the property the whole checker rests on)."""
+    branch (the property the whole checker rests on).
+
+    Multi-axis meshes: pass ``world`` / ``pid`` as ``{axis_name: ...}``
+    dicts — each collective then scales/splits by the size of the axis
+    (or tuple of axes) it names, and ``axis_index`` returns that axis's
+    coordinate."""
     import jax
     import jax.numpy as jnp
+
+    def axis_size(axis: Any) -> int:
+        if isinstance(world, int):
+            return world
+        if axis is None:
+            n = 1
+            for v in world.values():
+                n *= v
+            return n
+        if isinstance(axis, (tuple, list)):
+            n = 1
+            for a in axis:
+                n *= axis_size(a)
+            return n
+        return world[axis]
+
+    def axis_pid(axis: Any) -> int:
+        if isinstance(pid, int):
+            return pid
+        if isinstance(axis, (tuple, list)):
+            # Row-major flattening over the named axes — the convention
+            # a real mesh uses for a collective over a tuple of axes.
+            n = 0
+            for a in axis:
+                n = n * axis_size(a) + axis_pid(a)
+            return n
+        return pid[axis]
 
     def record(op: str, axis: Any, value: Any) -> None:
         leaf = _first_leaf(value)
@@ -198,7 +240,8 @@ def _simulated_process(
 
     def psum(x, axis_name, **kw):
         record("psum", axis_name, x)
-        return jax.tree.map(lambda v: v * world, x)
+        n = axis_size(axis_name)
+        return jax.tree.map(lambda v: v * n, x)
 
     def pmean(x, axis_name, **kw):
         record("pmean", axis_name, x)
@@ -214,24 +257,27 @@ def _simulated_process(
 
     def psum_scatter(x, axis_name, *, scatter_dimension=0, tiled=False, **kw):
         record("psum_scatter", axis_name, x)
+        n, i = axis_size(axis_name), axis_pid(axis_name)
         return jax.tree.map(
-            lambda v: jnp.split(v * world, world, axis=scatter_dimension)[pid],
+            lambda v: jnp.split(v * n, n, axis=scatter_dimension)[i],
             x,
         )
 
     def all_gather(x, axis_name, *, axis=0, tiled=False, **kw):
         record("all_gather", axis_name, x)
+        n = axis_size(axis_name)
         if tiled:
             return jax.tree.map(
-                lambda v: jnp.concatenate([v] * world, axis=axis), x
+                lambda v: jnp.concatenate([v] * n, axis=axis), x
             )
-        return jax.tree.map(lambda v: jnp.stack([v] * world, axis=axis), x)
+        return jax.tree.map(lambda v: jnp.stack([v] * n, axis=axis), x)
 
     def all_to_all(x, axis_name, split_axis, concat_axis, **kw):
         record("all_to_all", axis_name, x)
+        n = axis_size(axis_name)
         return jax.tree.map(
             lambda v: jnp.concatenate(
-                jnp.split(v, world, axis=split_axis), axis=concat_axis
+                jnp.split(v, n, axis=split_axis), axis=concat_axis
             ),
             x,
         )
@@ -241,7 +287,7 @@ def _simulated_process(
         return x
 
     def axis_index(axis_name):
-        return jnp.int32(pid)
+        return jnp.int32(axis_pid(axis_name))
 
     stubs: Dict[str, Callable] = {
         "psum": psum, "pmean": pmean, "pmax": pmax, "pmin": pmin,
@@ -255,8 +301,14 @@ def _simulated_process(
     try:
         for name, stub in stubs.items():
             setattr(jax.lax, name, stub)
-        jax.process_index = lambda backend=None: pid
-        jax.process_count = lambda backend=None: world
+        if isinstance(world, int):
+            flat_pid, flat_world = pid, world
+        else:
+            axes = tuple(world)
+            flat_pid = axis_pid(axes)
+            flat_world = axis_size(axes)
+        jax.process_index = lambda backend=None: flat_pid
+        jax.process_count = lambda backend=None: flat_world
         with jax.disable_jit():
             yield
     finally:
@@ -267,7 +319,7 @@ def _simulated_process(
 
 
 def record_schedule(
-    fn: Callable, *args: Any, world: int, pid: int, **kwargs: Any
+    fn: Callable, *args: Any, world: World, pid: Pid, **kwargs: Any
 ) -> List[CollectiveOp]:
     """Run ``fn(*args, **kwargs)`` as simulated process ``pid`` of
     ``world`` and return its ordered collective schedule."""
@@ -295,11 +347,38 @@ def run_lockstep(
     return schedules
 
 
+def run_lockstep_mesh(
+    build: Callable[[Dict[str, int], Dict[str, int]], Tuple[Callable, Tuple]],
+    axes: Dict[str, int],
+) -> List[List[CollectiveOp]]:
+    """Multi-axis :func:`run_lockstep`: record every coordinate of the
+    named mesh (row-major over ``axes``) and lockstep-check the lot.
+    ``build(pid, axes)`` receives the per-axis coordinate dict — e.g.
+    ``{"data": 1, "local": 3}`` on a (data=2, local=4) mesh — and runs
+    outside the simulator; ``fn(*args)`` runs inside. On real hardware
+    EVERY device participates in every collective of the two-level
+    exchange (the local pmean groups by host, the inter-host phases
+    group by local index), so all hosts*local schedules must agree."""
+    names = tuple(axes)
+    coords: List[Dict[str, int]] = [{}]
+    for name in names:
+        coords = [
+            {**c, name: i} for c in coords for i in range(axes[name])
+        ]
+    schedules = []
+    for pid in coords:
+        fn, args = build(pid, dict(axes))
+        schedules.append(record_schedule(fn, *args, world=dict(axes), pid=pid))
+    check_lockstep(schedules)
+    return schedules
+
+
 # --------------------------------------------------------------------------
 # The shipped collective programs (the CI spmd-lockstep job's matrix)
 # --------------------------------------------------------------------------
 
 _AXIS = "data"
+_LOCAL_AXIS = "local"
 _N_PARAMS = 1000     # two-leaf pytree, deliberately not bucket-aligned
 _BUCKET = 64         # padded = world*nb*64 = 1024 at world 2/4/8
 _CHUNKS = 2
@@ -426,11 +505,44 @@ def _remesh_program(world: int):
     return build
 
 
+def _hier_program(hosts: int, local: int):
+    """The two-level hierarchical exchange: fp32 pmean over 'local'
+    (the in-host ring) then ``sign_compress``'s 1-bit two-phase
+    exchange over 'data' (the inter-host link), as each DEVICE of the
+    (hosts x local) mesh runs it inside the hierarchical shard_map
+    step. Per-host EF rows are replicated over 'local', so the local
+    view slices the leading ``hosts`` axis by the 'data' coordinate."""
+    from ..train.optim import sign_compress
+
+    tx = sign_compress(
+        mode="sign_ef", world=hosts, axis_name=_AXIS,
+        local_axis_name=_LOCAL_AXIS, bucket_size=_BUCKET, chunks=_CHUNKS,
+    )
+    state = tx.init(_demo_params())
+
+    def build(pid: Dict[str, int], axes: Dict[str, int]):
+        flat = pid[_AXIS] * axes[_LOCAL_AXIS] + pid[_LOCAL_AXIS]
+        return tx.update, (
+            _demo_grads(flat), _local_view(state, hosts, pid[_AXIS]),
+        )
+
+    return build
+
+
 SHIPPED_PROGRAMS: Dict[str, Callable[[int], Callable]] = {
     "dp_exchange": _dp_program,
     "fsdp_exchange": _fsdp_program,
     "remesh_fold_regrow": _remesh_program,
 }
+
+# Multi-axis programs run at (hosts x local) meshes instead of flat
+# worlds: every process x local-device coordinate is simulated and must
+# agree on the full two-level schedule.
+SHIPPED_MESH_PROGRAMS: Dict[str, Callable[[int, int], Callable]] = {
+    "hier_exchange": _hier_program,
+}
+
+MESH_WORLDS: Tuple[Tuple[int, int], ...] = ((2, 2), (2, 4), (4, 2))
 
 
 def verify_shipped(
@@ -445,23 +557,47 @@ def verify_shipped(
     message) on the first divergence — this is the CI ``spmd-lockstep``
     job's body and the gate ROADMAP item 1's multi-host PR must pass.
     """
-    names = list(programs) if programs is not None else list(SHIPPED_PROGRAMS)
+    if programs is not None:
+        names = list(programs)
+    else:
+        names = list(SHIPPED_PROGRAMS) + list(SHIPPED_MESH_PROGRAMS)
     report: List[Dict[str, Any]] = []
     for name in names:
-        factory = SHIPPED_PROGRAMS[name]
-        for world in worlds:
+        if name in SHIPPED_PROGRAMS:
+            factory = SHIPPED_PROGRAMS[name]
+            for world in worlds:
+                try:
+                    schedules = run_lockstep(factory(world), world)
+                except LockstepError as e:
+                    raise LockstepError(
+                        f"program {name!r} at world {world}:\n{e}",
+                        divergence_index=e.divergence_index,
+                        schedules=e.schedules,
+                    ) from None
+                report.append(
+                    {
+                        "program": name,
+                        "world": world,
+                        "n_collectives": len(schedules[0]),
+                        "ok": True,
+                    }
+                )
+            continue
+        factory = SHIPPED_MESH_PROGRAMS[name]
+        for hosts, local in MESH_WORLDS:
+            axes = {_AXIS: hosts, _LOCAL_AXIS: local}
             try:
-                schedules = run_lockstep(factory(world), world)
+                schedules = run_lockstep_mesh(factory(hosts, local), axes)
             except LockstepError as e:
                 raise LockstepError(
-                    f"program {name!r} at world {world}:\n{e}",
+                    f"program {name!r} at mesh {hosts}x{local}:\n{e}",
                     divergence_index=e.divergence_index,
                     schedules=e.schedules,
                 ) from None
             report.append(
                 {
                     "program": name,
-                    "world": world,
+                    "world": f"{hosts}x{local}",
                     "n_collectives": len(schedules[0]),
                     "ok": True,
                 }

@@ -202,6 +202,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "over ZeRO-sharded optimizer state) and "
                              "with --scan-steps; TP/PP/device-data "
                              "rejected")
+        sp.add_argument("--dp-hosts", type=int, default=None,
+                        help="two-level hierarchical compressed "
+                             "exchange: factor the DP world into "
+                             "(hosts x local); fp32 ring reduce within "
+                             "a host's 'local' mesh axis, 1-bit "
+                             "exchange across the inter-host axis only "
+                             "(needs --grad-compress, --dp-mode gspmd)")
         sp.add_argument("--compress-bucket-size", type=int, default=1024,
                         help="elements per compression scale bucket "
                              "(multiple of 32)")
@@ -239,6 +246,13 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--node-rank", type=int, default=0)
         sp.add_argument("--coordinator", default=None,
                         help="host:port of process 0")
+        sp.add_argument("--init-timeout", type=float, default=60.0,
+                        help="per-attempt coordinator handshake deadline "
+                             "(seconds) for jax.distributed.initialize")
+        sp.add_argument("--init-retries", type=int, default=3,
+                        help="retry budget for retryable bootstrap "
+                             "failures (coordinator-unreachable/timeout; "
+                             "rank collisions fail fast)")
 
     t = sub.add_parser("train", help="train a model")
     common(t)
@@ -812,6 +826,7 @@ def _make_trainer(args, input_shape=(28, 28, 1), num_classes=10,
         data_parallel=args.dp if args.dp == "auto" else int(args.dp),
         dp_mode=args.dp_mode,
         grad_compress=args.grad_compress,
+        dp_hosts=args.dp_hosts,
         compress_bucket_size=args.compress_bucket_size,
         compress_chunks=args.compress_chunks,
         pipeline_parallel=args.pp,
@@ -1548,6 +1563,8 @@ def main(argv=None) -> int:
             coordinator_address=args.coordinator,
             num_processes=args.nodes,
             process_id=args.node_rank,
+            initialization_timeout_s=args.init_timeout,
+            retries=args.init_retries,
         )
 
     import jax
@@ -1705,9 +1722,17 @@ def main(argv=None) -> int:
     )
 
     if args.cmd == "train":
-        if getattr(args, "elastic", False):
+        from .parallel.distributed import detect_multihost
+
+        if getattr(args, "elastic", False) and detect_multihost() is None:
             rc, history = _fit_elastic(args, data, trainer)
         else:
+            # Plain resumable contract — including multihost elastic
+            # RANK processes (JG_MH_* set): membership is supervised by
+            # the PARENT (resilience.multihost.run_elastic_multihost),
+            # so a host-loss/regrow Preempted must surface as exit 75
+            # for it, not be "resumed" by an in-process run_elastic
+            # that cannot rebuild the TCP world.
             rc, history = _fit_resumable(lambda: trainer.fit(data))
         if rc:
             return rc

@@ -109,6 +109,8 @@ EVENT_KINDS: Dict[str, str] = {
     "rollout": "one rolling-deploy phase (ship/start/trip/...)",
     "decision": "one control-plane decision with its inputs (fleet)",
     "slo_alert": "a multiwindow burn-rate alert transitioned (obs/slo)",
+    "multihost_init": "cluster bootstrap outcome (attempts, classified)",
+    "host_membership": "host-level elastic membership change (multihost)",
 }
 
 

@@ -310,6 +310,90 @@ def make_compressed_dp_train_step(
     )
 
 
+def make_compressed_hier_train_step(
+    clamp_mask: Any,
+    mesh: Mesh,
+    state: "TrainState",
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    host_axis: str = "data",
+    local_axis: str = "local",
+    remat: bool = False,
+    grad_accum: int = 1,
+    augment: bool = False,
+    scan_steps: int = 1,
+) -> Callable:
+    """Two-level hierarchical compressed-DP train step
+    (ops/comm_compress.hier_exchange): batch sharded over BOTH mesh
+    axes (hosts x local devices), gradients fp32-pmean'd over
+    ``local_axis`` inside ``state.tx`` (``sign_compress(...,
+    local_axis_name=...)``) — the in-host ring reduce on the fast
+    interconnect — then 1-bit exchanged over ``host_axis`` only, the
+    slow link. No gradient collective appears in this body (same
+    contract as the flat compressed step).
+
+    ``state`` is the template whose opt_state carries the per-HOST EF
+    residual rows: leading axis = hosts, sharded over ``host_axis``,
+    replicated over ``local_axis`` (every device on a host computes the
+    identical post-pmean residual, so replication is consistent).
+    ``scan_steps > 1`` fuses S steps into one scanned dispatch like the
+    flat variants.
+    """
+    body = make_step_body(
+        clamp_mask, loss_fn=loss_fn, remat=remat, grad_accum=grad_accum,
+        augment=augment,
+    )
+    axes = (host_axis, local_axis)
+    local_n = mesh.shape[local_axis]
+
+    def hier_train_step(state, images, labels, rng):
+        # Decorrelate per-DEVICE noise over the flattened (host, local)
+        # index; the body additionally folds in state.step.
+        dev = (
+            jax.lax.axis_index(host_axis) * local_n
+            + jax.lax.axis_index(local_axis)
+        )
+        rng = jax.random.fold_in(rng, dev)
+        new_state, metrics = body(state, images, labels, rng)
+        metrics = jax.lax.pmean(metrics, axes)
+        bs = new_state.batch_stats
+        if bs:
+            new_state = new_state.replace(
+                batch_stats=jax.lax.pmean(bs, axes)
+            )
+        return new_state, metrics
+
+    from .fsdp import compressed_state_specs
+
+    state_specs = compressed_state_specs(state, host_axis)
+    if scan_steps > 1:
+
+        def hier_train_scan_step(state, images, labels, rng):
+            def scan_body(st, xs):
+                st, m = hier_train_step(st, xs[0], xs[1], rng)
+                return st, m
+
+            state, ms = jax.lax.scan(scan_body, state, (images, labels))
+            return state, jax.tree.map(jnp.mean, ms)
+
+        shmapped = shard_map(
+            hier_train_scan_step,
+            mesh=mesh,
+            in_specs=(state_specs, P(None, axes), P(None, axes), P()),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+    else:
+        shmapped = shard_map(
+            hier_train_step,
+            mesh=mesh,
+            in_specs=(state_specs, P(axes), P(axes), P()),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+    return jax.jit(shmapped, donate_argnums=(0,))
+
+
 def make_compressed_fsdp_train_step(
     clamp_mask: Any,
     mesh: Mesh,

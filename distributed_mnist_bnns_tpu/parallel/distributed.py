@@ -8,21 +8,227 @@ jax.distributed.initialize connects every process to the coordinator.
 All collectives thereafter are XLA collectives compiled onto ICI/DCN —
 there is no hand-rolled transport (the reference's raw-TCP checkpoint
 shipping, mnist change master.py:117-124, is subsumed by the checkpoint
-component writing to shared storage; utils/checkpoint.py)."""
+component writing to shared storage; utils/checkpoint.py).
+
+Hardened bootstrap (multi-host elastic runtime): the bare
+``jax.distributed.initialize`` call hangs forever on an unreachable
+coordinator and surfaces rank collisions as opaque RPC errors — on a
+real fleet that is the difference between "host 3 restarted with a
+stale rank file" and "the coordinator VM is gone", and the two need
+opposite responses. So the wrapper here
+
+  * **fails fast on config errors** (``check_multihost_config``): a
+    rank outside ``[0, num_processes)`` or a nonsense port is a
+    programming error that no amount of retrying fixes — ``ValueError``
+    before any network I/O;
+  * **bounds every attempt** with ``initialization_timeout_s`` (passed
+    through to jax's own coordinator handshake deadline);
+  * **classifies failures loudly** (``classify_init_error``):
+    ``coordinator-unreachable`` (refused/unavailable — the coordinator
+    process is not there), ``rank-collision`` (two processes claimed
+    the same ``process_id`` — retrying REJOINS the collision, so this
+    is fatal), ``timeout`` (the coordinator exists but the world never
+    filled — a peer is missing);
+  * **retries the retryable kinds** (unreachable/timeout/unknown) with
+    the jittered exponential backoff of
+    :class:`~..resilience.policy.RetryPolicy` — constant-delay retries
+    from a fleet of restarting hosts synchronize into a thundering
+    herd on the coordinator exactly when it is struggling;
+  * raises :class:`MultihostInitError` carrying the classified
+    ``kind`` once the budget is spent, and emits a ``multihost_init``
+    event (attempts, outcome, kind) when given a telemetry.
+
+``detect_multihost`` reads the ``JG_MH_*`` environment the elastic
+supervisor (resilience/multihost.py) exports into each rank process —
+the env:// analogue for the subprocess-per-host runtime where the
+inter-host exchange travels over the host collective
+(parallel/hostcomm.py) rather than XLA.
+"""
 
 from __future__ import annotations
 
 import logging
+import os
+import time
+from typing import Any, Callable, Dict, Optional
 
 import jax
 
 log = logging.getLogger(__name__)
+
+# Environment contract between the elastic multihost supervisor and its
+# rank subprocesses (resilience/multihost.py exports, detect_multihost
+# reads). RANK/HOSTS name the host-level world; PORT is the rank-0
+# conductor port for the host collective; STORE is the shared directory
+# (checkpoints + membership.json + events).
+ENV_RANK = "JG_MH_RANK"
+ENV_HOSTS = "JG_MH_HOSTS"
+ENV_PORT = "JG_MH_PORT"
+ENV_STORE = "JG_MH_STORE"
+
+#: classification kinds (MultihostInitError.kind)
+COORDINATOR_UNREACHABLE = "coordinator-unreachable"
+RANK_COLLISION = "rank-collision"
+TIMEOUT = "timeout"
+UNKNOWN = "unknown"
+
+# Substring → kind, matched case-insensitively against the failure
+# message. jax.distributed surfaces grpc status strings; the patterns
+# cover both the grpc spellings and the Python exception types' texts.
+_UNREACHABLE_PATTERNS = (
+    "connection refused", "unavailable", "failed to connect",
+    "connection reset", "name or service not known", "unreachable",
+)
+_COLLISION_PATTERNS = (
+    "already exists", "already_exists", "duplicate task",
+    "duplicate process", "already connected", "task already",
+)
+_TIMEOUT_PATTERNS = (
+    "deadline exceeded", "deadline_exceeded", "timed out", "timeout",
+    "barrier timed out",
+)
+
+
+class MultihostInitError(RuntimeError):
+    """Cluster bootstrap failed; ``kind`` carries the classification
+    (coordinator-unreachable | rank-collision | timeout | unknown)."""
+
+    def __init__(self, message: str, *, kind: str, attempts: int = 1):
+        super().__init__(message)
+        self.kind = kind
+        self.attempts = attempts
+
+
+def classify_init_error(exc: BaseException) -> str:
+    """Map an initialize failure onto the loud kinds above.
+
+    Exception types first (a raw ``ConnectionRefusedError`` needs no
+    message sniffing), then message substrings — jax wraps the grpc
+    status into ``RuntimeError`` text, so the string is usually all
+    there is.
+    """
+    if isinstance(exc, ConnectionError):
+        return COORDINATOR_UNREACHABLE
+    if isinstance(exc, TimeoutError):
+        return TIMEOUT
+    msg = str(exc).lower()
+    for pat in _COLLISION_PATTERNS:
+        if pat in msg:
+            return RANK_COLLISION
+    for pat in _UNREACHABLE_PATTERNS:
+        if pat in msg:
+            return COORDINATOR_UNREACHABLE
+    for pat in _TIMEOUT_PATTERNS:
+        if pat in msg:
+            return TIMEOUT
+    return UNKNOWN
+
+
+def check_multihost_config(
+    coordinator_address: Optional[str],
+    num_processes: Optional[int],
+    process_id: Optional[int],
+) -> None:
+    """Fail-fast sanity checks before any network I/O (``ValueError``
+    — classified fatal by RetryPolicy, so supervisors never burn their
+    restart budget rejoining with a config that cannot work)."""
+    if num_processes is not None and num_processes < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+    if num_processes is not None and num_processes > 1:
+        if coordinator_address is None:
+            raise ValueError(
+                f"num_processes={num_processes} needs a "
+                "coordinator_address (host:port)"
+            )
+        if process_id is None:
+            raise ValueError(
+                f"num_processes={num_processes} needs an explicit "
+                "process_id (this host's rank)"
+            )
+    if process_id is not None:
+        if process_id < 0:
+            raise ValueError(f"process_id must be >= 0, got {process_id}")
+        if num_processes is not None and process_id >= num_processes:
+            raise ValueError(
+                f"process_id {process_id} out of range for "
+                f"num_processes {num_processes} (ranks are "
+                f"0..{num_processes - 1})"
+            )
+    if coordinator_address is not None:
+        host, sep, port = coordinator_address.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                "coordinator_address must be 'host:port', got "
+                f"{coordinator_address!r}"
+            )
+        try:
+            port_n = int(port)
+        except ValueError:
+            raise ValueError(
+                f"coordinator_address port {port!r} is not an integer"
+            ) from None
+        if not 1 <= port_n <= 65535:
+            raise ValueError(
+                f"coordinator_address port {port_n} out of range 1..65535"
+            )
+
+
+def detect_multihost(env: Optional[Dict[str, str]] = None) -> Optional[dict]:
+    """Read the elastic supervisor's ``JG_MH_*`` rank environment.
+
+    Returns ``{"rank", "hosts", "port", "store"}`` when this process
+    was launched as a rank of a multihost world, else ``None``. Raises
+    ``ValueError`` on a half-set or inconsistent environment — a rank
+    that silently ran single-host would corrupt the shared checkpoint
+    generations it shares with its peers.
+    """
+    env = os.environ if env is None else env
+    rank_s = env.get(ENV_RANK)
+    hosts_s = env.get(ENV_HOSTS)
+    if rank_s is None and hosts_s is None:
+        return None
+    if rank_s is None or hosts_s is None:
+        raise ValueError(
+            f"half-set multihost env: {ENV_RANK}={rank_s!r} "
+            f"{ENV_HOSTS}={hosts_s!r} (supervisor must export both)"
+        )
+    try:
+        rank, hosts = int(rank_s), int(hosts_s)
+    except ValueError:
+        raise ValueError(
+            f"non-integer multihost env: {ENV_RANK}={rank_s!r} "
+            f"{ENV_HOSTS}={hosts_s!r}"
+        ) from None
+    if hosts < 1 or not 0 <= rank < hosts:
+        raise ValueError(
+            f"multihost env rank {rank} out of range for {hosts} host(s)"
+        )
+    port_s = env.get(ENV_PORT)
+    info = {
+        "rank": rank,
+        "hosts": hosts,
+        "port": int(port_s) if port_s is not None else None,
+        "store": env.get(ENV_STORE),
+    }
+    if hosts > 1 and info["port"] is None:
+        raise ValueError(
+            f"{ENV_HOSTS}={hosts} needs {ENV_PORT} (rank-0 conductor "
+            "port for the host collective)"
+        )
+    return info
 
 
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    *,
+    initialization_timeout_s: float = 60.0,
+    retries: int = 3,
+    policy: Any = None,
+    telemetry: Any = None,
+    sleep: Callable[[float], None] = time.sleep,
+    _initialize: Optional[Callable[..., None]] = None,
 ) -> dict:
     """Connect this process to a multi-host JAX cluster.
 
@@ -30,23 +236,113 @@ def initialize_multihost(
     master address) but via jax.distributed: pass
     coordinator_address="host:port", num_processes=n_hosts,
     process_id=this_host_rank. With no arguments, auto-detects from the
-    cluster environment (TPU pod metadata / SLURM) or stays single-process.
+    cluster environment (TPU pod metadata / SLURM) or stays
+    single-process.
+
+    Hardened per the module docstring: fail-fast config validation,
+    per-attempt ``initialization_timeout_s``, classified failures
+    (:class:`MultihostInitError` with ``kind``), jittered-backoff
+    retries for the retryable kinds only. ``_initialize`` injects the
+    underlying initialize for tests (defaults to
+    ``jax.distributed.initialize``); ``policy`` injects the backoff
+    shape (defaults to a seeded-from-rank RetryPolicy so a restarting
+    fleet decorrelates); ``sleep`` injects the clock.
 
     Returns a summary dict {process_id, num_processes, local_devices,
     global_devices} for logging.
     """
+    check_multihost_config(coordinator_address, num_processes, process_id)
+    attempts = 0
     if coordinator_address is not None or num_processes not in (None, 1):
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
+        if policy is None:
+            from ..resilience.policy import RetryPolicy
+
+            # seed from the rank: every host restarts at once after a
+            # coordinator bounce, identical jitter re-herds them
+            policy = RetryPolicy(
+                max_restarts=retries,
+                base_backoff_s=0.5,
+                max_backoff_s=15.0,
+                seed=process_id,
+            )
+        init = (
+            _initialize if _initialize is not None
+            else jax.distributed.initialize
         )
+        last_kind = UNKNOWN
+        last_exc: Optional[BaseException] = None
+        while True:
+            attempts += 1
+            try:
+                init(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    initialization_timeout=int(initialization_timeout_s),
+                )
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                last_exc, last_kind = e, classify_init_error(e)
+                desc = (
+                    f"jax.distributed.initialize attempt {attempts} "
+                    f"failed [{last_kind}] (coordinator "
+                    f"{coordinator_address}, rank {process_id}/"
+                    f"{num_processes}): {type(e).__name__}: {e}"
+                )
+                if last_kind == RANK_COLLISION:
+                    # rejoining with the same rank hits the same
+                    # collision; the supervisor must resolve ranks
+                    _emit_init_event(
+                        telemetry, ok=False, kind=last_kind,
+                        attempts=attempts, coordinator=coordinator_address,
+                        process_id=process_id, num_processes=num_processes,
+                    )
+                    raise MultihostInitError(
+                        desc, kind=last_kind, attempts=attempts
+                    ) from e
+                if attempts > retries:
+                    _emit_init_event(
+                        telemetry, ok=False, kind=last_kind,
+                        attempts=attempts, coordinator=coordinator_address,
+                        process_id=process_id, num_processes=num_processes,
+                    )
+                    raise MultihostInitError(
+                        f"{desc} — budget of {retries} retr(ies) spent",
+                        kind=last_kind, attempts=attempts,
+                    ) from e
+                delay = policy.backoff(attempts)
+                log.warning("%s; retrying in %.2fs", desc, delay)
+                sleep(delay)
     info = {
         "process_id": jax.process_index(),
         "num_processes": jax.process_count(),
         "local_devices": jax.local_device_count(),
         "global_devices": jax.device_count(),
     }
+    _emit_init_event(
+        telemetry, ok=True, kind="ok", attempts=max(attempts, 1),
+        coordinator=coordinator_address, process_id=process_id,
+        num_processes=num_processes,
+    )
     if jax.process_index() == 0:
         log.info("distributed runtime: %s", info)
     return info
+
+
+def _emit_init_event(
+    telemetry: Any, *, ok: bool, kind: str, attempts: int,
+    coordinator: Optional[str], process_id: Optional[int],
+    num_processes: Optional[int],
+) -> None:
+    if telemetry is None:
+        return
+    try:
+        telemetry.emit(
+            "multihost_init", ok=ok, init_kind=kind, attempts=attempts,
+            coordinator=coordinator, process_id=process_id,
+            num_processes=num_processes,
+        )
+    except Exception:  # telemetry must never mask the init outcome
+        log.exception("multihost_init event emit failed")

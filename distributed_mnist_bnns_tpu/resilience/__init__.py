@@ -28,6 +28,7 @@ RESILIENCE.md for the fault catalog, spec grammar and event schema.
 """
 
 from .chaos import (
+    HOST_KINDS,
     MEMBERSHIP_KINDS,
     ChaosController,
     ChaosFault,
@@ -39,6 +40,11 @@ from .chaos import (
     reset_fire_counts,
 )
 from .elastic import MembershipView, run_elastic
+from .multihost import (
+    HostMembershipView,
+    read_membership,
+    run_elastic_multihost,
+)
 from .policy import (
     DEFAULT_FATAL_TYPES,
     CircuitBreaker,
@@ -51,6 +57,7 @@ from .policy import (
 from .preempt import PREEMPT_EXIT_CODE, Preempted, StopRequest
 
 __all__ = [
+    "HOST_KINDS",
     "MEMBERSHIP_KINDS",
     "ChaosController",
     "ChaosFault",
@@ -60,6 +67,7 @@ __all__ = [
     "CircuitBreaker",
     "DEFAULT_FATAL_TYPES",
     "FaultRule",
+    "HostMembershipView",
     "MembershipView",
     "PREEMPT_EXIT_CODE",
     "Preempted",
@@ -68,8 +76,10 @@ __all__ = [
     "TrainingFailure",
     "classify_failure",
     "parse_chaos_spec",
+    "read_membership",
     "reset_fire_counts",
     "run_elastic",
+    "run_elastic_multihost",
     "run_with_policy",
     "trainer_topology",
 ]

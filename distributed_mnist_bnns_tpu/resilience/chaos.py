@@ -15,7 +15,8 @@ Spec grammar (``TrainConfig.chaos`` / ``--chaos`` / ``JG_CHAOS`` env)::
               | ckpt_corrupt | ckpt_truncate
               | infer_slow | infer_error
               | worker_lost | worker_restore
-    key      := step | epoch | p | times | delay_s | world
+              | host_lost | host_restore
+    key      := step | epoch | p | times | delay_s | world | hosts
 
 ``step``/``epoch`` trigger a rule the first time the run reaches that
 global optimizer step / epoch (``>=`` semantics, so scan-chunked
@@ -54,6 +55,21 @@ Fault points:
   worker_restore the lost workers came back: membership change back to
                  ``world=N`` (default: the launch world) — the
                  supervisor regrows the mesh and re-splits state
+  host_lost      REAL host loss in the multi-host elastic runtime
+                 (``hosts=N`` — the post-loss host count — is
+                 mandatory): every rank process whose rank is >= N
+                 SIGKILLs itself at the step boundary; the survivors
+                 detect the dead world through the host collective
+                 (parallel/hostcomm EOF/timeout), vacate via the
+                 preempt path WITHOUT saving, and the multihost
+                 supervisor (resilience/multihost) relaunches the
+                 world at the shrunken count. Requires the multihost
+                 runtime; a trainer without a host channel rejects the
+                 spec at init.
+  host_restore   the lost hosts came back: requests a regrow to
+                 ``hosts=N`` (default: the launch count) — every rank
+                 saves and vacates gracefully (exit 75) and the
+                 supervisor relaunches at the restored count
 
 Serving rules trigger on ``step`` = the serving engine's micro-batch
 sequence number (or ``p``), so one spec composes training and serving
@@ -98,6 +114,7 @@ FAULT_KINDS = frozenset({
     "ckpt_corrupt", "ckpt_truncate",
     "infer_slow", "infer_error",
     "worker_lost", "worker_restore",
+    "host_lost", "host_restore",
 })
 
 # Which kinds each fault point dispatches — a rule only evaluates its
@@ -111,6 +128,11 @@ _INFER_KINDS = frozenset({"infer_slow", "infer_error"})
 # but are dispatched to the elastic supervisor's hook, not the trainer —
 # exported so the Trainer can reject them loudly without --elastic.
 MEMBERSHIP_KINDS = frozenset({"worker_lost", "worker_restore"})
+# Host-level membership kinds (the multi-host elastic runtime): fire at
+# the trainer step boundary and dispatch to the multihost hook — which
+# may SIGKILL THIS PROCESS (host_lost on a doomed rank). Exported so the
+# Trainer can reject them loudly outside the multihost runtime.
+HOST_KINDS = frozenset({"host_lost", "host_restore"})
 
 FAULTS_TOTAL = "faults_injected_total"
 
@@ -152,6 +174,7 @@ class FaultRule:
     times: int = 1
     delay_s: float = 1.0
     world: Optional[int] = None  # membership kinds: post-change world
+    hosts: Optional[int] = None  # host kinds: post-change host count
     key: str = ""
 
 
@@ -172,7 +195,7 @@ def parse_chaos_spec(spec: str) -> List[FaultRule]:
             )
         rule = FaultRule(kind=kind, key=f"{raw}#{i}")
         casts = {"step": int, "epoch": int, "p": float, "times": int,
-                 "delay_s": float, "world": int}
+                 "delay_s": float, "world": int, "hosts": int}
         for arg in (a.strip() for a in argstr.split(",")):
             if not arg:
                 continue
@@ -182,7 +205,7 @@ def parse_chaos_spec(spec: str) -> List[FaultRule]:
             if k not in casts:
                 raise ValueError(
                     f"unknown chaos key {k!r} in {raw!r} "
-                    "(have: step, epoch, p, times, delay_s, world)"
+                    "(have: step, epoch, p, times, delay_s, world, hosts)"
                 )
             try:
                 setattr(rule, k, casts[k](v))
@@ -208,6 +231,21 @@ def parse_chaos_spec(spec: str) -> List[FaultRule]:
             raise ValueError(
                 f"chaos entry {raw!r}: world must be >= 1, "
                 f"got {rule.world}"
+            )
+        if rule.hosts is not None and kind not in HOST_KINDS:
+            raise ValueError(
+                f"chaos key 'hosts' in {raw!r} only applies to "
+                "host_lost/host_restore"
+            )
+        if kind == "host_lost" and (rule.hosts is None or rule.hosts < 1):
+            raise ValueError(
+                f"chaos entry {raw!r} needs hosts=N >= 1 (the post-loss "
+                "host count)"
+            )
+        if rule.hosts is not None and rule.hosts < 1:
+            raise ValueError(
+                f"chaos entry {raw!r}: hosts must be >= 1, "
+                f"got {rule.hosts}"
             )
         rules.append(rule)
     return rules
@@ -247,6 +285,11 @@ class ChaosController:
         # supervisor a fired membership rule raises — silently dropping
         # a scripted worker loss would make the chaos test vacuous.
         self.on_membership: Optional[Callable[..., None]] = None
+        # Wired by the multihost trainer: called as
+        # on_host_membership(event, hosts=, step=, epoch=) with event
+        # "lost"|"restored". The "lost" handler SIGKILLs the process
+        # when its own rank is doomed — control may never return.
+        self.on_host_membership: Optional[Callable[..., None]] = None
         self._rngs = {
             r.key: random.Random(f"{seed}:{r.key}") for r in rules
         }
@@ -398,11 +441,30 @@ class ChaosController:
             if (
                 rule.kind not in _STEP_KINDS
                 and rule.kind not in MEMBERSHIP_KINDS
+                and rule.kind not in HOST_KINDS
             ):
                 continue
             if not self._should_fire(rule, step, epoch):
                 continue
-            if rule.kind in MEMBERSHIP_KINDS:
+            if rule.kind in HOST_KINDS:
+                if self.on_host_membership is None:
+                    raise ValueError(
+                        f"chaos {rule.kind} fired with no multihost "
+                        "runtime attached — host faults need the "
+                        "multihost elastic loop (resilience.multihost."
+                        "run_elastic_multihost with JG_MH_* ranks)"
+                    )
+                self._record(
+                    rule, "step", step, epoch,
+                    f"hosts={rule.hosts}" if rule.hosts is not None
+                    else "hosts=launch",
+                )
+                # May SIGKILL this process (host_lost on a doomed rank).
+                self.on_host_membership(
+                    "lost" if rule.kind == "host_lost" else "restored",
+                    hosts=rule.hosts, step=step, epoch=epoch,
+                )
+            elif rule.kind in MEMBERSHIP_KINDS:
                 if self.on_membership is None:
                     raise ValueError(
                         f"chaos {rule.kind} fired with no elastic "

@@ -69,6 +69,13 @@ def trainer_topology(trainer: Any) -> Tuple[int, dict]:
     indistinguishable from a plain resume in the event log."""
     from ..parallel.remesh import mesh_topology  # lazy: import cycle
 
+    mh = getattr(trainer, "_mh", None)
+    if mh:
+        # Multihost elastic rank: the world is the host count (each rank
+        # is a single-process jax runtime with no in-process mesh) —
+        # checkpoint meta must record it so post-incident forensics see
+        # the shrink/regrow, exactly like an in-process mesh change.
+        return int(mh["hosts"]), {"host": int(mh["hosts"])}
     return mesh_topology(getattr(trainer, "mesh", None))
 
 

@@ -16,6 +16,11 @@ must live HERE, not in any single replica):
   rollout.py     rolling deploys: artifact shipped over utils/transfer
                  (digest-verified), canary reload with health + error-
                  rate gates, automatic fleet-wide rollback on a trip
+  remote.py      replicas on another machine: a JSON-line HostAgent on
+                 the replica host (spawn/poll/signal + artifact staging
+                 by digest over utils/transfer) and a RemoteLauncher
+                 whose Popen-shaped handles plug into the same
+                 supervisor — probes/breakers/respawn unchanged
   server.py      the `cli fleet` HTTP front end + SIGTERM drain
   harness.py     importable 3-replica availability-under-chaos probe
                  (the perf gate's fleet_availability_under_chaos band)
@@ -27,6 +32,7 @@ rollout event schema, and tests/test_fleet.py + scripts/fleet_smoke.py
 for the acceptance scenarios.
 """
 
+from .remote import HostAgent, RemoteLauncher, RemoteProcess
 from .router import (
     HttpTransport,
     Replica,
@@ -46,7 +52,10 @@ __all__ = [
     "FleetConfig",
     "FleetServer",
     "FleetView",
+    "HostAgent",
     "HttpTransport",
+    "RemoteLauncher",
+    "RemoteProcess",
     "Replica",
     "ReplicaSupervisor",
     "RolloutManager",

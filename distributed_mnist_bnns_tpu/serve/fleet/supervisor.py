@@ -233,10 +233,16 @@ class ReplicaSupervisor:
         autoscaler: Optional[Autoscaler] = None,
         respawn_policy: Optional[RetryPolicy] = None,
         env: Optional[Dict[str, str]] = None,
+        launcher: Any = None,
     ):
         self.router = router
         self.spawn_command = spawn_command
         self.artifact = artifact       # respawns/rollouts read this live
+        #: Optional :class:`~.remote.RemoteLauncher`-shaped placer
+        #: (``free_port``/``launch``/``ensure_artifact``/``host``): the
+        #: fleet's replicas run on ITS host, artifacts shipped by
+        #: digest over utils/transfer. None = local subprocesses.
+        self.launcher = launcher
         self.view = view
         self.telemetry = telemetry
         self.host = host
@@ -293,16 +299,31 @@ class ReplicaSupervisor:
 
     def spawn_replica(self) -> ReplicaMember:
         """Launch one replica process; it joins the router only after
-        its /healthz boot gate passes (``tick``)."""
+        its /healthz boot gate passes (``tick``). With a ``launcher``
+        the process runs on the launcher's host — the spawn command is
+        built against the remotely staged artifact (shipped by digest,
+        zero-copy on respawn), and everything downstream (boot gate,
+        probes, breakers, reap/retire signals) drives the returned
+        Popen-shaped handle exactly as it would a local child."""
         with self._lock:
             self._spawn_seq += 1
             seq = self._spawn_seq
             rid = f"replica-{seq}"
-        port = free_port(self.host)
-        cmd = self.spawn_command(rid, port, self.artifact)
-        proc = subprocess.Popen(cmd, env=self.env)
+        if self.launcher is not None:
+            host = self.launcher.host
+            port = self.launcher.free_port()
+            artifact = self.launcher.ensure_artifact(self.artifact)
+            proc = self.launcher.launch(
+                self.spawn_command(rid, port, artifact),
+                env=self.env,
+            )
+        else:
+            host = self.host
+            port = free_port(self.host)
+            cmd = self.spawn_command(rid, port, self.artifact)
+            proc = subprocess.Popen(cmd, env=self.env)
         member = ReplicaMember(
-            rid, seq, proc, port, f"http://{self.host}:{port}",
+            rid, seq, proc, port, f"http://{host}:{port}",
             boot_deadline=time.monotonic() + self.boot_timeout_s,
         )
         with self._lock:

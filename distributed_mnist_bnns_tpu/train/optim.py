@@ -108,6 +108,7 @@ def sign_compress(
     mode: str,
     world: int = 1,
     axis_name: Optional[str] = None,
+    local_axis_name: Optional[str] = None,
     bucket_size: int = 1024,
     chunks: int = 4,
 ) -> optax.GradientTransformation:
@@ -128,6 +129,15 @@ def sign_compress(
     the leading axis sliced to 1); ``init`` always runs outside, on the
     global params. ``world=1`` needs no mesh and is the NumPy-oracle
     test configuration.
+
+    Hierarchical form: ``local_axis_name`` names the intra-host mesh
+    axis (ops/comm_compress.hier_exchange). The incoming gradients are
+    fp32-pmean'd over it FIRST — the in-host ring reduce on the fast
+    interconnect — and the 1-bit exchange then runs over ``axis_name``
+    (the slow inter-host link) only, with ``world`` = the number of
+    HOSTS. Every device on a host carries the identical post-pmean
+    gradient, so the per-host EF rows are replicated over the local
+    axis and the collective schedule stays device-independent.
     """
     if mode not in ("sign", "sign_ef"):
         raise ValueError(
@@ -135,6 +145,11 @@ def sign_compress(
         )
     if axis_name is None and world != 1:
         raise ValueError("world > 1 requires an axis_name to exchange over")
+    if local_axis_name is not None and axis_name is None:
+        raise ValueError(
+            "local_axis_name (hierarchical exchange) requires axis_name "
+            "for the inter-host phase"
+        )
 
     def _plan(n: int) -> CommPlan:
         return make_plan(
@@ -156,6 +171,12 @@ def sign_compress(
         flat, unravel = jax.flatten_util.ravel_pytree(updates)
         plan = _plan(flat.size)
         flat = pad_flat(flat.astype(jnp.float32), plan)
+        if local_axis_name is not None:
+            # Intra-host fp32 ring reduce (the hierarchical fast-link
+            # phase): after this every device on the host carries the
+            # host-mean gradient and the 1-bit exchange below runs over
+            # the inter-host axis only.
+            flat = jax.lax.pmean(flat, local_axis_name)
         if mode == "sign_ef":
             corrected = flat + state.ef_residual[0]
             e2 = state.ef_residual2[0]

@@ -29,7 +29,10 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import json
 import logging
+import os
+import signal
 import time
 import weakref
 from dataclasses import dataclass, field
@@ -45,6 +48,7 @@ from ..data import batch_iterator, native_batch_iterator, prefetch_to_device
 from ..models import get_model, latent_clamp_mask
 from ..ops.losses import cross_entropy_loss
 from ..resilience import (
+    HOST_KINDS,
     MEMBERSHIP_KINDS,
     ChaosController,
     Preempted,
@@ -62,6 +66,7 @@ from ..utils.checkpoint import (
     save_checkpoint,
     shape_mismatches,
 )
+from ..utils.logging_utils import is_primary_host
 from ..utils.meters import AverageMeter
 from ..utils.results import ResultsLog
 from .optim import RegimeSchedule, make_optimizer, regime_hp_kwargs
@@ -72,6 +77,13 @@ log = logging.getLogger(__name__)
 # annotation (contextlib.nullcontext is reentrant and stateless, so one
 # instance serves every step without a per-step allocation).
 _NULL_CTX = contextlib.nullcontext()
+
+# Host-collective schedule tags for the out-of-step collectives
+# (parallel/hostcomm cross-checks tags per collective, so these only
+# need to be issued in the same order on every rank; the values are
+# just forensics for divergence messages).
+_MH_SYNC_TAG = 0x5EF0   # checkpoint-boundary EF-row allgather
+_MH_STOP_TAG = 0x570B   # epoch-boundary stop agreement
 
 
 class TrainState(struct.PyTreeNode):
@@ -650,6 +662,16 @@ class TrainConfig:
                                    # JG_AOT env var.
     aot_dir: Optional[str] = None  # store root (default JG_AOT_STORE
                                    # or <repo>/.jax_aot)
+    dp_hosts: Optional[int] = None  # >1: two-level hierarchical
+                                   # compressed exchange (PERF.md
+                                   # "Hierarchical comms"): the DP
+                                   # world factors into (hosts x
+                                   # local); gradients fp32-ring-
+                                   # reduce within a host's 'local'
+                                   # mesh axis and 1-bit exchange
+                                   # over the inter-host axis only.
+                                   # Requires grad_compress != none
+                                   # and dp_mode='gspmd'.
 
 
 def _prefetch_chunks(items, size: int = 2):
@@ -813,6 +835,20 @@ class Trainer:
                     "fault would fire into nothing (RESILIENCE.md "
                     "'Elastic membership')"
                 )
+        host_rules = [
+            r.kind for r in self.chaos.rules if r.kind in HOST_KINDS
+        ]
+        if host_rules and self.host_channel is None:
+            raise ValueError(
+                f"chaos {host_rules[0]!r} requires the multihost "
+                "elastic runtime (JG_MH_* env via resilience."
+                "multihost.run_elastic_multihost): host faults "
+                "SIGKILL/regrow real rank processes — without it the "
+                "fault would fire into nothing (RESILIENCE.md "
+                "'Multi-host elastic membership')"
+            )
+        if self.host_channel is not None:
+            self.chaos.on_host_membership = self._on_host_membership
         self._profiled = False  # trace the first epoch this trainer runs
         # Step-windowed on-demand capture (obs/profile; --profile-steps
         # A:B over cumulative optimizer steps). The window supersedes
@@ -867,8 +903,30 @@ class Trainer:
         is built — the compression lives inside ``tx``."""
         cfg = self.config
         self.comm_plan = None
+        self.hier_plan = None
         self._compress_axis = None
+        self._local_axis = None
+        self._mh = None                # supervisor-assigned {rank, hosts, ...}
+        self.host_channel = None       # parallel/hostcomm TCP collective
+        self._host_bytes_seen = 0      # last-seen channel byte counter
+        from ..parallel.distributed import detect_multihost
+
+        mh = detect_multihost()
         if cfg.grad_compress == "none":
+            if mh is not None:
+                raise ValueError(
+                    "multihost elastic runtime (JG_MH_* env) requires "
+                    "grad_compress='sign' or 'sign_ef': the host-side "
+                    "compressed exchange IS the inter-host transport "
+                    "(RESILIENCE.md 'Multi-host elastic membership')"
+                )
+            if cfg.dp_hosts:
+                raise ValueError(
+                    "dp_hosts (hierarchical exchange) requires "
+                    "grad_compress='sign' or 'sign_ef': the two-level "
+                    "topology exists to put the 1-bit phase on the "
+                    "inter-host link (PERF.md 'Hierarchical comms')"
+                )
             return
         if cfg.grad_compress not in ("sign", "sign_ef"):
             raise ValueError(
@@ -895,6 +953,9 @@ class Trainer:
             )
         from ..ops.comm_compress import make_plan, tree_size
 
+        if mh is not None:
+            self._setup_multihost(mh, params)
+            return
         dp = cfg.data_parallel
         world = (
             jax.device_count() if dp == "auto" else int(dp) if dp else 1
@@ -912,6 +973,45 @@ class Trainer:
                 cfg.grad_compress,
             )
         self._compress_axis = "data" if world > 1 else None
+        if cfg.dp_hosts:
+            # Two-level hierarchical layout: the DP world factors into
+            # (hosts x local); the compressed plan covers the HOST axis
+            # only (the fp32 local phase is accounted by the HierPlan).
+            from ..ops.comm_compress import make_hier_plan
+
+            hosts = int(cfg.dp_hosts)
+            if cfg.dp_mode != "gspmd":
+                raise ValueError(
+                    "dp_hosts composes with dp_mode='gspmd' only: the "
+                    "hierarchical exchange keeps the optimizer "
+                    "replicated (per-host EF rows sharded over the "
+                    "host axis)"
+                )
+            if hosts < 1 or world % hosts:
+                raise ValueError(
+                    f"dp_hosts={hosts} must divide the DP world "
+                    f"({world} devices)"
+                )
+            self.hier_plan = make_hier_plan(
+                tree_size(params),
+                hosts=hosts,
+                local=world // hosts,
+                mode=cfg.grad_compress,
+                bucket_size=cfg.compress_bucket_size,
+                chunks=cfg.compress_chunks,
+            )
+            self.comm_plan = self.hier_plan.inter
+            self._compress_axis = "data" if hosts > 1 else None
+            self._local_axis = (
+                "local" if world // hosts > 1 else None
+            )
+            if self._local_axis is not None and self._compress_axis is None:
+                raise ValueError(
+                    "dp_hosts=1 with data_parallel>1 has no inter-host "
+                    "axis to compress over — drop dp_hosts for the "
+                    "flat exchange"
+                )
+            return
         self.comm_plan = make_plan(
             tree_size(params),
             world=world,
@@ -920,6 +1020,56 @@ class Trainer:
             chunks=cfg.compress_chunks,
             layout="fsdp" if cfg.dp_mode == "fsdp" else "dp",
         )
+
+    def _setup_multihost(self, mh: Dict[str, Any], params: Any) -> None:
+        """Join the multi-host elastic world (RESILIENCE.md 'Multi-host
+        elastic membership'): this process is ONE host of ``mh['hosts']``,
+        running its own single-process jax runtime — the inter-host
+        exchange is the host-side TCP collective (parallel/hostcomm), so
+        there is no in-process mesh and no XLA axis to compress over.
+        ``start()`` blocks until the full world has formed (or fails
+        loudly within the channel timeout — the supervisor classifies
+        the exit)."""
+        cfg = self.config
+        if cfg.data_parallel not in (None, 1) or cfg.dp_hosts not in (
+            None, 1
+        ):
+            raise ValueError(
+                "multihost elastic runtime (JG_MH_* env) does not "
+                "compose with in-process data_parallel/dp_hosts: each "
+                "rank is one host of the world, the exchange runs over "
+                "the host collective (parallel/hostcomm)"
+            )
+        if cfg.dp_mode == "fsdp":
+            raise ValueError(
+                "multihost elastic runtime composes with "
+                "dp_mode='gspmd' only: the host exchange keeps the "
+                "optimizer replicated (per-host EF rows)"
+            )
+        if mh["hosts"] > 1 and not cfg.elastic:
+            raise ValueError(
+                "multihost runtime with JG_MH_HOSTS>1 requires "
+                "elastic=True (--elastic): host loss vacates via the "
+                "preempt path and the supervisor re-places state "
+                "through checkpoint generations (RESILIENCE.md "
+                "'Multi-host elastic membership')"
+            )
+        from ..ops.comm_compress import make_plan, tree_size
+        from ..parallel.hostcomm import HostChannel
+
+        self.comm_plan = make_plan(
+            tree_size(params),
+            world=mh["hosts"],
+            mode=cfg.grad_compress,
+            bucket_size=cfg.compress_bucket_size,
+            chunks=cfg.compress_chunks,
+        )
+        self._mh = dict(mh)
+        self.host_channel = HostChannel(
+            mh["rank"], mh["hosts"], int(mh["port"] or 0),
+            timeout_s=float(os.environ.get("JG_MH_TIMEOUT", "60")),
+        )
+        self.host_channel.start()
 
     def _build_tx(self, name: str, learning_rate: float, **kwargs: Any):
         """make_optimizer with this run's gradient pre-transform chained
@@ -940,7 +1090,22 @@ class Trainer:
         if self.config.grad_compress != "none":
             from .optim import sign_compress, sign_compress_fsdp
 
-            if self.config.dp_mode == "fsdp":
+            if self.host_channel is not None:
+                # Multihost elastic rank: the exchange rides the host
+                # collective, not an XLA axis (parallel/hostcomm). A
+                # regime optimizer switch rebuilds the transform with a
+                # fresh lockstep tag counter — deterministic rules fire
+                # at the same epoch on every rank, so the schedules
+                # stay aligned.
+                from ..parallel.hostcomm import host_sign_compress
+
+                grad_transform = host_sign_compress(
+                    mode=self.comm_plan.mode,
+                    channel=self.host_channel,
+                    bucket_size=self.comm_plan.bucket_size,
+                    chunks=self.comm_plan.chunks,
+                )
+            elif self.config.dp_mode == "fsdp":
                 if name.lower() in ("lars", "lamb"):
                     raise ValueError(
                         f"optimizer {name!r} does not compose with "
@@ -964,6 +1129,7 @@ class Trainer:
                     mode=self.comm_plan.mode,
                     world=self.comm_plan.world,
                     axis_name=self._compress_axis,
+                    local_axis_name=self._local_axis,
                     bucket_size=self.comm_plan.bucket_size,
                     chunks=self.comm_plan.chunks,
                 )
@@ -1052,6 +1218,16 @@ class Trainer:
             # the static plan the per-step comm_bytes_total counters
             # accumulate from (OBSERVABILITY.md).
             p = self.comm_plan
+            extra = {}
+            if self.hier_plan is not None:
+                h = self.hier_plan
+                extra = dict(
+                    hosts=h.hosts, local=h.local,
+                    intra_bytes_per_step=h.intra_bytes_per_step,
+                    inter_bytes_per_step=h.inter_bytes_per_step,
+                    flat_fp32_bytes_per_step=h.flat_fp32_bytes_per_step,
+                    inter_ratio_vs_flat_fp32=h.inter_ratio_vs_flat_fp32,
+                )
             self.telemetry.emit(
                 "comm_compress",
                 mode=p.mode, layout=p.layout, world=p.world,
@@ -1063,6 +1239,7 @@ class Trainer:
                 wire_bytes_ag=p.wire_bytes_ag,
                 fp32_bytes_per_step=p.fp32_bytes_per_step,
                 wire_ratio=p.wire_ratio,
+                **extra,
             )
 
     def _setup_sanitizer(self) -> None:
@@ -1323,11 +1500,45 @@ class Trainer:
             comm = reg.counter(
                 "comm_bytes_total",
                 "gradient-exchange bytes on the wire per worker "
-                "(labels: mode, phase=rs|ag)",
+                "(labels: mode, phase=rs|ag; hierarchical runs add "
+                "level=intra|inter)",
             )
-            comm.inc(p.wire_bytes_rs * n, mode=p.mode, phase="rs")
-            comm.inc(p.wire_bytes_ag * n, mode=p.mode, phase="ag")
-            if p.saved_bytes_per_step:
+            if self.hier_plan is not None:
+                # Two-level split: the intra-host fp32 ring is cheap
+                # fast-link traffic, the inter-host 1-bit phases are
+                # the slow-link bytes the hierarchy exists to minimize.
+                h = self.hier_plan
+                comm.inc(
+                    h.intra_bytes_per_step * n,
+                    mode="fp32", phase="ring", level="intra",
+                )
+                comm.inc(
+                    p.wire_bytes_rs * n,
+                    mode=p.mode, phase="rs", level="inter",
+                )
+                comm.inc(
+                    p.wire_bytes_ag * n,
+                    mode=p.mode, phase="ag", level="inter",
+                )
+            elif self.host_channel is not None:
+                # Multihost elastic rank: the channel counts the REAL
+                # framed TCP traffic (headers included) — record the
+                # delta since the last step instead of the analytic
+                # ring model; it is all inter-host by construction.
+                ch = self.host_channel
+                total = ch.bytes_sent + ch.bytes_received
+                delta = total - self._host_bytes_seen
+                self._host_bytes_seen = total
+                if delta > 0:
+                    comm.inc(
+                        delta, mode=p.mode, phase="xchg", level="inter",
+                    )
+            else:
+                # Flat exchange keeps the historical {mode, phase}
+                # label set (dashboards + the fsdp CI smoke pin it).
+                comm.inc(p.wire_bytes_rs * n, mode=p.mode, phase="rs")
+                comm.inc(p.wire_bytes_ag * n, mode=p.mode, phase="ag")
+            if p.saved_bytes_per_step and self.host_channel is None:
                 reg.counter(
                     "comm_saved_bytes_total",
                     "wire bytes saved vs the fp32 exchange",
@@ -1469,14 +1680,19 @@ class Trainer:
         from ..parallel import shard_batch
 
         mesh = self.mesh
+        # The hierarchical mesh splits the batch over BOTH axes
+        # (hosts x local); every other mesh path shards over 'data'.
+        axis = (
+            ("data", "local") if self.hier_plan is not None else "data"
+        )
         rng_global = _make_rng_replicator(mesh)
 
         def step(state, images, labels, rng):
             # Placement (host->device) happens OUTSIDE the transfer
             # guard: only the jitted dispatch itself must be
             # transfer-free.
-            xb = shard_batch(images, mesh)
-            yb = shard_batch(labels, mesh)
+            xb = shard_batch(images, mesh, axis)
+            yb = shard_batch(labels, mesh, axis)
             rg = rng_global(rng)
             with self.sanitizer.guard_transfers():
                 return base_step(state, xb, yb, rg)
@@ -1543,7 +1759,17 @@ class Trainer:
                 f"batch_size {self.config.batch_size} not divisible by "
                 f"data_parallel={n}"
             )
-        self.mesh = make_mesh(data=n)
+        if self.hier_plan is not None:
+            # Two-level mesh: 'data' = hosts (the slow inter-host axis
+            # the 1-bit exchange runs over), 'local' = devices per host
+            # (the fp32 ring). EF rows shard over 'data' as usual and
+            # replicate over 'local'.
+            self.mesh = make_mesh(
+                data=self.hier_plan.hosts, model=self.hier_plan.local,
+                axis_names=("data", "local"),
+            )
+        else:
+            self.mesh = make_mesh(data=n)
         if self.config.grad_compress != "none":
             # Both layouts (gspmd DP and fsdp) run the explicit
             # shard_map exchange; they differ in what lives inside tx
@@ -1551,7 +1777,9 @@ class Trainer:
             # placement shards (parallel/fsdp.compressed_state_specs).
             from ..parallel import place_compressed_state
 
-            if self.config.dp_mode == "fsdp":
+            if self.hier_plan is not None:
+                self._set_compressed_hier_step(loss_fn)
+            elif self.config.dp_mode == "fsdp":
                 self._set_compressed_fsdp_step(loss_fn)
             else:
                 self._set_compressed_dp_step(loss_fn)
@@ -1606,6 +1834,20 @@ class Trainer:
         from ..parallel import make_compressed_dp_train_step
 
         step = make_compressed_dp_train_step(
+            self.clamp_mask, self.mesh, self.state, loss_fn=loss_fn,
+            remat=self.config.remat, grad_accum=self.config.grad_accum,
+            augment=self.config.augment,
+        )
+        self.train_step = self._wrap_mesh_step(step)
+
+    def _set_compressed_hier_step(self, loss_fn) -> None:
+        """Two-level hierarchical compressed DP over the (data x local)
+        mesh: fp32 pmean inside a host, 1-bit exchange across hosts —
+        both inside ``state.tx`` (train/optim.sign_compress with
+        local_axis_name; PERF.md "Hierarchical comms")."""
+        from ..parallel import make_compressed_hier_train_step
+
+        step = make_compressed_hier_train_step(
             self.clamp_mask, self.mesh, self.state, loss_fn=loss_fn,
             remat=self.config.remat, grad_accum=self.config.grad_accum,
             augment=self.config.augment,
@@ -1738,10 +1980,13 @@ class Trainer:
             from ..parallel import (
                 make_compressed_dp_train_step,
                 make_compressed_fsdp_train_step,
+                make_compressed_hier_train_step,
             )
 
             builder = (
-                make_compressed_fsdp_train_step
+                make_compressed_hier_train_step
+                if self.hier_plan is not None
+                else make_compressed_fsdp_train_step
                 if self.config.dp_mode == "fsdp"
                 else make_compressed_dp_train_step
             )
@@ -1764,11 +2009,15 @@ class Trainer:
             from ..parallel import shard_batch
 
             mesh = self.mesh
+            axis = (
+                ("data", "local") if self.hier_plan is not None
+                else "data"
+            )
             rng_global = _make_rng_replicator(mesh)
 
             def wrapped(state, images, labels, rng):
-                xb = shard_batch(images, mesh, batch_dim=1)
-                yb = shard_batch(labels, mesh, batch_dim=1)
+                xb = shard_batch(images, mesh, axis, batch_dim=1)
+                yb = shard_batch(labels, mesh, axis, batch_dim=1)
                 rg = rng_global(rng)
                 with self.sanitizer.guard_transfers():
                     return scan(state, xb, yb, rg)
@@ -2163,6 +2412,11 @@ class Trainer:
         )
         try:
             for images, labels, n in items:
+                # Host-loss fence FIRST: a latched loss means the last
+                # dispatched step consumed a zero exchange — vacate
+                # before firing more chaos or dispatching on top of the
+                # tainted state (raises Preempted; no checkpoint).
+                self._check_host_lost(self._steps_done, epoch)
                 if self.chaos.active:
                     # Pre-dispatch fault point: may stall, raise a
                     # transient fault, or request preemption
@@ -2179,7 +2433,16 @@ class Trainer:
                 # others in the next collective — multi-process runs
                 # stop at the epoch boundary, where _stop_boundary
                 # reaches cross-host agreement first.
-                if self.stop.requested and jax.process_count() <= 1:
+                if (
+                    self.stop.requested and jax.process_count() <= 1
+                    and self.host_channel is None
+                ):
+                    # Multihost elastic ranks are each process_count()==1
+                    # yet must NOT stop unilaterally — a rank leaving
+                    # mid-epoch strands its peers in the next exchange.
+                    # They defer to the epoch boundary, where
+                    # _stop_boundary reaches agreement over the host
+                    # collective.
                     self._graceful_stop(epoch, batches_done=seen)
                 if self._profile_window is not None:
                     # --profile-steps A:B: open the capture before the
@@ -2273,6 +2536,11 @@ class Trainer:
                     # profile_capture event).
                     self._drive_profile_window(before_dispatch=False)
             jax.block_until_ready(self.state.params)
+            # block_until_ready drained every ordered io_callback, so
+            # the lost latch is now current: a loss on the epoch's final
+            # step must vacate HERE, before the fit loop checkpoints the
+            # tainted epoch.
+            self._check_host_lost(self._steps_done, epoch)
         finally:
             if profiling:  # epoch shorter than profile_steps, or a raise
                 jax.profiler.stop_trace()
@@ -2439,6 +2707,124 @@ class Trainer:
             else save_checkpoint
         )
 
+    def _on_host_membership(self, event: str, *, hosts=None,
+                            step=None, epoch=None) -> None:
+        """Chaos ``host_lost``/``host_restore`` dispatch (resilience/
+        chaos): the rules are seed-deterministic and every rank runs the
+        same spec, so this fires on EVERY rank at the same step
+        boundary.
+
+        ``lost``: ranks above the surviving count die by SIGKILL — a
+        real host death, no cleanup, no checkpoint, sockets closed by
+        the kernel. Survivors do nothing here: they discover the loss
+        through the next exchange's EOF and vacate WITHOUT saving
+        (``_check_host_lost``). ``restored``: rank 0 records the regrow
+        request in the shared store and every rank requests a graceful
+        stop, so the supervisor relaunches the full world from the
+        checkpoint the stop writes."""
+        mh = self._mh
+        if mh is None:
+            return
+        if event == "lost":
+            surviving = int(hosts) if hosts is not None else mh["hosts"]
+            if mh["rank"] >= surviving:
+                log.warning(
+                    "chaos host_lost: rank %d >= surviving hosts %d — "
+                    "SIGKILL (no cleanup, no checkpoint)",
+                    mh["rank"], surviving,
+                )
+                os.kill(os.getpid(), signal.SIGKILL)
+            return
+        if event == "restored":
+            store = mh.get("store") or self.config.checkpoint_dir
+            if store and mh["rank"] == 0:
+                os.makedirs(store, exist_ok=True)
+                req = os.path.join(store, "restore_request.json")
+                tmp = req + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(
+                        {"hosts": int(hosts) if hosts else None,
+                         "step": step, "epoch": epoch}, f,
+                    )
+                os.replace(tmp, req)  # atomic: the supervisor polls it
+            self.stop.request(
+                f"chaos host_restore (regrow to {hosts or 'full'} hosts)"
+            )
+
+    def _check_host_lost(self, step: int, epoch: int) -> None:
+        """Step-boundary host-loss fence. Once the channel latched
+        ``lost``, the in-flight exchange returned zeros and the step
+        that consumed them is garbage — the live state is TAINTED.
+        Vacate via Preempted WITHOUT saving: the last digest-verified
+        checkpoint generation is the resume point, so the supervisor's
+        relaunch at the surviving host count replays exactly the
+        trajectory a fresh resume would (bitwise — the acceptance
+        contract)."""
+        ch = self.host_channel
+        if ch is None or not ch.lost:
+            return
+        mh = self._mh or {}
+        reason = (ch.lost_reason or "peer failure")[:200]
+        self.telemetry.registry.counter(
+            "host_losses_total",
+            "host-collective losses observed by a surviving rank",
+        ).inc()
+        self.telemetry.emit(
+            "host_membership", event="lost", rank=mh.get("rank"),
+            hosts=mh.get("hosts"), lost_ranks=list(ch.lost_ranks),
+            reason=reason, step=int(step), epoch=int(epoch),
+        )
+        log.warning(
+            "host collective lost (%s): vacating WITHOUT checkpoint — "
+            "the supervisor resumes the shrunken world from the last "
+            "verified generation", reason,
+        )
+        raise Preempted(epoch, int(step), f"host lost: {reason}")
+
+    def _sync_host_ef_rows(self) -> bool:
+        """Checkpoint-boundary EF-row sync (parallel/hostcomm.
+        allgather_rows): each rank's compression state carries only its
+        OWN error-feedback row — the primary must hold the full
+        ``(hosts, ...)`` matrix before saving so a resume at ANY host
+        count can re-fold it (parallel/remesh). Runs on every rank (it
+        is a collective); returns False when the world is/became lost —
+        the caller must NOT save (incomplete rows + tainted state)."""
+        ch, mh = self.host_channel, self._mh
+        if ch is None or mh is None or mh["hosts"] <= 1:
+            return True
+        if ch.lost:
+            return False
+        from ..parallel.hostcomm import allgather_rows
+        from .optim import SignCompressState
+
+        jax.block_until_ready(self.state.opt_state)  # drain exchanges
+        rank = mh["rank"]
+
+        def sync(node):
+            if not isinstance(node, SignCompressState):
+                return node  # ordinary optimizer leaves pass through
+            ef = allgather_rows(
+                ch, np.asarray(jax.device_get(node.ef_residual[rank])),
+                tag=_MH_SYNC_TAG,
+            )
+            ef2 = allgather_rows(
+                ch, np.asarray(jax.device_get(node.ef_residual2[rank])),
+                tag=_MH_SYNC_TAG,
+            )
+            return SignCompressState(
+                ef_residual=jnp.asarray(ef), ef_residual2=jnp.asarray(ef2)
+            )
+
+        try:
+            new_opt = jax.tree_util.tree_map(
+                sync, self.state.opt_state,
+                is_leaf=lambda n: isinstance(n, SignCompressState),
+            )
+        except ConnectionError:
+            return False  # lost mid-sync: latched; caller skips the save
+        self.state = self.state.replace(opt_state=new_opt)
+        return True
+
     def _stop_boundary(self) -> bool:
         """Epoch-boundary stop decision. Single-process: the local
         flag. Multi-process: hosts must AGREE before anyone stops — a
@@ -2447,6 +2833,25 @@ class Trainer:
         Every host calls this once per epoch (the agreement is itself a
         collective, so the call sites must be unconditional), and any
         single host's pending request stops them all."""
+        if self.host_channel is not None and (
+            self._mh and self._mh["hosts"] > 1
+        ):
+            # Multihost elastic: the agreement rides the host collective
+            # (each rank is its own jax process, so process_count() is
+            # blind here). A transport failure means the world is dying:
+            # report "stop" and let the lost latch vacate without a save.
+            try:
+                flags = self.host_channel.allgather(
+                    b"\x01" if self.stop.requested else b"\x00",
+                    tag=_MH_STOP_TAG,
+                )
+            except ConnectionError:
+                return True
+            if any(f == b"\x01" for f in flags):
+                if not self.stop.requested:
+                    self.stop.request("preemption on a peer host")
+                return True
+            return False
         if jax.process_count() <= 1:
             return self.stop.requested
         from jax.experimental import multihost_utils  # pragma: no cover
@@ -2481,6 +2886,13 @@ class Trainer:
         # write_checkpoint=False means the fit loop already wrote the
         # per-epoch checkpoint this stop resumes from.
         saved = not write_checkpoint and bool(cfg.checkpoint_dir)
+        if write_checkpoint and cfg.checkpoint_dir and (
+            not self._sync_host_ef_rows()
+        ):
+            # Multihost world died under the stop: incomplete EF rows +
+            # tainted state must not reach the store — vacate without
+            # the mid-epoch save (last verified generation resumes).
+            self._check_host_lost(self._steps_done, epoch)
         if write_checkpoint and cfg.checkpoint_dir:
             world_size, mesh_shape = trainer_topology(self)
             extra = {
@@ -2768,6 +3180,10 @@ class Trainer:
                         self.telemetry.emit("eval", epoch=epoch, **eval_row)
                     history.append(row)
                     if self.config.checkpoint_dir:
+                        if not self._sync_host_ef_rows():
+                            # World lost during the EF-row collective:
+                            # the fence raises Preempted (no save).
+                            self._check_host_lost(self._steps_done, epoch)
                         acc = row.get("test_acc", 0.0)
                         is_best = acc > self.best_acc
                         self.best_acc = max(self.best_acc, acc)
@@ -2810,7 +3226,9 @@ class Trainer:
                             # --async-checkpoint opt-in, keep blocking
                             # semantics.
                             self._checkpointer.wait()
-                    if jax.process_index() == 0:
+                    if is_primary_host():
+                        # JG_MH_RANK-aware: multihost ranks all have
+                        # process_index()==0 but share one results file.
                         log.info(
                             "epoch %d done: %s", epoch,
                             {k: round(v, 4) for k, v in row.items()

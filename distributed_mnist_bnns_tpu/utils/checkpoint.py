@@ -42,6 +42,8 @@ import jax
 import numpy as np
 from flax import serialization
 
+from .logging_utils import is_primary_host
+
 log = logging.getLogger(__name__)
 
 LATEST = "checkpoint.msgpack"
@@ -137,7 +139,10 @@ def _write_checkpoint(
     """
     os.makedirs(path, exist_ok=True)
     target = os.path.join(path, LATEST)
-    if jax.process_index() == 0:
+    # Primary-host gate, not process_index()==0: multihost elastic ranks
+    # are separate single-process jax runtimes sharing one checkpoint
+    # store — only JG_MH_RANK 0 may write it (utils/logging_utils).
+    if is_primary_host():
         keep = (
             DEFAULT_KEEP_GENERATIONS if keep_generations is None
             else max(int(keep_generations), 1)

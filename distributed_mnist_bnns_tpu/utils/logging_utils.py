@@ -11,9 +11,20 @@ import os
 
 
 def is_primary_host() -> bool:
-    """True on the JAX process that should own logging/checkpoint writes.
+    """True on the process that should own logging/checkpoint writes.
 
-    Falls back to True when JAX isn't initialized (pure-host tooling)."""
+    Under the multihost elastic runtime every rank is its OWN jax
+    process (process_index()==0 everywhere — inter-host exchange is
+    host-side, parallel/hostcomm), so the supervisor-assigned JG_MH_RANK
+    decides primacy there; real jax.distributed runs fall through to
+    process_index(). Falls back to True when JAX isn't initialized
+    (pure-host tooling)."""
+    rank = os.environ.get("JG_MH_RANK")
+    if rank is not None:
+        try:
+            return int(rank) == 0
+        except ValueError:
+            pass  # malformed env: fall through to the jax view
     try:
         import jax
 

@@ -1,0 +1,195 @@
+"""Multi-host elastic smoke (CI): a real two-process world must survive
+a SIGKILLed host rank WITHOUT a full-job restart.
+
+Drives :func:`resilience.multihost.run_elastic_multihost` over the
+actual CLI — two OS processes on localhost, each a single-process jax
+runtime exchanging 1-bit sign_ef gradients over the parallel/hostcomm
+TCP collective — with a scripted ``host_lost@step=20,hosts=1`` chaos
+rule that makes rank 1 SIGKILL itself mid-epoch-1. Asserts that:
+
+  * the supervisor returns 0: rank 0 noticed the dead socket, vacated
+    exit-75 WITHOUT saving the tainted step, and the relaunch at ONE
+    host resumed from the newest digest-verified generation (the
+    (2, ...) per-host EF rows remesh-folded to world 1);
+  * ``membership.json`` records exactly one 2->1 ``lost`` transition
+    and the supervisor event log exactly one ``host_membership``
+    ``lost`` with ``budget_used=0`` — host loss is membership churn,
+    never a retry (RESILIENCE.md "Multi-host elastic membership");
+  * zero ``failed``/``preempted``/``timeout`` supervisor events — the
+    retry and preemption budgets are untouched;
+  * the run LEARNED across the shrink (final test accuracy beats the
+    bar — a relaunch that scrambled the folded EF rows would still
+    exit 0).
+
+Usage: python scripts/multihost_smoke.py [--dir DIR] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHAOS_SPEC = "host_lost@step=20,hosts=1"
+HOSTS = 2
+MIN_ACC = 50.0
+
+
+def _read_jsonl(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default=None,
+                        help="work dir (default: a fresh temp dir)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the work dir for inspection")
+    args = parser.parse_args(argv)
+
+    from distributed_mnist_bnns_tpu.obs.events import EventLog
+    from distributed_mnist_bnns_tpu.resilience import (
+        RetryPolicy,
+        run_elastic_multihost,
+    )
+    from distributed_mnist_bnns_tpu.resilience.multihost import (
+        read_membership,
+    )
+
+    work = args.dir or tempfile.mkdtemp(prefix="multihost_smoke_")
+    ckpt_dir = os.path.join(work, "ckpts")
+    tel_dir = os.path.join(work, "telemetry")
+    results = os.path.join(work, "results.csv")
+
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JG_MH_TIMEOUT": "60",
+    }
+    cmd = [
+        sys.executable, "-m", "distributed_mnist_bnns_tpu.cli", "train",
+        "--model", "bnn-mlp-small", "--epochs", "3", "--batch-size", "64",
+        "--grad-compress", "sign_ef", "--elastic", "--resume",
+        "--synthetic-sizes", "1024", "128", "--seed", "0",
+        "--chaos", CHAOS_SPEC,
+        "--checkpoint-dir", ckpt_dir, "--telemetry-dir", tel_dir,
+        "--results", results,
+    ]
+    print("multihost_smoke: supervising", " ".join(cmd), file=sys.stderr,
+          flush=True)
+
+    sup_events_path = os.path.join(work, "supervisor_events.jsonl")
+    events = EventLog(sup_events_path)
+    failures = []
+    try:
+        rc = run_elastic_multihost(
+            cmd, hosts=HOSTS, store=work, env=env, events=events,
+            policy=RetryPolicy(max_restarts=0, max_preemptions=0),
+            generation_timeout_s=420.0,
+        )
+    except Exception as e:  # budget exhausted / world extinct
+        rc = -1
+        failures.append(f"supervisor raised: {type(e).__name__}: {e}")
+    finally:
+        events.close()
+    if rc != 0:
+        failures.append(f"run_elastic_multihost returned {rc} (want 0)")
+
+    view = read_membership(work) or {}
+    lost = [h for h in view.get("history", []) if h.get("event") == "lost"]
+    if [(h.get("hosts_from"), h.get("hosts_to")) for h in lost] != [(2, 1)]:
+        failures.append(
+            f"want exactly one 2->1 lost transition in membership.json, "
+            f"got {lost}"
+        )
+    if view.get("hosts") != 1:
+        failures.append(
+            f"membership.json final world is {view.get('hosts')} (want 1)"
+        )
+
+    sup_events = []
+    try:
+        sup_events = _read_jsonl(sup_events_path)
+    except OSError as e:
+        failures.append(f"no supervisor event log: {e}")
+    sup_lost = [e for e in sup_events if e.get("event") == "lost"]
+    if len(sup_lost) != 1:
+        failures.append(
+            f"want exactly one host_membership lost event, got {sup_lost}"
+        )
+    elif sup_lost[0].get("budget_used") != 0:
+        failures.append(
+            "host loss consumed retry budget: "
+            f"budget_used={sup_lost[0].get('budget_used')} (want 0)"
+        )
+    budgeted = [e for e in sup_events
+                if e.get("event") in ("failed", "preempted", "timeout")]
+    if budgeted:
+        failures.append(
+            f"supervisor burned budget on membership churn: {budgeted}"
+        )
+    if [e.get("event") for e in sup_events if e.get("event") == "complete"] \
+            != ["complete"]:
+        failures.append("want exactly one complete event")
+
+    acc = None
+    try:
+        with open(results) as f:
+            rows = list(csv.DictReader(f))
+        acc = float(rows[-1]["test_acc"])
+        if acc <= MIN_ACC:
+            failures.append(
+                f"run did not learn across the host loss: test_acc={acc} "
+                f"(want > {MIN_ACC})"
+            )
+    except (OSError, IndexError, KeyError, ValueError) as e:
+        failures.append(f"could not read final accuracy from {results}: {e}")
+
+    # Rank 0's own event log: it must have SEEN the loss (emitted before
+    # vacating) and resumed remeshed at world 1 in the next generation.
+    trainer_events = []
+    try:
+        trainer_events = _read_jsonl(os.path.join(tel_dir, "events.jsonl"))
+    except OSError as e:
+        failures.append(f"no trainer event log: {e}")
+    tr_lost = [e for e in trainer_events
+               if e.get("kind") == "host_membership"
+               and e.get("event") == "lost"]
+    if len(tr_lost) != 1:
+        failures.append(
+            "rank 0 should emit exactly one host_membership lost before "
+            f"vacating, got {len(tr_lost)}"
+        )
+
+    summary = {
+        "exit_code": rc,
+        "test_acc": acc,
+        "membership": [(h.get("event"), h.get("hosts_from"),
+                        h.get("hosts_to")) for h in view.get("history", [])
+                       if h.get("event")],
+        "supervisor_events": [e.get("event") for e in sup_events],
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=2))
+    for f_ in failures:
+        print(f"FAIL: {f_}", file=sys.stderr)
+    if not args.keep and args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,21 @@ METRIC_PATHS = {
         "comm_fsdp.variants.sign_ef.compiles_post_warmup", "max"),
     "sign_ef_fsdp_scan4_post_warmup_compiles": (
         "comm_fsdp.variants.sign_ef_scan4.compiles_post_warmup", "max"),
+    # Two-level hierarchical wire model (multi-host elastic runtime;
+    # PERF.md "Hierarchical comms"): the DP world factored into
+    # (hosts x local) — fp32 ring within a host, 1-bit sign_ef across
+    # hosts only. Byte columns are pure functions of (model, hosts,
+    # local, bucket layout), gated EXACTLY like the flat wire bytes.
+    # The ratio band is the multi-host acceptance contract: inter-host
+    # bytes <= 1/8 of the flat fp32 ring at the same total world.
+    "hier_intra_wire_bytes_per_step": (
+        "comm_hier.hier.intra_bytes_per_step", "exact"),
+    "hier_inter_wire_bytes_per_step": (
+        "comm_hier.hier.inter_bytes_per_step", "exact"),
+    "hier_inter_wire_ratio_vs_flat_fp32": (
+        "comm_hier.hier.inter_ratio_vs_flat_fp32", "max"),
+    "hier_post_warmup_compiles": (
+        "comm_hier.hier.compiles_post_warmup", "max"),
     # Serving-latency ceiling (ROADMAP item 5 slice): classifier
     # request p99 at saturation through the real engine — the
     # serve/harness measurement, banded WIDE like the step times (a

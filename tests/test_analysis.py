@@ -497,6 +497,43 @@ def test_jg013_negative_symbolic_and_bound_axes():
     assert not active(run_source(src, "lib.py"), "JG013")
 
 
+def test_jg013_negative_two_axis_mesh_binds_both():
+    # The hierarchical exchange shape: a ('host', 'local') mesh where
+    # specs bind both axes — collectives over either name are fine.
+    src = (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def body(x):\n"
+        "    x = jax.lax.psum(x, 'local')\n"
+        "    return jax.lax.psum(x, 'host')\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh,\n"
+        "                     in_specs=(P('host', 'local'),),\n"
+        "                     out_specs=P('host', 'local'))\n"
+    )
+    assert not active(run_source(src, "lib.py"), "JG013")
+
+
+def test_jg013_flags_axis_missing_from_two_axis_spec():
+    # Only 'local' appears in the specs; the inter-host reduce over
+    # 'host' references an axis this shard_map never declared.
+    src = (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from distributed_mnist_bnns_tpu.parallel.compat import shard_map\n"
+        "def body(x):\n"
+        "    x = jax.lax.psum(x, 'local')\n"
+        "    return jax.lax.psum(x, 'host')\n"
+        "def build(mesh):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('local'),),\n"
+        "                     out_specs=P('local'))\n"
+    )
+    findings = active(run_source(src, "lib.py"), "JG013")
+    assert len(findings) == 1
+    assert "host" in findings[0].message
+
+
 def test_jg014_flags_differing_branch_sequences():
     src = (
         "import jax\n"

@@ -1,0 +1,649 @@
+"""Multi-host elastic runtime (RESILIENCE.md "Multi-host elastic
+membership"): the host collective's transport + loss latch, the
+hardened ``initialize_multihost`` bootstrap (classified failures,
+seeded backoff), the ``JG_MH_*`` rank-env contract, chaos grammar for
+``host_lost``/``host_restore``, the supervisor's exit-code
+classification (host loss is membership churn, budget-free), per-host
+EF-row fold/regrow against NumPy oracles, and the remote-replica
+launcher the fleet supervisor can place replicas through. The full
+kill-a-rank end-to-end run lives in scripts/multihost_smoke.py (CI
+``multihost-smoke``)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_mnist_bnns_tpu.parallel import distributed as mh_env
+from distributed_mnist_bnns_tpu.parallel.distributed import (
+    COORDINATOR_UNREACHABLE,
+    RANK_COLLISION,
+    TIMEOUT,
+    MultihostInitError,
+    check_multihost_config,
+    classify_init_error,
+    detect_multihost,
+    initialize_multihost,
+)
+from distributed_mnist_bnns_tpu.parallel.hostcomm import (
+    HostChannel,
+    HostLostError,
+    allgather_rows,
+)
+from distributed_mnist_bnns_tpu.resilience import (
+    HOST_KINDS,
+    TrainingFailure,
+    parse_chaos_spec,
+)
+from distributed_mnist_bnns_tpu.resilience import multihost as mh_sup
+from distributed_mnist_bnns_tpu.resilience.multihost import (
+    read_membership,
+    run_elastic_multihost,
+)
+from distributed_mnist_bnns_tpu.resilience.policy import RetryPolicy
+from distributed_mnist_bnns_tpu.utils.logging_utils import is_primary_host
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- env contract ------------------------------------------------------------
+
+
+def test_env_names_paired_with_supervisor():
+    """resilience/multihost duplicates the JG_MH_* literals (to stay
+    importable without the parallel package); they must never drift
+    from the detect_multihost source of truth."""
+    for name in ("ENV_RANK", "ENV_HOSTS", "ENV_PORT", "ENV_STORE"):
+        assert getattr(mh_sup, name) == getattr(mh_env, name), name
+
+
+def test_detect_multihost_reads_rank_env():
+    assert detect_multihost(env={}) is None
+    info = detect_multihost(env={
+        "JG_MH_RANK": "1", "JG_MH_HOSTS": "2", "JG_MH_PORT": "4321",
+        "JG_MH_STORE": "/tmp/store",
+    })
+    assert info == {
+        "rank": 1, "hosts": 2, "port": 4321, "store": "/tmp/store",
+    }
+    # a rank that silently ran single-host would corrupt the shared
+    # generations: half-set / inconsistent env is loud
+    with pytest.raises(ValueError, match="half-set"):
+        detect_multihost(env={"JG_MH_RANK": "0"})
+    with pytest.raises(ValueError, match="non-integer"):
+        detect_multihost(env={"JG_MH_RANK": "x", "JG_MH_HOSTS": "2"})
+    with pytest.raises(ValueError, match="out of range"):
+        detect_multihost(env={"JG_MH_RANK": "2", "JG_MH_HOSTS": "2"})
+    with pytest.raises(ValueError, match="JG_MH_PORT"):
+        detect_multihost(env={"JG_MH_RANK": "0", "JG_MH_HOSTS": "2"})
+
+
+def test_is_primary_host_follows_rank_env(monkeypatch):
+    monkeypatch.setenv("JG_MH_RANK", "0")
+    assert is_primary_host()
+    monkeypatch.setenv("JG_MH_RANK", "1")
+    assert not is_primary_host()
+    monkeypatch.delenv("JG_MH_RANK")
+    assert is_primary_host()  # single-process jax view
+
+
+# -- hardened bootstrap ------------------------------------------------------
+
+
+def test_classify_init_error_kinds():
+    assert classify_init_error(
+        ConnectionRefusedError("refused")) == COORDINATOR_UNREACHABLE
+    assert classify_init_error(TimeoutError("t")) == TIMEOUT
+    assert classify_init_error(
+        RuntimeError("DEADLINE_EXCEEDED: barrier timed out")) == TIMEOUT
+    assert classify_init_error(
+        RuntimeError("task already exists for process 3")) == RANK_COLLISION
+    assert classify_init_error(
+        RuntimeError("failed to connect to coordinator"),
+    ) == COORDINATOR_UNREACHABLE
+
+
+def test_check_multihost_config_fails_fast():
+    with pytest.raises(ValueError, match="out of range"):
+        check_multihost_config("h:1234", 2, 5)
+    with pytest.raises(ValueError, match="host:port"):
+        check_multihost_config("nocolon", 2, 0)
+    with pytest.raises(ValueError, match="port"):
+        check_multihost_config("h:99999", 2, 0)
+    with pytest.raises(ValueError, match="coordinator_address"):
+        check_multihost_config(None, 2, 0)
+    check_multihost_config("h:1234", 2, 1)  # valid: no raise
+
+
+def test_initialize_retries_timeout_with_seeded_backoff():
+    """Coordinator-timeout classification drives the retry loop: two
+    scripted timeouts then success; the jittered delays must come from
+    the injected seeded policy (deterministic across runs — a
+    restarting fleet must decorrelate, not re-herd)."""
+    calls, delays = [], []
+
+    def flaky(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise RuntimeError("deadline exceeded waiting for world")
+
+    events = []
+
+    class Tel:
+        def emit(self, kind, **f):
+            events.append((kind, f))
+
+    info = initialize_multihost(
+        "127.0.0.1:9", 2, 0, retries=3,
+        policy=RetryPolicy(max_restarts=3, base_backoff_s=0.1, seed=7),
+        telemetry=Tel(), sleep=delays.append, _initialize=flaky,
+    )
+    assert len(calls) == 3 and len(delays) == 2
+    assert calls[0]["initialization_timeout"] == 60
+    assert info["num_processes"] == 1  # this process stayed solo
+    assert events == [("multihost_init", {
+        "ok": True, "init_kind": "ok", "attempts": 3,
+        "coordinator": "127.0.0.1:9", "process_id": 0,
+        "num_processes": 2,
+    })]
+    # seeded determinism: the same policy seed replays the same jitter
+    replay = RetryPolicy(max_restarts=3, base_backoff_s=0.1, seed=7)
+    assert delays == [replay.backoff(1), replay.backoff(2)]
+
+
+def test_initialize_rank_collision_is_fatal_immediately():
+    """Rejoining with the same rank hits the same collision — no
+    retries, no sleeps, kind carried on the exception."""
+    delays = []
+
+    def collide(**kw):
+        raise RuntimeError("task already exists")
+
+    with pytest.raises(MultihostInitError) as ei:
+        initialize_multihost(
+            "127.0.0.1:9", 2, 1, retries=5, sleep=delays.append,
+            _initialize=collide,
+        )
+    assert ei.value.kind == RANK_COLLISION
+    assert ei.value.attempts == 1 and delays == []
+
+
+def test_initialize_budget_spent_carries_kind():
+    def refused(**kw):
+        raise ConnectionRefusedError("connection refused")
+
+    events = []
+
+    class Tel:
+        def emit(self, kind, **f):
+            events.append(f)
+
+    with pytest.raises(MultihostInitError) as ei:
+        initialize_multihost(
+            "127.0.0.1:9", 2, 0, retries=2, telemetry=Tel(),
+            policy=RetryPolicy(max_restarts=2, base_backoff_s=0.0),
+            sleep=lambda s: None, _initialize=refused,
+        )
+    assert ei.value.kind == COORDINATOR_UNREACHABLE
+    assert ei.value.attempts == 3  # initial + 2 retries
+    assert events[-1]["ok"] is False
+    assert events[-1]["init_kind"] == COORDINATOR_UNREACHABLE
+
+
+# -- host collective ---------------------------------------------------------
+
+
+def _start_world(hosts, port, timeout_s=5.0):
+    """Form a real hosts-rank star over localhost threads; returns the
+    started channels in rank order."""
+    chans = [
+        HostChannel(r, hosts, port, timeout_s=timeout_s)
+        for r in range(hosts)
+    ]
+    errs = []
+
+    def _start(ch):
+        try:
+            ch.start()
+        except Exception as e:  # surfaces in the assert below
+            errs.append(e)
+
+    threads = [threading.Thread(target=_start, args=(c,)) for c in chans]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errs, errs
+    return chans
+
+
+def test_allgather_three_ranks_rank_ordered():
+    chans = _start_world(3, _free_port())
+    try:
+        outs = [None] * 3
+
+        def _gather(i):
+            outs[i] = chans[i].allgather(b"payload-%d" % i, tag=5)
+
+        threads = [
+            threading.Thread(target=_gather, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        want = [b"payload-0", b"payload-1", b"payload-2"]
+        assert outs == [want, want, want]  # identical, rank order
+        assert chans[0].bytes_sent > 0 and chans[1].bytes_received > 0
+    finally:
+        for c in chans:
+            c.close()
+
+
+def test_allgather_rows_stacks_host_rows():
+    chans = _start_world(2, _free_port())
+    try:
+        rows = [np.arange(4, dtype=np.float32) + 10 * r for r in range(2)]
+        outs = [None, None]
+
+        def _gather(i):
+            outs[i] = allgather_rows(chans[i], rows[i], tag=3)
+
+        threads = [
+            threading.Thread(target=_gather, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        want = np.stack(rows)
+        for out in outs:
+            assert out.shape == (2, 4)
+            np.testing.assert_array_equal(out, want)
+    finally:
+        for c in chans:
+            c.close()
+
+
+def test_conductor_attributes_loss_and_latches():
+    """A vanished peer surfaces as HostLostError WITH the lost rank,
+    the channel latches ``lost``, and every later call fails fast —
+    a half-dead world must vacate, not limp."""
+    chans = _start_world(2, _free_port(), timeout_s=2.0)
+    try:
+        chans[1].close()  # rank 1 "SIGKILLed": its sockets drop
+        with pytest.raises(HostLostError) as ei:
+            chans[0].allgather(b"x")
+        assert ei.value.lost_ranks == [1]
+        assert chans[0].lost and chans[0].lost_ranks == [1]
+        assert "lost" in chans[0].lost_reason
+        with pytest.raises(HostLostError, match="already lost"):
+            chans[0].allgather(b"x")
+    finally:
+        for c in chans:
+            c.close()
+
+
+def test_single_host_needs_no_sockets():
+    ch = HostChannel(0, 1, 0).start()
+    assert ch.allgather(b"solo") == [b"solo"]
+    assert ch.bytes_sent == 0 and ch.bytes_received == 0
+
+
+def test_channel_rejects_bad_rank():
+    with pytest.raises(ValueError, match="out of range"):
+        HostChannel(2, 2, 1234)
+
+
+# -- chaos grammar -----------------------------------------------------------
+
+
+def test_chaos_grammar_host_kinds():
+    rules = parse_chaos_spec("host_lost@step=20,hosts=1;host_restore@step=40")
+    assert [r.kind for r in rules] == ["host_lost", "host_restore"]
+    assert rules[0].hosts == 1 and rules[0].step == 20
+    assert rules[1].hosts is None  # restore defaults to the launch count
+    assert HOST_KINDS == {"host_lost", "host_restore"}
+
+
+def test_chaos_grammar_host_lost_needs_hosts():
+    with pytest.raises(ValueError, match="hosts=N"):
+        parse_chaos_spec("host_lost@step=5")
+    with pytest.raises(ValueError, match="hosts"):
+        parse_chaos_spec("host_lost@step=5,hosts=0")
+    with pytest.raises(ValueError, match="only applies"):
+        parse_chaos_spec("preempt@step=5,hosts=1")
+
+
+# -- supervisor exit-code classification -------------------------------------
+
+# Tiny rank stubs: behavior keyed off the JG_MH_* env the supervisor
+# exports and flag files in the store, so one generation can differ
+# from the next.
+
+_KILL_LAST_RANK_ONCE = r"""
+import os, signal, sys
+rank, hosts = int(os.environ["JG_MH_RANK"]), int(os.environ["JG_MH_HOSTS"])
+if hosts == 2 and rank == 1:
+    os.kill(os.getpid(), signal.SIGKILL)
+sys.exit(0)
+"""
+
+_KILL_ALL = r"""
+import os, signal
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+_FLAG_THEN_OK = r"""
+import os, sys
+flag = os.path.join(os.environ["JG_MH_STORE"], "flag")
+if not os.path.exists(flag):
+    open(flag, "w").close()
+    sys.exit(int(sys.argv[1]))
+sys.exit(0)
+"""
+
+_RESTORE_REQUEST = r"""
+import json, os, sys
+store = os.environ["JG_MH_STORE"]
+if os.environ["JG_MH_HOSTS"] == "1":
+    with open(os.path.join(store, "restore_request.json"), "w") as f:
+        json.dump({"hosts": 2}, f)
+    sys.exit(75)
+sys.exit(0)
+"""
+
+
+class _Events:
+    def __init__(self):
+        self.rows = []
+
+    def emit(self, kind, **f):
+        self.rows.append({"kind": kind, **f})
+
+    def of(self, event):
+        return [r for r in self.rows if r.get("event") == event]
+
+
+def _run(store, src, *argv, hosts=2, **kw):
+    ev = _Events()
+    kw.setdefault("policy", RetryPolicy(max_restarts=0, max_preemptions=0))
+    rc = run_elastic_multihost(
+        [sys.executable, "-c", src, *map(str, argv)],
+        hosts=hosts, store=str(store), events=ev, poll_s=0.02,
+        sleep=lambda s: None, **kw,
+    )
+    return rc, ev
+
+
+def test_supervisor_clean_world_completes(tmp_path):
+    rc, ev = _run(tmp_path, "import sys; sys.exit(0)")
+    assert rc == 0
+    assert [r["kind"] for r in ev.rows] == ["host_membership"]
+    assert ev.of("complete")[0]["hosts"] == 2
+    view = read_membership(str(tmp_path))
+    assert view["hosts"] == 2 and view["generation"] == 1
+
+
+def test_supervisor_host_loss_shrinks_budget_free(tmp_path):
+    """Any signal-killed rank is membership churn: relaunch at the
+    survivor count with ZERO retry/preemption budget consumed — under
+    a zero-restart policy the run must still complete."""
+    rc, ev = _run(tmp_path, _KILL_LAST_RANK_ONCE)
+    assert rc == 0
+    lost = ev.of("lost")
+    assert len(lost) == 1
+    assert lost[0]["hosts_from"] == 2 and lost[0]["hosts_to"] == 1
+    assert lost[0]["killed_ranks"] == [1]
+    assert lost[0]["signals"] == ["SIGKILL"]
+    assert lost[0]["budget_used"] == 0
+    assert not ev.of("failed") and not ev.of("preempted")
+    view = read_membership(str(tmp_path))
+    assert view["hosts"] == 1
+    assert [h["event"] for h in view["history"]] == ["lost", "complete"]
+
+
+def test_supervisor_world_extinction_raises(tmp_path):
+    with pytest.raises(TrainingFailure, match="nothing left to shrink"):
+        _run(tmp_path, _KILL_ALL)
+
+
+def test_supervisor_preemption_burns_preempt_budget(tmp_path):
+    rc, ev = _run(
+        tmp_path, _FLAG_THEN_OK, 75,
+        policy=RetryPolicy(max_restarts=0, max_preemptions=1),
+    )
+    assert rc == 0
+    assert ev.of("preempted")[0]["budget_used"] == 1
+    (tmp_path / "flag").unlink()
+    with pytest.raises(TrainingFailure, match="preempted"):
+        _run(tmp_path, _FLAG_THEN_OK, 75)
+
+
+def test_supervisor_transient_failure_burns_restart_budget(tmp_path):
+    rc, ev = _run(
+        tmp_path, _FLAG_THEN_OK, 3,
+        policy=RetryPolicy(max_restarts=1, max_preemptions=0,
+                           base_backoff_s=0.0),
+    )
+    assert rc == 0
+    failed = ev.of("failed")
+    assert len(failed) == 1 and failed[0]["budget_used"] == 1
+    # the ranks race on the shared flag file — at least one saw it
+    # missing and took the scripted failure exit
+    assert 3 in failed[0]["exits"].values()
+    (tmp_path / "flag").unlink()
+    with pytest.raises(TrainingFailure, match="giving up"):
+        _run(tmp_path, _FLAG_THEN_OK, 3)
+
+
+def test_supervisor_regrows_on_restore_request(tmp_path):
+    """A persisted shrunken membership resumes at that world; the
+    restore_request.json handshake regrows to the requested count
+    budget-free, and the request file is consumed (one-shot)."""
+    mh_sup.HostMembershipView(full_hosts=2, hosts=1).record(str(tmp_path))
+    rc, ev = _run(tmp_path, _RESTORE_REQUEST)
+    assert rc == 0
+    restored = ev.of("restored")
+    assert len(restored) == 1
+    assert restored[0]["hosts_from"] == 1 and restored[0]["hosts_to"] == 2
+    assert restored[0]["budget_used"] == 0
+    assert not os.path.exists(tmp_path / "restore_request.json")
+    assert read_membership(str(tmp_path))["hosts"] == 2
+
+
+def test_supervisor_rejects_empty_world(tmp_path):
+    with pytest.raises(ValueError, match="hosts"):
+        run_elastic_multihost(["true"], hosts=0, store=str(tmp_path))
+
+
+# -- EF-row fold/regrow across host counts -----------------------------------
+
+
+def _plan(world, n_params=5000):
+    from distributed_mnist_bnns_tpu.ops.comm_compress import make_plan
+
+    return make_plan(n_params, world=world, mode="sign_ef",
+                     bucket_size=256, chunks=2)
+
+
+def test_host_ef_rows_fold_to_survivor_count():
+    """Shrink 2→1 (the host-loss path): the surviving world's worker
+    row is the MEAN of the old rows (contribution-preserving under the
+    exchange's mean combine) and the segment rows re-cut position-
+    preservingly — NumPy oracles, exactly PR 10's re-cut rules."""
+    from distributed_mnist_bnns_tpu.parallel.remesh import (
+        remesh_compress_state,
+    )
+    from distributed_mnist_bnns_tpu.train.optim import SignCompressState
+
+    old, new = _plan(2), _plan(1)
+    rng = np.random.RandomState(0)
+    ef = rng.randn(2, old.padded).astype(np.float32)
+    ef2 = rng.randn(2, old.seg).astype(np.float32)
+    # the transforms' invariant: positions at/after n_params are zero
+    flat2 = ef2.reshape(-1)
+    flat2[old.n_params:] = 0.0
+    state = (SignCompressState(ef_residual=ef,
+                               ef_residual2=ef2.reshape(2, old.seg)),)
+    folded, n = remesh_compress_state(state, new)
+    assert n == 1
+    got = folded[0]
+    want_ef = np.zeros((1, new.padded), np.float32)
+    m = min(old.padded, new.padded)
+    want_ef[:, :m] = ef.mean(axis=0, keepdims=True)[:, :m]
+    np.testing.assert_allclose(
+        np.asarray(got.ef_residual), want_ef, rtol=1e-6
+    )
+    want_ef2 = np.zeros(new.world * new.seg, np.float32)
+    m2 = min(flat2.size, want_ef2.size)
+    want_ef2[:m2] = flat2[:m2]
+    np.testing.assert_array_equal(
+        np.asarray(got.ef_residual2).reshape(-1), want_ef2
+    )
+
+
+def test_host_ef_rows_regrow_and_roundtrip():
+    """Regrow 1→2 copies the row to its successors; a 2→1→2 round trip
+    keeps the position-indexed e2 vector bitwise (the ef rows converge
+    to their mean — the documented contribution-preserving fold)."""
+    from distributed_mnist_bnns_tpu.parallel.remesh import (
+        remesh_compress_state,
+    )
+    from distributed_mnist_bnns_tpu.train.optim import SignCompressState
+
+    p2, p1 = _plan(2), _plan(1)
+    rng = np.random.RandomState(1)
+    ef = rng.randn(2, p2.padded).astype(np.float32)
+    ef2 = rng.randn(2, p2.seg).astype(np.float32)
+    ef2.reshape(-1)[p2.n_params:] = 0.0
+    state = (SignCompressState(ef_residual=ef, ef_residual2=ef2),)
+    down, _ = remesh_compress_state(state, p1)
+    back, _ = remesh_compress_state(down, p2)
+    got = back[0]
+    assert np.asarray(got.ef_residual).shape == (2, p2.padded)
+    # both regrown rows carry the fold's mean
+    want = ef.mean(axis=0)
+    for r in range(2):
+        np.testing.assert_allclose(
+            np.asarray(got.ef_residual)[r, :p1.padded],
+            want[:p1.padded], rtol=1e-6,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(got.ef_residual2).reshape(-1)[:p1.seg],
+        np.asarray(down[0].ef_residual2).reshape(-1)[:p1.seg],
+    )
+
+
+def test_fold_rejects_non_divisible_worlds():
+    from distributed_mnist_bnns_tpu.parallel.remesh import fold_worker_rows
+
+    with pytest.raises(ValueError, match="divide"):
+        fold_worker_rows(np.zeros((3, 8), np.float32), 2, 8)
+
+
+# -- remote replicas (serve/fleet) -------------------------------------------
+
+
+@pytest.fixture
+def agent(tmp_path):
+    from distributed_mnist_bnns_tpu.serve.fleet import HostAgent
+
+    a = HostAgent(str(tmp_path / "agent")).start()
+    yield a
+    a.close()
+
+
+def test_remote_launcher_spawn_signal_reap(agent):
+    from distributed_mnist_bnns_tpu.serve.fleet import RemoteLauncher
+
+    launcher = RemoteLauncher("127.0.0.1", agent.port)
+    assert launcher.ping()
+    port = launcher.free_port()
+    assert 0 < port < 65536
+    proc = launcher.launch(
+        [sys.executable, "-c", "import time; time.sleep(60)"]
+    )
+    assert proc.poll() is None
+    proc.terminate()
+    assert proc.wait(timeout=10) == -signal.SIGTERM
+    assert proc.poll() == -signal.SIGTERM  # latched, no further RPCs
+    # env plumbed through to the child
+    proc2 = launcher.launch(
+        [sys.executable, "-c",
+         "import os, sys; sys.exit(int(os.environ['JG_X']))"],
+        env={"JG_X": "7"},
+    )
+    assert proc2.wait(timeout=10) == 7
+
+
+def test_remote_launcher_unreachable_agent_reads_as_killed(tmp_path):
+    from distributed_mnist_bnns_tpu.serve.fleet import (
+        HostAgent, RemoteLauncher,
+    )
+
+    a = HostAgent(str(tmp_path / "agent")).start()
+    launcher = RemoteLauncher("127.0.0.1", a.port, timeout_s=2.0)
+    proc = launcher.launch(
+        [sys.executable, "-c", "import time; time.sleep(60)"]
+    )
+    a.close()  # the replica host vanishes (children reaped with it)
+    assert proc.poll() == -signal.SIGKILL  # host gone == hard loss
+
+
+def test_remote_artifact_ships_once_by_digest(agent, tmp_path):
+    from distributed_mnist_bnns_tpu.serve.fleet import RemoteLauncher
+
+    art = tmp_path / "model.msgpack"
+    art.write_bytes(os.urandom(2048))
+    launcher = RemoteLauncher("127.0.0.1", agent.port)
+    p1 = launcher.ensure_artifact(str(art))
+    assert os.path.exists(p1)
+    assert open(p1, "rb").read() == art.read_bytes()  # digest-verified ship
+    # second resolve answers from the digest cache: same path, no ship
+    assert launcher.ensure_artifact(str(art)) == p1
+    # a fresh launcher (supervisor restart) also finds it staged
+    assert RemoteLauncher(
+        "127.0.0.1", agent.port).ensure_artifact(str(art)) == p1
+
+
+def test_supervisor_places_replicas_through_launcher(agent, tmp_path):
+    """The fleet supervisor's spawn path with a launcher: remote port,
+    remotely staged artifact in the spawn command, a Popen-shaped
+    member the reap/retire machinery can drive."""
+    from distributed_mnist_bnns_tpu.serve.fleet import (
+        FleetView, RemoteLauncher, ReplicaSupervisor, RouterCore,
+    )
+    from distributed_mnist_bnns_tpu.serve.fleet.remote import RemoteProcess
+
+    art = tmp_path / "model.msgpack"
+    art.write_bytes(b"weights")
+    seen = {}
+
+    def spawn_command(rid, port, artifact):
+        seen["artifact"] = artifact
+        return [sys.executable, "-c", "import time; time.sleep(60)"]
+
+    sup = ReplicaSupervisor(
+        RouterCore(), spawn_command, artifact=str(art),
+        view=FleetView(1, 1, 1),
+        launcher=RemoteLauncher("127.0.0.1", agent.port),
+    )
+    member = sup.spawn_replica()
+    assert isinstance(member.proc, RemoteProcess)
+    assert os.path.exists(seen["artifact"])  # staged remote path, not local
+    assert seen["artifact"] != str(art)
+    assert member.proc.poll() is None
+    rcs = sup.drain_all(timeout=10)
+    assert rcs[member.rid] == -signal.SIGTERM
