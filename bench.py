@@ -1251,16 +1251,34 @@ def _bench_lm_serve(args, deadline):
         "ctx": ctx, "embed_dim": args.lm_embed_dim,
         "depth": args.lm_depth, "n_new_tokens_per_stream": n_new,
         "interpret_mode": interp,
+        # Both variants run with the Pallas serving path armed: the
+        # in-kernel page-table-walk attention is common to both rows,
+        # so the packed-vs-dense ratio isolates the GEMM weight format
+        # (packed bitplanes — popcount carry at decode M, fused
+        # bitplane-unpack at prefill/verify M, FUSED_UNPACK_MIN_M —
+        # vs carried fp32).
+        "kernels": True,
     }
 
-    def run_streams(fz, streams, spec_k=0):
+    def run_streams(fz, streams, spec_k=0, kernels=True):
         """One engine at `streams` concurrent staggered requests;
-        returns the throughput/latency row (+ spec acceptance)."""
+        returns the throughput/latency row (+ spec acceptance).
+
+        The decode window per stream is the widest that fits the
+        context after its prompt, and the whole request batch runs
+        TWICE on the warm engine, keeping the attempt with the higher
+        throughput: host/scheduler jitter is strictly additive, so the
+        minimum-wall attempt is the lowest-noise estimator — the same
+        reasoning as ``_min_marginal``'s two-length minima. A 16-token
+        window behind an 8-36 token prefill measures mostly prefill
+        and thread-wakeup noise (ratios swung 0.6-1.3 run to run);
+        the wide window makes the row a decode-throughput number."""
         reg = MetricsRegistry()
         tel = Telemetry(None, registry=reg)
         dec = make_paged_lm_decoder(
             fz, slots=streams, page_size=16,
             prefill_chunk=16, interpret=interp, spec_k=spec_k,
+            kernels=kernels,
         )
         eng = LMEngine(dec, queue_depth=streams * 2,
                        telemetry=tel).start()
@@ -1270,25 +1288,37 @@ def _bench_lm_serve(args, deadline):
                 rng.randint(0, 256, size=8 + 4 * i).astype(np.int32)
                 for i in range(streams)       # staggered lengths
             ]
-            t0 = time.perf_counter()
-            reqs = [
-                eng.submit(p, n_new, time.monotonic() + 600)
-                for p in prompts
-            ]
-            done = 0
-            for r in reqs:
-                while True:
-                    ev = r.events.get(timeout=600)
-                    if ev["kind"] == "done":
-                        assert ev["status"] == "ok", ev
-                        done += ev["n"]
-                        break
-            wall = time.perf_counter() - t0
+            longest = max(len(p) for p in prompts)
+            # Spec rows keep the narrow window: the K-wide verify
+            # dispatch must not be pushed against max_len.
+            n_new_row = (
+                n_new if spec_k else max(n_new, ctx - longest - 1)
+            )
+            best = None
+            for _attempt in range(2):
+                t0 = time.perf_counter()
+                reqs = [
+                    eng.submit(p, n_new_row, time.monotonic() + 600)
+                    for p in prompts
+                ]
+                done = 0
+                for r in reqs:
+                    while True:
+                        ev = r.events.get(timeout=600)
+                        if ev["kind"] == "done":
+                            assert ev["status"] == "ok", ev
+                            done += ev["n"]
+                            break
+                wall = time.perf_counter() - t0
+                tps = done / wall
+                if best is None or tps > best:
+                    best = tps
             hist = reg.histogram(DECODE_ITERATION_SECONDS)
             p50 = hist.percentile(50)
             p99 = hist.percentile(99)
             row = {
-                "tokens_per_sec": round(done / wall, 1),
+                "tokens_per_sec": round(best, 1),
+                "n_new_per_stream": int(n_new_row),
                 "p50_intertoken_ms": (
                     round(p50 * 1e3, 3) if p50 is not None else None
                 ),
@@ -1318,14 +1348,16 @@ def _bench_lm_serve(args, deadline):
             rows[f"streams_{streams}"] = run_streams(fz, streams)
         out[vname] = rows
     pk, dn = out.get("packed_1bit"), out.get("dense_fp32")
-    if (
-        isinstance(pk, dict) and isinstance(dn, dict)
-        and "streams_8" in pk and "streams_8" in dn
-    ):
-        out["packed_speedup_8_streams"] = round(
-            pk["streams_8"]["tokens_per_sec"]
-            / dn["streams_8"]["tokens_per_sec"], 2,
-        )
+    if isinstance(pk, dict) and isinstance(dn, dict):
+        # perf-gate floors (lm_packed_speedup_{1,4,8}_streams): packed
+        # must beat dense fp32 at EVERY stream count, not just 8.
+        for streams in (1, 4, 8):
+            sk = f"streams_{streams}"
+            if sk in pk and sk in dn and dn[sk]["tokens_per_sec"]:
+                out[f"packed_speedup_{streams}_streams"] = round(
+                    pk[sk]["tokens_per_sec"]
+                    / dn[sk]["tokens_per_sec"], 2,
+                )
 
     # -- self-speculative decoding (SERVING.md "Speculative decoding"):
     # spec-on (packed 1-bit draft + fixed-K bf16 verify) vs the

@@ -69,20 +69,23 @@ _REV_MODULES: Dict[str, Tuple[str, ...]] = {
         f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
         f"{_PKG}.ops.xnor_gemm",
     ),
+    # ops.flash_attention rides along: paged_kv's Pallas kernels import
+    # their online-softmax constants from it, so an edit there changes
+    # the traced attention math of all three LM programs.
     "lm_prefill": (
         f"{_PKG}.infer_transformer", f"{_PKG}.ops.paged_kv",
         f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
-        f"{_PKG}.ops.xnor_gemm",
+        f"{_PKG}.ops.xnor_gemm", f"{_PKG}.ops.flash_attention",
     ),
     "lm_decode": (
         f"{_PKG}.infer_transformer", f"{_PKG}.ops.paged_kv",
         f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
-        f"{_PKG}.ops.xnor_gemm",
+        f"{_PKG}.ops.xnor_gemm", f"{_PKG}.ops.flash_attention",
     ),
     "lm_verify": (
         f"{_PKG}.infer_transformer", f"{_PKG}.ops.paged_kv",
         f"{_PKG}.ops.binarize", f"{_PKG}.ops.bitpack",
-        f"{_PKG}.ops.xnor_gemm",
+        f"{_PKG}.ops.xnor_gemm", f"{_PKG}.ops.flash_attention",
     ),
     "train_step": (
         f"{_PKG}.train.trainer", f"{_PKG}.train.optim",
@@ -289,15 +292,22 @@ def _lm_avals(geom: Dict[str, int]):
 
 def lm_decoder_keys(
     artifact_digest: str, geom: Dict[str, int], *, interpret: bool,
+    kernels: bool = False,
 ) -> Tuple[AotKey, AotKey, Optional[AotKey]]:
     """(prefill, decode, verify-or-None) keys. ``spec_k`` shapes ONLY
     the verify key: the prefill/decode programs are identical with
     spec decode on or off, so the pair banked by a plain boot serves a
     spec-armed boot too — which still misses as a set until
-    ``lm_verify`` is banked (the all-or-nothing discipline)."""
+    ``lm_verify`` is banked (the all-or-nothing discipline).
+    ``kernels`` keys all three: the Pallas paged-attention +
+    fused-unpack programs are different executables from the gather
+    path, so flipping the flag must miss."""
     _, prefill_avals, decode_avals, verify_avals = _lm_avals(geom)
     extra = {k: v for k, v in geom.items() if k != "spec_k"}
-    extra.update(interpret=bool(interpret), donate=aot_donate())
+    extra.update(
+        interpret=bool(interpret), donate=aot_donate(),
+        kernels=bool(kernels),
+    )
     key_v = None
     if verify_avals is not None:
         key_v = make_key(
@@ -318,7 +328,7 @@ def load_paged_lm_decoder_aot(
     path: str, *, slots: int, page_size: int = 16,
     num_pages: Optional[int] = None, prefill_chunk: int = 16,
     max_len: Optional[int] = None, spec_k: int = 0,
-    interpret: bool = False, store: AotStore,
+    interpret: bool = False, kernels: bool = False, store: AotStore,
 ):
     """AOT-aware ``make_paged_lm_decoder`` from an artifact file.
 
@@ -344,7 +354,7 @@ def load_paged_lm_decoder_aot(
         prefill_chunk=prefill_chunk, max_len=max_len, spec_k=spec_k,
     )
     key_p, key_d, key_v = lm_decoder_keys(
-        digest, geom, interpret=interpret
+        digest, geom, interpret=interpret, kernels=kernels
     )
     keys = [key_p, key_d] + ([key_v] if key_v is not None else [])
     # All-or-nothing: only touch get() (which emits hit/miss events and
@@ -385,6 +395,7 @@ def load_paged_lm_decoder_aot(
             vocab=geom["vocab"], num_blocks=geom["num_blocks"],
             verify=loaded[2] if key_v is not None else None,
             spec_k=geom["spec_k"],
+            kernels=bool(kernels),
         )
         return decoder, info, {
             "status": "hit",
@@ -397,7 +408,7 @@ def load_paged_lm_decoder_aot(
     dec = make_paged_lm_decoder(
         frozen, slots=slots, page_size=page_size, num_pages=num_pages,
         prefill_chunk=prefill_chunk, max_len=max_len, spec_k=spec_k,
-        interpret=interpret,
+        interpret=interpret, kernels=kernels,
         donate=aot_donate(),   # see module docstring: donation +
                                # deserialize double-frees on 0.4.37
     )
@@ -417,7 +428,7 @@ def load_paged_lm_decoder_aot(
     _, prefill_avals, decode_avals, verify_avals = _lm_avals(geom)
     comp_p = dec.prefill.lower(*prefill_avals).compile()
     comp_d = dec.decode.lower(*decode_avals).compile()
-    meta = {"artifact": path, **geom}
+    meta = {"artifact": path, "kernels": bool(kernels), **geom}
     store.put(key_p, comp_p, meta=meta)
     store.put(key_d, comp_d, meta=meta)
     comp_v = None

@@ -328,6 +328,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "alone, accept/reject is host-side so the "
                          "compiled signatures stay fixed (SERVING.md "
                          "'Speculative decoding'). 0 = off")
+    sv.add_argument("--kernels", action="store_true",
+                    help="--lm: arm the Pallas serving path — paged "
+                         "attention walks the page table in-kernel (no "
+                         "materialized K/V gather) and packed "
+                         "projections run the fused unpack-GEMM "
+                         "(weights cross HBM at 1/32 byte/param). Same "
+                         "three-program set, token-identical greedy "
+                         "output; off = the gather/popcount oracle path")
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument("--port", type=int, default=8000,
                     help="0 = pick an ephemeral port (logged)")
@@ -746,6 +754,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "`serve --lm --aot --spec-decode K` boots "
                          "zero-compile (the prefill/decode pair-miss "
                          "discipline extends to the triple)")
+    ab.add_argument("--kernels", action="store_true",
+                    help="bank the Pallas serving path's programs "
+                         "(in-kernel page-walk attention + fused "
+                         "unpack-GEMM); must match the serving flag — "
+                         "kernels is part of the cache key")
     ab.add_argument("--interpret", action=argparse.BooleanOptionalAction,
                     default=None,
                     help="packed-kernel interpreter mode; must match "
@@ -940,6 +953,7 @@ def _cmd_aot(args) -> int:
             max_len=args.max_len,
             spec_k=args.spec_decode,
             interpret=interpret,
+            kernels=args.kernels,
             store=store,
         )
         built.append({
@@ -1503,6 +1517,7 @@ def main(argv=None) -> int:
                 trace=args.trace,
                 prefix_cache=args.prefix_cache,
                 spec_decode=args.spec_decode,
+                kernels=args.kernels,
                 costs=args.costs,
                 events_max_bytes=args.events_max_bytes,
             ))

@@ -32,6 +32,7 @@ them in fp32 — equality tests pin backend="xla".
 
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
@@ -49,7 +50,11 @@ from .models.transformer import (
     _attend_xla,
 )
 from .ops.binarize import binarize_ste
-from .ops.xnor_gemm import prepack_weights, xnor_matmul_packed
+from .ops.xnor_gemm import (
+    prepack_weights,
+    xnor_matmul_fused_unpack,
+    xnor_matmul_packed,
+)
 
 
 def _freeze_dense(params: Dict, scale: bool) -> Dict[str, Any]:
@@ -72,18 +77,45 @@ def _freeze_dense_fp32(params: Dict) -> Dict[str, Any]:
     return {"kernel": params["kernel"], "bias": params["bias"]}
 
 
-def _dense_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
+def _dense_fn(
+    layer: Dict[str, Any], interpret: bool, fused: bool = False
+) -> Callable:
     """Layer closure dispatch: packed 1-bit ('wp') or carried fp32
-    ('kernel' — partial binarization)."""
+    ('kernel' — partial binarization). ``fused`` selects the fused
+    unpack-GEMM carry of the packed weights (kernel serving path)."""
     if "wp" in layer:
-        return _packed_dense_fn(layer, interpret)
+        return _packed_dense_fn(layer, interpret, fused)
     kernel = jnp.asarray(layer["kernel"], jnp.float32)
     bias = jnp.asarray(layer["bias"], jnp.float32)
     return lambda x: jnp.dot(x, kernel) + bias
 
 
-def _packed_dense_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
-    """sign(x) @ packed-W (+ alpha) + b over any leading shape."""
+#: M at which the kernel serving path switches the packed-weight GEMM
+#: from the XNOR-popcount carry to the fused bitplane-unpack carry.
+#: Same discipline as the PERF.md §3 packed-vs-dense crossover, one
+#: level down: below it (the S-slot decode step) popcount's ~K/32
+#: word ops per output beat anything that expands bitplanes; at and
+#: above it (prefill chunks, S*K verify windows) the fused kernel's
+#: in-VMEM unpack feeds the MXU full (bm, bn) tiles. Both carries
+#: stream the SAME packed words — HBM stays 1/32 byte/param either
+#: way — and both are exact on the ±1 domain, so the choice cannot
+#: move logits. M is static per compiled program, so the pick is
+#: burned in at trace time (no shape-dependent recompiles).
+FUSED_UNPACK_MIN_M = 16
+
+
+def _packed_dense_fn(
+    layer: Dict[str, Any], interpret: bool, fused: bool = False
+) -> Callable:
+    """sign(x) @ packed-W (+ alpha) + b over any leading shape.
+
+    ``fused=False`` always runs the XNOR-popcount kernel on packed
+    activations; ``fused=True`` (the kernel serving path) picks per
+    dispatch shape: popcount below ``FUSED_UNPACK_MIN_M`` rows,
+    ``xnor_matmul_fused_unpack`` — same packed weights, bitplanes
+    expanded in-kernel per K tile and hit with MXU dots — at or above
+    it. All carries are exact integer GEMMs on the ±1 domain, so the
+    kernel-flag flip cannot move logits."""
     wp = jnp.asarray(layer["wp"])
     k, n = int(layer["k"]), int(layer["n"])
     bias = jnp.asarray(layer["bias"], jnp.float32)
@@ -95,8 +127,11 @@ def _packed_dense_fn(layer: Dict[str, Any], interpret: bool) -> Callable:
     def fn(x: jnp.ndarray) -> jnp.ndarray:
         bits = binarize_ste(x)
         lead = bits.shape[:-1]
-        y = xnor_matmul_packed(
-            bits.reshape(-1, k), wp, k, n, interpret=interpret
+        flat = bits.reshape(-1, k)
+        use_fused = fused and flat.shape[0] >= FUSED_UNPACK_MIN_M
+        matmul = xnor_matmul_fused_unpack if use_fused else xnor_matmul_packed
+        y = matmul(
+            flat, wp, k, n, interpret=interpret
         )
         y = y.reshape(*lead, n)
         if alpha is not None:
@@ -322,19 +357,22 @@ def _freeze_lm_tensors(model: BinarizedLM, variables: Dict) -> Dict[str, Any]:
     return frozen
 
 
-def _block_layers(blk: Dict[str, Any], interpret: bool) -> Dict[str, Callable]:
+def _block_layers(
+    blk: Dict[str, Any], interpret: bool, fused: bool = False
+) -> Dict[str, Callable]:
     """The per-block closures shared by the full forward (_block_fn) and
     the KV-cache decoder (_block_decode_fn) — one construction site so
-    the two paths cannot drift."""
+    the two paths cannot drift. ``fused`` arms the fused unpack-GEMM
+    carry of every packed projection (see :func:`_packed_dense_fn`)."""
     return {
         "ln_attn": _ln_fn(blk["ln_attn"]),
         "ln_mlp": _ln_fn(blk["ln_mlp"]),
-        "q": _dense_fn(blk["q"], interpret),
-        "k": _dense_fn(blk["k"], interpret),
-        "v": _dense_fn(blk["v"], interpret),
-        "out": _dense_fn(blk["out"], interpret),
-        "mlp1": _dense_fn(blk["mlp1"], interpret),
-        "mlp2": _dense_fn(blk["mlp2"], interpret),
+        "q": _dense_fn(blk["q"], interpret, fused),
+        "k": _dense_fn(blk["k"], interpret, fused),
+        "v": _dense_fn(blk["v"], interpret, fused),
+        "out": _dense_fn(blk["out"], interpret, fused),
+        "mlp1": _dense_fn(blk["mlp1"], interpret, fused),
+        "mlp2": _dense_fn(blk["mlp2"], interpret, fused),
     }
 
 
@@ -831,6 +869,7 @@ class PagedLMDecoder(NamedTuple):
     num_blocks: int
     verify: Optional[Callable] = None   # spec-decode scorer (or None)
     spec_k: int = 0         # verify window width (0 = spec decode off)
+    kernels: bool = False   # Pallas paged-attention + fused-unpack path
 
 
 def make_paged_lm_decoder(
@@ -843,6 +882,7 @@ def make_paged_lm_decoder(
     interpret: bool = False,
     donate: bool = True,
     spec_k: int = 0,
+    kernels: bool = False,
 ) -> PagedLMDecoder:
     """Build the paged prefill/decode pair from a ``kind == "lm"``
     artifact (see :class:`PagedLMDecoder`). ``num_pages`` defaults to
@@ -857,7 +897,17 @@ def make_paged_lm_decoder(
     scores the whole window — the pending token plus the drafts — in
     one dense-bf16 dispatch. ``spec_k == 1`` degenerates to a
     one-token-per-round bf16 verifier with no drafts (the
-    "verifier-alone" reference engine of the equivalence suite)."""
+    "verifier-alone" reference engine of the equivalence suite).
+
+    ``kernels=True`` arms the Pallas serving path: paged attention runs
+    the in-kernel page-table walk (``paged_kv.paged_attention_kernel``
+    and its prefill/verify twins — no materialized K/V gather) and every
+    packed projection runs the fused unpack-GEMM
+    (``xnor_matmul_fused_unpack`` — bitplanes expand in VMEM, HBM
+    weight traffic stays 1/32 byte/param). The gather + popcount path
+    (``kernels=False``) is kept as the correctness oracle; greedy
+    output is token-identical between the two (the fused GEMM is
+    bitwise-equal on ±1, attention matches to fp tolerance)."""
     from .ops import paged_kv
 
     if frozen.get("kind") != "lm":
@@ -871,7 +921,25 @@ def make_paged_lm_decoder(
     ln_head = _ln_fn(frozen["ln_head"])
     head_w = jnp.asarray(frozen["head_w"], jnp.float32)
     head_b = jnp.asarray(frozen["head_b"], jnp.float32)
-    layers = [_block_layers(blk, interpret) for blk in frozen["blocks"]]
+    kernels = bool(kernels)
+    layers = [
+        _block_layers(blk, interpret, fused=kernels)
+        for blk in frozen["blocks"]
+    ]
+    if kernels:
+        _attn = functools.partial(
+            paged_kv.paged_attention_kernel, interpret=interpret
+        )
+        _attn_prefill = functools.partial(
+            paged_kv.paged_prefill_attention_kernel, interpret=interpret
+        )
+        _attn_verify = functools.partial(
+            paged_kv.paged_verify_attention_kernel, interpret=interpret
+        )
+    else:
+        _attn = paged_kv.paged_attention
+        _attn_prefill = paged_kv.paged_prefill_attention
+        _attn_verify = paged_kv.paged_verify_attention
     embed_dim = int(tok.shape[1])
     head_dim = embed_dim // num_heads
     pos_len = int(pos_embed.shape[1])
@@ -928,9 +996,7 @@ def make_paged_lm_decoder(
             v = lay["v"](y).reshape(c, num_heads, head_dim)
             kp = paged_kv.write_kv(kp, idx, k)
             vp = paged_kv.write_kv(vp, idx, v)
-            core = paged_kv.paged_prefill_attention(
-                q, kp, vp, page_table, gpos
-            )
+            core = _attn_prefill(q, kp, vp, page_table, gpos)
             x = x + lay["out"](core.reshape(c, embed_dim))
             x = _mlp(lay, x)
             new.append((kp, vp))
@@ -950,9 +1016,7 @@ def make_paged_lm_decoder(
             v = lay["v"](y).reshape(s, num_heads, head_dim)
             kp = paged_kv.write_kv(kp, idx, k)
             vp = paged_kv.write_kv(vp, idx, v)
-            core = paged_kv.paged_attention(
-                q, kp, vp, page_tables, positions
-            )
+            core = _attn(q, kp, vp, page_tables, positions)
             x = x + lay["out"](core.reshape(s, embed_dim))
             x = _mlp(lay, x)
             new.append((kp, vp))
@@ -1002,9 +1066,7 @@ def make_paged_lm_decoder(
                 # verifier-grade history.
                 kp = paged_kv.write_kv(kp, idx, kk)
                 vp = paged_kv.write_kv(vp, idx, v)
-                core = paged_kv.paged_verify_attention(
-                    q, kp, vp, page_tables, positions
-                )
+                core = _attn_verify(q, kp, vp, page_tables, positions)
                 x = x + lay["out"](core.reshape(s, k, embed_dim))
                 x = _mlp(lay, x)
                 new.append((kp, vp))
@@ -1026,4 +1088,5 @@ def make_paged_lm_decoder(
         num_blocks=n_blocks,
         verify=verify_fn,
         spec_k=spec_k,
+        kernels=kernels,
     )

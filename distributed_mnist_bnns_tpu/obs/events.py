@@ -94,6 +94,7 @@ EVENT_KINDS: Dict[str, str] = {
     "lm_decode_error": "a decode dispatch failed and was retried",
     "lm_prefix_hit": "admission forked a cached prompt prefix COW",
     "lm_spec_round": "periodic speculative-decode round snapshot",
+    "lm_warmup": "the LM engine finished warmup (programs, kernels)",
     "aot_hit": "a boot installed a stored AOT executable (no compile)",
     "aot_miss": "AOT store had no entry; online compile + re-bank",
     "aot_bank": "an executable was serialized into the AOT store",

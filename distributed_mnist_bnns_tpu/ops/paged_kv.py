@@ -35,11 +35,14 @@ thread, never inside the jitted step.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .flash_attention import NEG_INF
 
 NULL_PAGE = 0
 
@@ -323,3 +326,161 @@ def paged_prefill_attention(
     scores = jnp.where(mask[:, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("chl,lhd->chd", probs, vc)
+
+
+# ---------------------------------------------------------------------------
+# Pallas paged-attention kernel (decode / prefill / verify share one body)
+# ---------------------------------------------------------------------------
+#
+# The gather oracles above materialize every K/V page a table references
+# — (S, P * page_size, H, D) of HBM traffic per layer per step — before
+# a single flop of attention runs. The kernel below never materializes
+# that copy: the page table rides in as a *scalar-prefetch* operand, the
+# grid walks (slot, page-block) with the page axis innermost-sequential,
+# and the k/v BlockSpec index maps read ``tables[s, p]`` directly, so
+# the Pallas pipeline fetches exactly one (page_size, H, D) page per
+# step straight out of the pool. Softmax is the online accumulation of
+# ``_flash_kernel`` (m/l/acc in VMEM scratch persisting across the
+# sequential page steps); causal/validity masking (``kpos <= qpos``) is
+# applied in-kernel, which also neutralizes null-page garbage exactly as
+# the oracle's -inf mask does — every position past a slot's length,
+# including everything a null-page entry covers, is masked before the
+# softmax.
+#
+# One body serves all three call shapes via queries (S, C, H, D) with
+# per-query positions (S, C): decode is C=1, verify is C=spec_k, prefill
+# is S=1 with C=chunk.
+
+
+def _paged_attn_kernel(
+    tables_ref, q_ref, qpos_ref, k_ref, v_ref, o_ref,
+    m_ref, l_ref, acc_ref, *, page_size, n_pblocks, scale,
+):
+    del tables_ref  # consumed by the BlockSpec index maps
+    from jax.experimental import pallas as pl
+
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _reset():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)               # (C, H, D)
+    k = k_ref[0].astype(jnp.float32)               # (ps, H, D)
+    v = v_ref[0].astype(jnp.float32)
+    qpos = qpos_ref[0]                             # (C,) int32
+    c = q.shape[0]
+
+    # (H, C, ps) scores: contract D, batch over heads.
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((1,), (1,))),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ) * scale
+    kpos = p * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (c, page_size), 1
+    )
+    mask = kpos <= qpos[:, None]                   # (C, ps)
+    s = jnp.where(mask[None, :, :], s, NEG_INF)
+
+    m_prev = m_ref[...]                            # (H, C)
+    l_prev = l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+    p_ = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new[..., None]), 0.0)
+    corr = jnp.where(m_prev > NEG_INF / 2, jnp.exp(m_prev - m_new), 0.0)
+    l_ref[...] = corr * l_prev + jnp.sum(p_, axis=2)
+    m_ref[...] = m_new
+    pv = jax.lax.dot_general(                      # (H, C, D)
+        p_, v, (((2,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    acc_ref[...] = corr[..., None] * acc_ref[...] + pv
+
+    @pl.when(p == n_pblocks - 1)
+    def _finalize():
+        l_fin = l_ref[...]
+        safe_l = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        out = acc_ref[...] / safe_l[..., None]     # (H, C, D)
+        o_ref[0] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q4, qpos, k_pool, v_pool, tables, *, interpret):
+    """Shared launcher: ``q4`` (S, C, H, D), ``qpos`` (S, C) int32,
+    ``tables`` (S, P) int32 -> (S, C, H, D) fp32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, c, h, d = q4.shape
+    _, ps, _, _ = k_pool.shape
+    n_pblocks = tables.shape[-1]
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        page_size=ps, n_pblocks=n_pblocks, scale=d ** -0.5,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s, n_pblocks),
+        in_specs=[
+            pl.BlockSpec((1, c, h, d), lambda si, p, tb: (si, 0, 0, 0)),
+            pl.BlockSpec((1, c), lambda si, p, tb: (si, 0)),
+            # The in-kernel page-table walk: the pipeline fetches pool
+            # page tables[si, p] for grid step (si, p) — no gather.
+            pl.BlockSpec((1, ps, h, d),
+                         lambda si, p, tb: (tb[si, p], 0, 0, 0)),
+            pl.BlockSpec((1, ps, h, d),
+                         lambda si, p, tb: (tb[si, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, c, h, d), lambda si, p, tb: (si, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, c), jnp.float32),
+            pltpu.VMEM((h, c), jnp.float32),
+            pltpu.VMEM((h, c, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, c, h, d), jnp.float32),
+        interpret=bool(interpret),
+    )(tables.astype(jnp.int32), q4.astype(jnp.float32),
+      qpos.astype(jnp.int32), k_pool, v_pool)
+
+
+def paged_attention_kernel(
+    q, k_pool, v_pool, page_tables, positions, *, interpret: bool = False
+):
+    """Kernel twin of :func:`paged_attention` — same signature and
+    semantics, no materialized gather. ``q`` (S, H, D)."""
+    out = _paged_attention_pallas(
+        q[:, None], positions[:, None], k_pool, v_pool, page_tables,
+        interpret=interpret,
+    )
+    return out[:, 0]
+
+
+def paged_verify_attention_kernel(
+    q, k_pool, v_pool, page_tables, positions, *, interpret: bool = False
+):
+    """Kernel twin of :func:`paged_verify_attention`. ``q`` (S, K, H, D);
+    query j of slot s sits at global position ``positions[s] + j``."""
+    qpos = positions[:, None] + jnp.arange(
+        q.shape[1], dtype=jnp.int32
+    )[None, :]
+    return _paged_attention_pallas(
+        q, qpos, k_pool, v_pool, page_tables, interpret=interpret
+    )
+
+
+def paged_prefill_attention_kernel(
+    q, k_pool, v_pool, page_table, q_positions, *, interpret: bool = False
+):
+    """Kernel twin of :func:`paged_prefill_attention`. ``q`` (C, H, D)
+    for ONE sequence with table (P,) and positions (C,)."""
+    out = _paged_attention_pallas(
+        q[None], q_positions[None], k_pool, v_pool, page_table[None],
+        interpret=interpret,
+    )
+    return out[0]

@@ -366,6 +366,110 @@ def xnor_matmul_packed(
     )
 
 
+# ---------------------------------------------------------------------------
+# Fused bitplane-unpack GEMM — packed weights straight into the MXU
+# ---------------------------------------------------------------------------
+
+
+def _fused_unpack_kernel(x_ref, wt_ref, o_ref):
+    """One (bm, bn, k-chunk) grid step of ``x @ unpack(w_packed)``: the
+    (kc, bn) packed-word tile is expanded to its (kc*32, bn) ±1 bitplane
+    IN VMEM (never written back to HBM) and hit with one dot per step.
+
+    x_ref:  (bm, kc*32) fp32 activations for this K chunk
+    wt_ref: (kc, bn) int32 packed weights, K-major (prepack_weights)
+
+    Unpack matches ``bitpack.unpack_bits`` exactly: bit b of word kw is
+    K index kw*32 + b (LSB-first), bit 1 -> +1, bit 0 -> -1. Zero-padded
+    K words therefore unpack to -1 columns — neutralized by the zero
+    rows the entry point pads onto x, so the formula stays exact. The
+    packed-K axis is the innermost (sequential) grid dimension revisiting
+    the output tile, seeded at step 0 — the same accumulation scaffold
+    as ``_xnor_kernel``. fp32 accumulation of ±1 dots is exact
+    (integers, |o| <= K <= 2^24) in any blocking order.
+    """
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _zero():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    words = wt_ref[...]                       # (kc, bn) int32
+    kc, bn = words.shape
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (kc, WORD_BITS, bn), 1)
+    bits = jnp.right_shift(words[:, None, :], shifts) & 1
+    w = (2 * bits - 1).astype(jnp.float32).reshape(kc * WORD_BITS, bn)
+    o_ref[...] += jax.lax.dot_general(
+        x_ref[...], w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n", "block_m", "block_n", "interpret")
+)
+def xnor_matmul_fused_unpack(
+    x: jnp.ndarray,
+    w_packed: jnp.ndarray,
+    k: int,
+    n: int,
+    *,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(M, K) fp32 activations @ pre-packed ±1 weights with the bitplane
+    unpack fused into the GEMM's K loop.
+
+    The decode-hot-path alternative to ``unpack_bits`` + ``jnp.dot``:
+    weights cross HBM packed (1/32 byte/param) and expand to ±1 only
+    inside VMEM, one (kc*32, bn) tile at a time — the unpacked (K, N)
+    weight matrix never exists in HBM. On the ±1 activation domain the
+    result is bitwise-equal to unpack-then-GEMM (both are exact integer
+    sums in fp32). ``w_packed`` is ``prepack_weights`` layout; ``x`` may
+    be any real-valued fp32 (the packed-x popcount path is
+    ``xnor_matmul_packed``).
+
+    K chunks are 8 words (256 bits) so the in-VMEM bitplane tile stays
+    small; when the whole packed K fits in 8 words it is one chunk.
+    """
+    m, k2 = x.shape
+    assert k == k2, (x.shape, k)
+    bm = min(block_m, max(8, m))
+    bn = min(block_n, max(128, n))
+    mp = -(-m // bm) * bm
+    wtp = w_packed
+    kw = -(-k // WORD_BITS)
+    kw_real = max(kw, wtp.shape[0])
+    kc = kw_real if kw_real <= 8 else 8
+    kw_p = -(-kw_real // kc) * kc
+    np_ = -(-max(n, wtp.shape[1]) // bn) * bn
+    if (kw_p, np_) != wtp.shape:
+        wtp = jnp.pad(
+            wtp,
+            ((0, kw_p - wtp.shape[0]), (0, np_ - wtp.shape[1])),
+        )
+    xf = x.astype(jnp.float32)
+    if (mp, kw_p * WORD_BITS) != xf.shape:
+        xf = jnp.pad(xf, ((0, mp - m), (0, kw_p * WORD_BITS - k)))
+
+    from jax.experimental import pallas as pl
+
+    out = pl.pallas_call(
+        _fused_unpack_kernel,
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        grid=(mp // bm, np_ // bn, kw_p // kc),
+        in_specs=[
+            pl.BlockSpec((bm, kc * WORD_BITS), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((kc, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        interpret=bool(interpret),
+    )(xf, wtp)
+    return out[:m, :n]
+
+
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
 def xnor_matmul(
     x_pm1: jnp.ndarray,

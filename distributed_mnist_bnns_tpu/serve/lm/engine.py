@@ -450,6 +450,20 @@ class LMEngine:
             # server's pre-load BOOT mark for an AOT store hit; every
             # later after_step enforces budget 0 against it.
             self._sanitizer.pin_baseline(self._compile_baseline)
+        # Warmup record: which serving path the compiled programs carry
+        # (kernels = Pallas page-walk attention + fused unpack-GEMM vs
+        # the gather/popcount oracle) — the smoke asserts the armed path
+        # from this event rather than trusting the CLI flag made it here.
+        kernels = bool(getattr(dec, "kernels", False))
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "lm_warmup", programs=3 if self.spec_k else 2,
+                kernels=kernels, spec_k=self.spec_k,
+            )
+        log.info(
+            "lm engine warm: %d programs, kernels=%s",
+            3 if self.spec_k else 2, kernels,
+        )
         self._thread = threading.Thread(
             target=self._run, name="lm-engine", daemon=True
         )

@@ -102,6 +102,11 @@ class LMServeConfig:
                                         # bf16 verify dispatch per
                                         # round (SERVING.md
                                         # "Speculative decoding")
+    kernels: bool = False               # Pallas serving path: in-kernel
+                                        # page-table-walk attention +
+                                        # fused unpack-GEMM; same
+                                        # three-program set, gather
+                                        # path kept as the oracle
     costs: Optional[bool] = None        # per-program HLO cost ledger +
                                         # measured MFU (obs/costs;
                                         # None = the JG_COSTS env var)
@@ -167,6 +172,7 @@ class LMServer:
                 max_len=cfg.max_len,
                 spec_k=cfg.spec_decode,
                 interpret=self._interpret(),
+                kernels=cfg.kernels,
                 store=AotStore(cfg.aot_dir, telemetry=self.telemetry),
             )
             self.artifact_info = info
@@ -193,6 +199,7 @@ class LMServer:
                 max_len=cfg.max_len,
                 spec_k=cfg.spec_decode,
                 interpret=self._interpret(),
+                kernels=cfg.kernels,
             )
             self.aot_status = "disabled"
         self.vocab = decoder.vocab
@@ -234,6 +241,7 @@ class LMServer:
                 "aot": self.aot_status,
                 "prefix_cache": cfg.prefix_cache,
                 "spec_decode": cfg.spec_decode,
+                "kernels": cfg.kernels,
             },
             artifact_info=self.artifact_info,
         )
@@ -264,6 +272,7 @@ class LMServer:
             "recompiles_post_warmup": eng.recompiles_post_warmup,
             "fence_error": eng.fence_error,
             "max_len": eng.max_len,
+            "kernels": bool(getattr(eng.decoder, "kernels", False)),
             "aot": self.aot_status,
             "uptime_s": round(time.time() - self._started_at, 3),
         }

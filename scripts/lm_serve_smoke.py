@@ -40,6 +40,15 @@ Perfetto-loadable Chrome-trace JSON, and the zero-post-warmup-
 recompile check above now runs WITH tracing on — the budget-0 fence
 must stay green while spans flow.
 
+A second, chaos-free phase arms the Pallas serving path (``--kernels``:
+in-kernel page-table-walk attention + fused bitplane-unpack GEMM, in
+interpret mode on CPU) and replays the same prompts through a kernel
+server and a gather server: the outputs must be TOKEN-IDENTICAL, both
+boots must hold ``recompiles_post_warmup == 0`` with the budget-0 fence
+green (the kernel path compiles the same three-program set — see
+SERVING.md "Zero-recompile serving"), and /healthz must report which
+path is armed.
+
 Usage: python scripts/lm_serve_smoke.py [--dir DIR] [--keep]
 """
 
@@ -80,6 +89,143 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def kernel_path_phase(artifact: str, work: str, failures: list) -> dict:
+    """Gather vs Pallas-kernel serving path, token-identity acceptance.
+
+    Boots the server twice against the same artifact — once on the
+    gather (oracle) path, once with ``--kernels`` — runs the same
+    greedy prompts through each, and asserts identical token streams,
+    zero post-warmup recompiles on BOTH boots (same three compiled
+    programs either way), a green budget-0 fence, and a clean SIGTERM
+    drain. Returns a summary dict for the smoke's JSON output."""
+    from distributed_mnist_bnns_tpu.serve.lm import client as lc
+
+    prompts = [SYSTEM_PROMPT + [7, 2, 3], [5, 4, 3, 2, 1]]
+    tokens_by = {}
+    health_by = {}
+    for variant, extra in (("gather", []), ("kernels", ["--kernels"])):
+        port = _free_port()
+        base = f"http://127.0.0.1:{port}"
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "distributed_mnist_bnns_tpu.cli",
+                "serve", "--lm",
+                "--artifact", artifact,
+                "--port", str(port),
+                "--slots", "2",
+                "--page-size", "8",
+                "--prefill-chunk", "8",
+                "--queue-depth", "4",
+                "--spec-decode", "4",
+                "--interpret",
+                "--log-file",
+                os.path.join(work, f"lm_serve_{variant}.log"),
+                *extra,
+            ],
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+        toks_all = []
+        try:
+            for _ in range(240):
+                try:
+                    if lc.healthz(base, timeout=2)[0] == 200:
+                        break
+                except OSError:
+                    pass
+                if proc.poll() is not None:
+                    failures.append(
+                        f"kernel phase: {variant} server died at startup "
+                        f"(rc {proc.returncode})"
+                    )
+                    return {}
+                time.sleep(0.5)
+            else:
+                failures.append(
+                    f"kernel phase: {variant} server never became healthy"
+                )
+                return {}
+            for p in prompts:
+                code, events = lc.generate(
+                    base, p, max_new_tokens=12,
+                    deadline_ms=120000, timeout=120,
+                )
+                if code != 200:
+                    failures.append(
+                        f"kernel phase: {variant} generate got HTTP {code}"
+                    )
+                    toks_all.append(None)
+                    continue
+                done = events[-1] if events else {}
+                if done.get("status") != "ok":
+                    failures.append(
+                        f"kernel phase: {variant} stream did not finish "
+                        f"ok: {done}"
+                    )
+                toks_all.append(
+                    [e["token"] for e in events if "token" in e]
+                )
+            code, body = lc.healthz(base)
+            health = json.loads(body) if code == 200 else {}
+            health_by[variant] = health
+            if health.get("recompiles_post_warmup") != 0:
+                failures.append(
+                    f"kernel phase: {variant} path recompiled post-"
+                    f"warmup ({health.get('recompiles_post_warmup')}, "
+                    "want 0) — the Pallas/gather flip must not leak "
+                    "extra compiled signatures"
+                )
+            if health.get("fence_error"):
+                failures.append(
+                    f"kernel phase: {variant} fence error: "
+                    f"{health['fence_error']}"
+                )
+            want_kernels = variant == "kernels"
+            if bool(health.get("kernels")) != want_kernels:
+                failures.append(
+                    f"kernel phase: /healthz reports kernels="
+                    f"{health.get('kernels')!r} on the {variant} boot"
+                )
+            proc.send_signal(signal.SIGTERM)
+            try:
+                rc = proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = proc.wait()
+                failures.append(
+                    f"kernel phase: {variant} server did not drain "
+                    "within 60s of SIGTERM"
+                )
+            if rc != 0:
+                failures.append(
+                    f"kernel phase: {variant} server exited {rc} after "
+                    "SIGTERM (want 0)"
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        tokens_by[variant] = toks_all
+    if tokens_by.get("gather") != tokens_by.get("kernels"):
+        failures.append(
+            "kernel phase: Pallas path tokens differ from the gather "
+            f"oracle — gather={tokens_by.get('gather')} "
+            f"kernels={tokens_by.get('kernels')}"
+        )
+    return {
+        "token_identical": tokens_by.get("gather")
+        == tokens_by.get("kernels"),
+        "recompiles_post_warmup": {
+            v: h.get("recompiles_post_warmup")
+            for v, h in health_by.items()
+        },
+        "kernels_flag": {
+            v: h.get("kernels") for v, h in health_by.items()
+        },
+    }
 
 
 def main(argv=None) -> int:
@@ -480,7 +626,11 @@ def main(argv=None) -> int:
         except (OSError, ValueError, KeyError, AssertionError) as e:
             failures.append(f"Chrome-trace export invalid: {e!r}")
 
+    # -- Pallas kernel-path acceptance (chaos-free, deterministic) ------
+    kernel_summary = kernel_path_phase(artifact, work, failures)
+
     summary = {
+        "kernel_path": kernel_summary,
         "streams": {
             tid: {"code": r["code"], "n_tokens": len(r["tokens"]),
                   "status": (r["done"] or {}).get("status"),

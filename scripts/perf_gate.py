@@ -148,6 +148,23 @@ METRIC_PATHS = {
         "lm_serve.packed_1bit.streams_8.p99_intertoken_ms", "max"),
     "lm_spec_acceptance_rate": (
         "lm_serve.spec.acceptance_rate", "min"),
+    # Packed-vs-dense decode throughput at every stream count (ISSUE 20
+    # acceptance; ROADMAP item 2): with the Pallas serving path armed
+    # (in-kernel page-table walk + packed-GEMM carries) the 1-bit
+    # engine must beat the same artifact carried as dense fp32 at 1, 4
+    # AND 8 streams. These are PINNED contract floors (baseline 1.0,
+    # tolerance 0 — see PINNED_FLOORS) that HARD-ARM only on
+    # compiled-kernel records: under the CPU interpreter both rows are
+    # interpreter-overhead-bound and the ratio draws runner noise
+    # around 1.0 (±20% observed across back-to-back runs), so
+    # interpret-mode records report the draw informationally instead
+    # of flaking CI on interpreter jitter (PERF.md round 16).
+    "lm_packed_speedup_1_streams": (
+        "lm_serve.packed_speedup_1_streams", "min"),
+    "lm_packed_speedup_4_streams": (
+        "lm_serve.packed_speedup_4_streams", "min"),
+    "lm_packed_speedup_8_streams": (
+        "lm_serve.packed_speedup_8_streams", "min"),
     # Fleet availability under chaos (ISSUE 15): success fraction of
     # saturating client requests against a 3-replica fleet while one
     # replica is chaos-stalled then killed mid-window — retry/failover
@@ -209,6 +226,18 @@ MIN_TOLERANCES = {
     "fleet_availability_under_chaos": 0.01,
 }
 
+# Floors banked at a PINNED baseline instead of the measured draw: the
+# floor IS the contract. A fast draw must not ratchet the band up and a
+# slow runner must not relax it — packed decode beating dense fp32 at
+# every stream count is ISSUE 20's acceptance line, full stop. On
+# interpret-mode records compare() reports these informationally
+# instead of hard-failing (see METRIC_PATHS comment).
+PINNED_FLOORS = {
+    "lm_packed_speedup_1_streams": 1.0,
+    "lm_packed_speedup_4_streams": 1.0,
+    "lm_packed_speedup_8_streams": 1.0,
+}
+
 # Serving-latency bands whose trips the gate EXPLAINS with `cli
 # trace`-style tail attribution over the bench run's probe events
 # (ROADMAP item 5: "EXPLAIN any band trip, not just detect it").
@@ -249,8 +278,26 @@ def run_bench(events_dir: str | None = None) -> dict:
     return json.loads(lines[-1])
 
 
-def compare(baselines: dict, record: dict) -> list:
-    """Returns a list of failure strings (empty = gate passes)."""
+def _measurement_note(record: dict, path: str) -> str:
+    """Measurement-context suffix for a trip message: LM-serving bands
+    measured with the Pallas kernels under the interpreter (CPU run —
+    bench records ``lm_serve.interpret_mode``) say so in the failure
+    itself, so a reader weighs the trip against interpreter overhead
+    rather than assuming compiled-kernel numbers regressed."""
+    if path.startswith("lm_serve.") and _get(
+        record, "lm_serve.interpret_mode"
+    ):
+        return (
+            " [measured with interpret_mode=true: Pallas kernels ran "
+            "under the interpreter on CPU]"
+        )
+    return ""
+
+
+def compare(baselines: dict, record: dict, notes: list | None = None) -> list:
+    """Returns a list of failure strings (empty = gate passes).
+    ``notes`` (optional) collects informational lines that are not
+    failures — the interpret-mode draws of the pinned speedup floors."""
     failures = []
     for name, spec in baselines.get("metrics", {}).items():
         path, kind = METRIC_PATHS.get(name, (None, None))
@@ -268,26 +315,43 @@ def compare(baselines: dict, record: dict) -> list:
             continue
         base = spec["baseline"]
         tol = float(spec.get("tolerance", 0.0))
+        note = _measurement_note(record, path)
+        if name in PINNED_FLOORS and _get(
+            record, "lm_serve.interpret_mode"
+        ):
+            # The speedup floors certify a weight-bandwidth contract
+            # (1/32 byte/param) that only exists where the kernels
+            # compile; under the interpreter the ratio is runner noise
+            # around 1.0. Record the draw, arm the floor on
+            # compiled-kernel records (see METRIC_PATHS comment).
+            if notes is not None:
+                notes.append(
+                    f"{name}: measured {measured} — pinned floor "
+                    f"{base} is informational under "
+                    "interpret_mode=true, hard-armed on "
+                    "compiled-kernel records" + note
+                )
+            continue
         if kind == "exact":
             if measured != base:
                 failures.append(
                     f"{name}: measured {measured} != banked {base} "
                     "(analytic byte model drifted — if deliberate, "
-                    "re-bank with scripts/perf_gate.py --update)"
+                    "re-bank with scripts/perf_gate.py --update)" + note
                 )
         elif kind == "min":
             floor = base * (1.0 - tol)
             if measured < floor:
                 failures.append(
                     f"{name}: measured {measured} < floor {floor} "
-                    f"(baseline {base}, tolerance {tol})"
+                    f"(baseline {base}, tolerance {tol})" + note
                 )
         else:  # max
             limit = base * (1.0 + tol)
             if measured > limit:
                 failures.append(
                     f"{name}: measured {measured} > allowed {limit} "
-                    f"(baseline {base}, tolerance {tol})"
+                    f"(baseline {base}, tolerance {tol})" + note
                 )
     return failures
 
@@ -429,6 +493,10 @@ def bank(record: dict, prev: dict | None = None) -> dict:
                 f"cannot bank {name}: missing from the record at {path!r} "
                 f"({measured!r})"
             )
+        if name in PINNED_FLOORS:
+            metrics[name] = {"baseline": PINNED_FLOORS[name],
+                             "kind": kind, "tolerance": 0.0}
+            continue
         if kind == "min":
             tol = MIN_TOLERANCES.get(name, 0.0)
         else:
@@ -451,7 +519,13 @@ def bank(record: dict, prev: dict | None = None) -> dict:
             "(serve/fleet/harness.py: 3 replicas, one chaos-stalled "
             "then killed mid-saturation, success fraction through the "
             "real router) are FLOORS (kind=min: measured >= "
-            "baseline*(1-tolerance)). Serving-band, MFU-band and "
+            "baseline*(1-tolerance)). The LM packed-vs-dense speedups "
+            "at 1/4/8 streams are PINNED contract floors (baseline "
+            "1.0, tolerance 0, never ratcheted by --update): with the "
+            "Pallas serving path armed, packed decode must beat dense "
+            "fp32 at every stream count — hard-armed on compiled-"
+            "kernel records, reported informationally on interpret-"
+            "mode records (PERF.md round 16). Serving-band, MFU-band and "
             "fleet-band trips print their own explanation (tail "
             "attribution / cost ledger / per-replica transition log — "
             "explain_failures). Re-bank deliberate changes "
@@ -499,12 +573,15 @@ def main() -> int:
 
     with open(BASELINES) as f:
         baselines = json.load(f)
-    failures = compare(baselines, record)
+    notes: list = []
+    failures = compare(baselines, record, notes=notes)
     for name, spec in sorted(baselines.get("metrics", {}).items()):
         path, _ = METRIC_PATHS.get(name, (None, None))
         measured = _get(record, path) if path else None
         print(f"perf_gate: {name}: measured={measured} "
               f"baseline={spec['baseline']} ({spec['kind']})")
+    for n_ in notes:
+        print(f"perf_gate: note: {n_}")
     if failures:
         print("\nPERF GATE FAILED:", file=sys.stderr)
         for f_ in failures:

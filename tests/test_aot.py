@@ -492,6 +492,37 @@ class TestServerBootFromStore:
             ref.slots, ref.page_size, ref.num_pages, ref.max_pages,
             ref.max_len, ref.prefill_chunk, ref.vocab, ref.num_blocks)
 
+    def test_kernels_flip_is_a_miss(self, lm_artifact, tmp_path):
+        """The Pallas serving path compiles different executables from
+        the gather path, so ``kernels`` lives in every LM cache key's
+        extras: banking the gather pair must NOT serve a kernels-armed
+        boot (silently running the wrong programs), and each path hits
+        on its own keys thereafter."""
+        store_dir = str(tmp_path / "s")
+        _, _, meta = load_paged_lm_decoder_aot(
+            lm_artifact, slots=2, page_size=8, interpret=True,
+            store=AotStore(store_dir),
+        )
+        assert meta["status"] == "miss"
+        dec_g, _, meta_g = load_paged_lm_decoder_aot(
+            lm_artifact, slots=2, page_size=8, interpret=True,
+            kernels=False, store=AotStore(store_dir),
+        )
+        assert meta_g["status"] == "hit"
+        assert dec_g.kernels is False
+        dec_k, _, meta_k = load_paged_lm_decoder_aot(
+            lm_artifact, slots=2, page_size=8, interpret=True,
+            kernels=True, store=AotStore(store_dir),
+        )
+        assert meta_k["status"] == "miss"      # flag flip = key miss
+        assert dec_k.kernels is True
+        dec_k2, _, meta_k2 = load_paged_lm_decoder_aot(
+            lm_artifact, slots=2, page_size=8, interpret=True,
+            kernels=True, store=AotStore(store_dir),
+        )
+        assert meta_k2["status"] == "hit"      # kernel set banked
+        assert dec_k2.kernels is True
+
 
 class TestTrainerAot:
     def _cfg(self, tmp_path, **over):

@@ -174,6 +174,52 @@ class TestPagedEqualsContiguous:
             got, np.stack(ref), atol=1e-5, rtol=1e-5
         )
 
+    def test_kernels_path_logprobs_match_gather(self, frozen):
+        """The Pallas serving path (in-kernel page-table-walk attention
+        + fused bitplane-unpack GEMM, SERVING.md "The Pallas serving
+        path") must reproduce the gather decoder's log-probs at every
+        position of the same chunked-prefill + decode schedule."""
+        decs = {
+            kernels: make_paged_lm_decoder(
+                frozen, slots=2, page_size=4, prefill_chunk=8,
+                interpret=True, donate=False, kernels=kernels,
+            )
+            for kernels in (False, True)
+        }
+        assert decs[True].kernels and not decs[False].kernels
+        tokens = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(11), (18,), 0, 32),
+            np.int32,
+        )
+        table = np.zeros(decs[False].max_pages, np.int32)
+        table[:5] = [5, 1, 4, 2, 3]            # scrambled page order
+        lps = {}
+        for kernels, dec in decs.items():
+            pools = dec.init_pools()
+            got = []
+            for start in (0, 8):
+                pools, clp = dec.prefill(
+                    pools, jnp.asarray(tokens[start:start + 8]),
+                    jnp.asarray(table), jnp.asarray(np.int32(start)),
+                    jnp.asarray(np.int32(16)),
+                )
+                got.extend(np.asarray(clp))
+            tables = np.zeros((2, decs[False].max_pages), np.int32)
+            tables[0] = table
+            positions = np.zeros(2, np.int32)
+            toks = np.zeros(2, np.int32)
+            for t in (16, 17):
+                positions[0], toks[0] = t, tokens[t]
+                pools, lp = dec.decode(
+                    pools, jnp.asarray(toks), jnp.asarray(tables),
+                    jnp.asarray(positions),
+                )
+                got.append(np.asarray(lp)[0])
+            lps[kernels] = np.stack(got)
+        np.testing.assert_allclose(
+            lps[True], lps[False], atol=1e-5, rtol=1e-5
+        )
+
 
 # -- the engine: continuous batching -----------------------------------------
 
@@ -222,6 +268,29 @@ class TestEngine:
         assert all(e["pages_freed"] > 0 for e in evicts.values())
         # page accounting closed out
         assert eng.allocator.used_count() == 0
+
+    def test_greedy_tokens_identical_with_kernels_armed(
+        self, frozen, contiguous
+    ):
+        """Engine-level token identity with the Pallas path armed: the
+        greedy stream must equal the single-sequence generate() oracle
+        exactly (CPU XLA is bitwise deterministic, so the kernels-on
+        log-probs argmax the same), with the budget-0 fence green."""
+        dec = make_paged_lm_decoder(
+            frozen, slots=2, page_size=8, prefill_chunk=8,
+            interpret=True, kernels=True,
+        )
+        eng = LMEngine(dec, queue_depth=4).start()
+        try:
+            prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+            req = eng.submit(prompt, 10, time.monotonic() + 120)
+            toks, done = _drain_tokens(req)
+            assert done["status"] == "ok"
+            assert eng.recompiles_post_warmup == 0
+            assert eng.fence_error is None
+        finally:
+            eng.stop()
+        assert toks == _greedy_ref(frozen, contiguous, prompt, 10)
 
     def test_queued_past_deadline_never_prefilled(self, frozen, tmp_path):
         dec = make_paged_lm_decoder(

@@ -14,6 +14,11 @@ from distributed_mnist_bnns_tpu.ops.paged_kv import (
     gather_kv,
     init_pools,
     paged_attention,
+    paged_attention_kernel,
+    paged_prefill_attention,
+    paged_prefill_attention_kernel,
+    paged_verify_attention,
+    paged_verify_attention_kernel,
     pages_needed,
     write_kv,
 )
@@ -251,3 +256,147 @@ def test_null_page_absorbs_inactive_slot_writes():
     kp = write_kv(kp, idx0, jnp.asarray(np.full((1, h, d), 9.0, np.float32)))
     strip = np.asarray(gather_kv(kp, table))
     np.testing.assert_array_equal(strip[0], real[0])
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel vs gather oracle (interpret mode — runs on CPU)
+# ---------------------------------------------------------------------------
+
+
+def _fill_slot(kp, vp, table, length, ps, rng):
+    """Write ``length`` random K/V rows through ``table``; returns the
+    updated pools and the contiguous rows for reference math."""
+    h, d = kp.shape[-2], kp.shape[-1]
+    rows_k = rng.randn(length, h, d).astype(np.float32)
+    rows_v = rng.randn(length, h, d).astype(np.float32)
+    idx = flat_write_indices(
+        jnp.asarray(table), jnp.arange(length, dtype=jnp.int32), ps
+    )
+    kp = write_kv(kp, idx, jnp.asarray(rows_k))
+    vp = write_kv(vp, idx, jnp.asarray(rows_v))
+    return kp, vp, rows_k, rows_v
+
+
+class TestPagedKernelVsOracle:
+    """The in-kernel page-table walk must reproduce the gather oracle's
+    log-probs to fp tolerance in every lifecycle corner the engine hits:
+    lengths spanning page boundaries, scrambled page order, null-page
+    slots, and page/slot reuse after early termination."""
+
+    def test_decode_matches_oracle_boundary_spans_and_scrambled_pages(self):
+        ps, h, d = 4, 2, 8
+        rng = np.random.RandomState(0)
+        # lengths 4 (exact page), 5 (one past boundary), 11 (mid-page),
+        # 12 (exact multi-page) — through deliberately scrambled tables
+        lens = [4, 5, 11, 12]
+        tables = np.zeros((4, 3), np.int32)
+        tables[0, :1] = [7]
+        tables[1, :2] = [3, 9]
+        tables[2, :3] = [10, 1, 6]
+        tables[3, :3] = [5, 11, 2]
+        kp, vp = init_pools(1, 12, ps, h, d)[0]
+        for si, length in enumerate(lens):
+            kp, vp, _, _ = _fill_slot(kp, vp, tables[si], length, ps, rng)
+        q = jnp.asarray(rng.randn(4, h, d).astype(np.float32))
+        positions = jnp.asarray([l - 1 for l in lens], jnp.int32)
+        tb = jnp.asarray(tables)
+        ref = paged_attention(q, kp, vp, tb, positions)
+        got = paged_attention_kernel(q, kp, vp, tb, positions, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+
+    def test_decode_null_page_slots_agree_with_oracle(self):
+        """Inactive slots (all-null tables) and trailing null entries in
+        active tables must not perturb active slots, and the kernel must
+        agree with the oracle on the inactive rows too (both attend only
+        position 0 of the null page)."""
+        ps, h, d = 4, 2, 4
+        rng = np.random.RandomState(1)
+        tables = np.zeros((3, 3), np.int32)      # slot 1 fully null
+        tables[0, :2] = [2, 5]
+        tables[2, :1] = [7]
+        kp, vp = init_pools(1, 8, ps, h, d)[0]
+        kp, vp, _, _ = _fill_slot(kp, vp, tables[0], 6, ps, rng)
+        kp, vp, _, _ = _fill_slot(kp, vp, tables[2], 3, ps, rng)
+        q = jnp.asarray(rng.randn(3, h, d).astype(np.float32))
+        positions = jnp.asarray([5, 0, 2], jnp.int32)
+        tb = jnp.asarray(tables)
+        ref = paged_attention(q, kp, vp, tb, positions)
+        got = paged_attention_kernel(q, kp, vp, tb, positions, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+        assert np.all(np.isfinite(np.asarray(got)))
+
+    def test_reuse_after_early_termination(self):
+        """Free a slot's pages mid-flight, let another sequence grab them
+        (allocator hands them back in a different order), overwrite, and
+        decode again: the kernel must track the new table exactly and
+        show no ghost of the terminated sequence's K/V."""
+        ps, h, d = 4, 1, 4
+        rng = np.random.RandomState(2)
+        alloc = PageAllocator(6)
+        first = alloc.alloc(3)                    # e.g. [1, 2, 3]
+        kp, vp = init_pools(1, 6, ps, h, d)[0]
+        kp, vp, _, _ = _fill_slot(kp, vp, np.asarray(first, np.int32),
+                                  10, ps, rng)
+        alloc.free(first)                         # early termination
+        second = alloc.alloc(3)
+        assert sorted(second) == sorted(first)    # pages actually reused
+        table2 = np.asarray(second[::-1], np.int32)   # different order
+        kp, vp, _, _ = _fill_slot(kp, vp, table2, 9, ps, rng)
+        q = jnp.asarray(rng.randn(1, h, d).astype(np.float32))
+        positions = jnp.asarray([8], jnp.int32)
+        tb = jnp.asarray(table2[None])
+        ref = paged_attention(q, kp, vp, tb, positions)
+        got = paged_attention_kernel(q, kp, vp, tb, positions, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+
+    def test_verify_matches_oracle(self):
+        """K-query verify windows (speculative decode) through scrambled
+        tables, windows straddling page boundaries."""
+        ps, h, d, k = 4, 2, 4, 3
+        rng = np.random.RandomState(3)
+        lens = [7, 10]                            # window covers 5..7, 8..10
+        tables = np.zeros((2, 3), np.int32)
+        tables[0, :2] = [6, 1]
+        tables[1, :3] = [4, 8, 2]
+        kp, vp = init_pools(1, 10, ps, h, d)[0]
+        for si, length in enumerate(lens):
+            kp, vp, _, _ = _fill_slot(kp, vp, tables[si], length, ps, rng)
+        q = jnp.asarray(rng.randn(2, k, h, d).astype(np.float32))
+        positions = jnp.asarray([lens[0] - k, lens[1] - k], jnp.int32)
+        tb = jnp.asarray(tables)
+        ref = paged_verify_attention(q, kp, vp, tb, positions)
+        got = paged_verify_attention_kernel(
+            q, kp, vp, tb, positions, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+
+    def test_prefill_matches_oracle_including_padding_queries(self):
+        """Chunked prefill: real queries plus padding rows past the true
+        length — both paths produce garbage there but must produce the
+        SAME finite garbage (mask row non-empty, no NaN)."""
+        ps, h, d = 4, 2, 4
+        rng = np.random.RandomState(4)
+        table = np.asarray([5, 2, 7], np.int32)
+        length = 9
+        kp, vp = init_pools(1, 8, ps, h, d)[0]
+        kp, vp, _, _ = _fill_slot(kp, vp, table, length, ps, rng)
+        chunk = 8                                  # second chunk: 8..15
+        q = jnp.asarray(rng.randn(chunk, h, d).astype(np.float32))
+        q_positions = jnp.arange(8, 8 + chunk, dtype=jnp.int32)
+        tb = jnp.asarray(table)
+        ref = paged_prefill_attention(q, kp, vp, tb, q_positions)
+        got = paged_prefill_attention_kernel(
+            q, kp, vp, tb, q_positions, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+        assert np.all(np.isfinite(np.asarray(got)))

@@ -1,0 +1,60 @@
+"""Repo hygiene guards.
+
+The stray ``log.txt`` at the repo root has reappeared twice despite being
+covered by ``.gitignore`` (PR 7 removed it once already).  The durable fix is
+a tier-1 guard: no file matching an ignored pattern may be tracked by git, so
+a accidental ``git add -f`` (or an add that predates the ignore rule) trips CI
+instead of riding along silently.
+"""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _git(*args):
+    return subprocess.run(
+        ["git", *args],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+
+
+def _require_git_repo():
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+    probe = _git("rev-parse", "--is-inside-work-tree")
+    if probe.returncode != 0 or probe.stdout.strip() != "true":
+        pytest.skip("not running inside a git work tree")
+
+
+def test_no_ignored_pattern_file_is_tracked():
+    """``git ls-files -ci --exclude-standard`` must be empty.
+
+    A non-empty listing means a file matching ``.gitignore`` is tracked —
+    exactly how the stray ``log.txt`` kept sneaking back into the tree.
+    """
+    _require_git_repo()
+    out = _git("ls-files", "-ci", "--exclude-standard")
+    assert out.returncode == 0, out.stderr
+    offenders = [line for line in out.stdout.splitlines() if line.strip()]
+    assert not offenders, (
+        "tracked files match ignored patterns (git rm --cached them): "
+        f"{offenders}"
+    )
+
+
+def test_stray_root_log_txt_absent_or_ignored():
+    """The root ``log.txt`` must never be tracked; untracked copies are
+    tolerated (the ``lm`` subcommand writes one by default) because
+    ``.gitignore`` keeps them out of commits."""
+    _require_git_repo()
+    out = _git("ls-files", "--", "log.txt")
+    assert out.returncode == 0, out.stderr
+    assert not out.stdout.strip(), "log.txt is tracked at the repo root"

@@ -413,3 +413,101 @@ def test_fused_affine_epilogue_matches_unfused():
             np.asarray(got), np.asarray(want), atol=1e-6, rtol=1e-6,
             err_msg=f"{(m, k, n)}",
         )
+
+
+class TestFusedUnpackGemm:
+    """xnor_matmul_fused_unpack: the serving decode path's GEMM. The
+    bitplane unpack happens inside the kernel K-loop (HBM reads stay at
+    1/32 byte per weight); on ±1 operands the result must be BITWISE
+    equal to unpack-then-GEMM — fp32 accumulation of ±1 dot products is
+    exact in any summation order — and therefore also to the popcount
+    kernel."""
+
+    def _unpack_oracle(self, x, wp, k, n):
+        """Unpack the packed planes back to ±1 fp32 and jnp.dot — the
+        'unpack then GEMM' reference the fused kernel must match bit
+        for bit."""
+        kw = wp.shape[0]
+        words = np.asarray(wp).astype(np.uint32)          # (KW_p, N_p)
+        bits = (words[:, None, :] >> np.arange(32)[None, :, None]) & 1
+        w_full = (2.0 * bits - 1.0).reshape(kw * 32, -1).astype(np.float32)
+        return np.asarray(x) @ w_full[:k, :n]
+
+    @pytest.mark.parametrize("block_m,block_n", [(256, 256), (8, 128)])
+    def test_bitwise_equals_unpack_then_gemm_randomized_shapes(
+        self, block_m, block_n
+    ):
+        """MXU-sized (256/256) and VPU-sized (8/128) block shapes over
+        randomized awkward shapes: odd M, partial pack words, K spanning
+        one vs many kernel K-chunks (kc = 8 words = 256 bits)."""
+        from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+            prepack_weights,
+            xnor_matmul_fused_unpack,
+            xnor_matmul_packed,
+        )
+
+        rng = np.random.RandomState(block_m)
+        shapes = [(1, 32, 128), (7, 63, 130), (9, 100, 257)]
+        for _ in range(3):                      # randomized shapes/K
+            shapes.append((
+                int(rng.randint(1, 40)),
+                int(rng.randint(1, 1200)),
+                int(rng.randint(1, 300)),
+            ))
+        for i, (m, k, n) in enumerate(shapes):
+            x = _pm1(jax.random.PRNGKey(1000 + i), (m, k))
+            w = _pm1(jax.random.PRNGKey(2000 + i), (k, n))
+            wp, kk, nn_ = prepack_weights(w)
+            got = np.asarray(xnor_matmul_fused_unpack(
+                x, wp, kk, nn_,
+                block_m=block_m, block_n=block_n, interpret=True,
+            ))
+            oracle = self._unpack_oracle(x, wp, kk, nn_)
+            np.testing.assert_array_equal(
+                got, oracle, err_msg=f"shape {(m, k, n)}"
+            )
+            # and bitwise vs the popcount kernel (both exact on ±1)
+            pop = np.asarray(
+                xnor_matmul_packed(x, wp, kk, nn_, interpret=True)
+            )
+            np.testing.assert_array_equal(
+                got, pop, err_msg=f"vs popcount, shape {(m, k, n)}"
+            )
+
+    def test_multi_kchunk_accumulation(self):
+        """K large enough that the fused kernel's sequential K-grid runs
+        many 256-bit steps (kc=8 words): accumulation across steps stays
+        exact (every partial sum is an integer below 2^24)."""
+        from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+            prepack_weights,
+            xnor_matmul_fused_unpack,
+        )
+
+        for k in (4095, 4097, 8193):
+            x = _pm1(jax.random.PRNGKey(30), (5, k))
+            w = _pm1(jax.random.PRNGKey(31), (k, 140))
+            wp, kk, nn_ = prepack_weights(w)
+            got = np.asarray(xnor_matmul_fused_unpack(
+                x, wp, kk, nn_, interpret=True
+            ))
+            np.testing.assert_array_equal(
+                got, np.asarray(x @ w), err_msg=f"K={k}"
+            )
+
+    def test_pad_bits_are_neutralized(self):
+        """Pack-word pad bits unpack to -1 inside the kernel; the entry
+        point zero-pads x's K extent so those columns contribute 0. A
+        K one short of a word boundary is the sharpest case."""
+        from distributed_mnist_bnns_tpu.ops.xnor_gemm import (
+            prepack_weights,
+            xnor_matmul_fused_unpack,
+        )
+
+        m, k, n = 6, 31, 64                      # 31 bits: 1 pad bit
+        x = _pm1(jax.random.PRNGKey(40), (m, k))
+        w = _pm1(jax.random.PRNGKey(41), (k, n))
+        wp, kk, nn_ = prepack_weights(w)
+        got = np.asarray(
+            xnor_matmul_fused_unpack(x, wp, kk, nn_, interpret=True)
+        )
+        np.testing.assert_array_equal(got, np.asarray(x @ w))
